@@ -1,0 +1,28 @@
+"""hpnn_tpu -- a TPU-native rebuild of libhpnn (ovhpa/hpnn).
+
+A JAX/XLA/Pallas framework for on-the-fly training of small fully-connected
+neural networks, with the reference's complete capability surface (ANN/SNN
+model families, BP/BPM training, text .conf/.kernel formats, stdout grammar)
+re-designed TPU-first:
+
+* compute is jit-compiled XLA (fp64 parity path, fp32/bf16 throughput path)
+* the per-sample train-to-convergence loop is a single on-device
+  ``lax.while_loop`` (no host round-trip per iteration)
+* distribution is a ``jax.sharding.Mesh`` -- row-sharded tensor parallelism
+  (the reference's MPI strategy) and batched data parallelism (new) via
+  collectives compiled by XLA over ICI/DCN.
+
+Package map:
+    utils/     glibc-compatible PRNG, verbosity-gated logging
+    io/        .conf, .kernel/.opt checkpoints, sample files
+    models/    the MLP kernel container + seeded generation
+    ops/       jit step functions: forward, error, deltas, BP/BPM, while-loop
+    parallel/  mesh runtime, TP/DP shardings, collectives
+    api.py     nn_def-level driver API (train_kernel / run_kernel)
+"""
+
+__version__ = "0.1.0"
+
+from . import io, models, utils
+
+__all__ = ["io", "models", "utils", "__version__"]

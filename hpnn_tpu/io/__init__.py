@@ -1,0 +1,16 @@
+from .conf import NNConf, dump_conf, load_conf, parse_conf
+from .kernel_io import dump_kernel, dump_kernel_to_path, load_kernel
+from .samples import list_sample_dir, load_dataset, read_sample
+
+__all__ = [
+    "NNConf",
+    "parse_conf",
+    "load_conf",
+    "dump_conf",
+    "load_kernel",
+    "dump_kernel",
+    "dump_kernel_to_path",
+    "read_sample",
+    "list_sample_dir",
+    "load_dataset",
+]

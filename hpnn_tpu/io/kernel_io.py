@@ -1,0 +1,197 @@
+"""Text kernel checkpoint format, byte-compatible with the reference.
+
+Writer mirrors ``ann_dump`` (``/root/reference/src/ann.c:770-857``):
+
+    [name] <name>
+    [param] <n_in> <h1> ... <n_out>
+    [input] <n_in>
+    [hidden 1] <N>
+    [neuron 1] <M>
+    <w> <w> ... <w>          (M values at %17.15f, space separated)
+    ...
+    [output] <N>
+    [neuron 1] <M>
+    ...
+
+Reader mirrors ``ann_load`` (``/root/reference/src/ann.c:206-631``): the
+``[param]`` line fixes the topology, then ``[hidden i]``/``[output]`` sections
+each carry N ``[neuron j]`` blocks of M weights.  The reference requires the
+file to start with ``[name]`` (ann.c:260-264) and validates every count; we do
+the same so malformed files fail identically.
+"""
+
+from __future__ import annotations
+
+from typing import IO, Iterator
+
+import numpy as np
+
+from ..models.kernel import Kernel
+from ..utils.nn_log import nn_error
+
+
+def format_weight(v: float) -> str:
+    """C's %17.15f."""
+    return f"{v:17.15f}"
+
+
+def dump_kernel(kernel: Kernel, fp: IO[str]) -> None:
+    """Write the reference text format (ann_dump, ann.c:770-857)."""
+    if kernel is None:
+        nn_error("CAN'T SAVE KERNEL! kernel=NULL\n")
+        return
+    w = kernel.weights
+    fp.write(f"[name] {kernel.name}\n")
+    fp.write("[param] " + " ".join(str(p) for p in kernel.params) + "\n")
+    fp.write(f"[input] {kernel.n_inputs}\n")
+    for idx, mat in enumerate(w[:-1]):
+        n, m = mat.shape
+        fp.write(f"[hidden {idx + 1}] {n}\n")
+        _dump_neurons(fp, mat)
+    n, m = w[-1].shape
+    fp.write(f"[output] {n}\n")
+    _dump_neurons(fp, w[-1])
+
+
+def _dump_neurons(fp: IO[str], mat: np.ndarray) -> None:
+    n, m = mat.shape
+    for j in range(n):
+        fp.write(f"[neuron {j + 1}] {m}\n")
+        row = mat[j]
+        fp.write(" ".join(format_weight(float(v)) for v in row))
+        fp.write("\n")
+
+
+def dump_kernel_to_path(kernel: Kernel, path: str) -> None:
+    with open(path, "w") as fp:
+        dump_kernel(kernel, fp)
+
+
+class _Lines:
+    """Line cursor returning None at EOF."""
+
+    def __init__(self, fp: IO[str]):
+        self._it: Iterator[str] = iter(fp)
+
+    def next(self) -> str | None:
+        return next(self._it, None)
+
+
+def _parse_ints(text: str) -> list[int]:
+    vals = []
+    for tok in text.replace("\t", " ").split():
+        if tok.lstrip("-").isdigit():
+            vals.append(int(tok))
+        else:
+            break
+    return vals
+
+
+def load_kernel(path: str) -> Kernel | None:
+    """Parse the text kernel format (ann_load, ann.c:206-631).
+
+    Returns None on malformed input, with the reference's NN(ERR) messages.
+    """
+    try:
+        fp = open(path, "r")
+    except OSError:
+        nn_error(f"Error opening kernel file: {path}\n")
+        return None
+    with fp:
+        lines = _Lines(fp)
+        first = lines.next()
+        if first is None or "[name]" not in first:
+            nn_error("kernel file should start with [name] keyword!\n")
+            return None
+        name = first.split("[name]", 1)[1].strip()
+        if not name:
+            name = "noname"
+        # find [param]
+        params: list[int] | None = None
+        line = first
+        while line is not None:
+            if "[param]" in line:
+                params = _parse_ints(line.split("[param]", 1)[1])
+                break
+            line = lines.next()
+        if not params:
+            nn_error("kernel read: missing parameter line!\n")
+            return None
+        if len(params) < 3:
+            nn_error("kernel read: parameter line has too few parameters!\n")
+            return None
+        if any(p == 0 for p in params):
+            nn_error("kernel read: zero in parameter line!\n")
+            return None
+        dims = params
+        n_layers = len(dims) - 1
+        weights: list[np.ndarray | None] = [None] * n_layers
+
+        line = lines.next()
+        while line is not None:
+            stripped = line
+            if "[hidden" in stripped and "]" in stripped:
+                head = stripped.split("[hidden", 1)[1]
+                idx_txt, rest = head.split("]", 1)
+                if not idx_txt.strip().isdigit():
+                    nn_error("kernel read: wrong hidden layer parameters!\n")
+                    return None
+                layer = int(idx_txt.strip()) - 1
+                n = _parse_ints(rest)
+                if layer < 0 or layer >= n_layers - 1 or not n or n[0] != dims[layer + 1]:
+                    nn_error("kernel read: wrong hidden layer parameters!\n")
+                    return None
+                mat = _read_layer(lines, dims[layer + 1], dims[layer])
+                if mat is None:
+                    return None
+                weights[layer] = mat
+            elif "[output]" in stripped:
+                n = _parse_ints(stripped.split("[output]", 1)[1])
+                if not n or n[0] != dims[-1]:
+                    nn_error("kernel read: wrong output parameters!\n")
+                    return None
+                mat = _read_layer(lines, dims[-1], dims[-2])
+                if mat is None:
+                    return None
+                weights[-1] = mat
+            line = lines.next()
+
+        if any(w is None for w in weights):
+            nn_error("kernel read: missing layer weights!\n")
+            return None
+        return Kernel(name=name, weights=[np.asarray(w, dtype=np.float64) for w in weights])
+
+
+def _read_layer(lines: _Lines, n: int, m: int) -> np.ndarray | None:
+    """Read N [neuron j] blocks of M doubles each."""
+    mat = np.empty((n, m), dtype=np.float64)
+    for j in range(n):
+        line = lines.next()
+        while line is not None and line.strip() == "":
+            line = lines.next()
+        if line is None or "[neuron" not in line or "]" not in line:
+            nn_error("kernel read: missing neuron line!\n")
+            return None
+        head = line.split("[neuron", 1)[1]
+        _, rest = head.split("]", 1)
+        cnt = _parse_ints(rest)
+        if not cnt or cnt[0] != m:
+            nn_error("kernel read: wrong neuron parameters!\n")
+            return None
+        # read m doubles from subsequent lines
+        vals: list[float] = []
+        while len(vals) < m:
+            line = lines.next()
+            if line is None:
+                nn_error("kernel read: missing weight values!\n")
+                return None
+            for tok in line.split():
+                try:
+                    vals.append(float(tok))
+                except ValueError:
+                    nn_error("kernel read: bad weight value!\n")
+                    return None
+                if len(vals) == m:
+                    break
+        mat[j] = vals
+    return mat

@@ -1,0 +1,131 @@
+"""Sample-file I/O and directory listing.
+
+A sample file is text (``_NN(read,sample)``,
+``/root/reference/src/libhpnn.c:1070-1145``):
+
+    [input] N
+    v1 v2 ... vN
+    [output] M
+    t1 t2 ... tM
+
+The reference reads all N values from the single line following the header
+(libhpnn.c:1102-1111); we additionally accept values spanning several lines
+(documented deviation -- strictly more permissive, every reference-valid file
+parses identically).  Directory listing skips dotfiles (``libhpnn.c:1194-1198``) and preserves the
+OS readdir order, which the seeded shuffle then permutes -- for reproducible
+runs across filesystems we sort the listing first and document the deviation
+(readdir order is inode-dependent and not reproducible even for the reference
+itself across machines; the shuffle seed only fixes the permutation applied on
+top of it).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..utils.nn_log import nn_error
+
+
+def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
+    """Parse one sample file; (None, None) on failure, as the reference."""
+    try:
+        fp = open(path, "r")
+    except OSError:
+        return None, None
+    vec_in: np.ndarray | None = None
+    vec_out: np.ndarray | None = None
+    with fp:
+        lines = fp.readlines()
+    i = 0
+    while i < len(lines):
+        line = lines[i]
+        if "[input" in line:
+            n, vals, i = _read_vector(lines, i, "[input", path, "input")
+            if vals is None:
+                return None, None
+            vec_in = vals
+            continue
+        if "[output" in line:
+            n, vals, i = _read_vector(lines, i, "[output", path, "output")
+            if vals is None:
+                return None, None
+            vec_out = vals
+            continue
+        i += 1
+    return vec_in, vec_out
+
+
+def _read_vector(lines, i, key, path, what):
+    rest = lines[i].split(key, 1)[1]
+    if rest[:1] == "]":
+        rest = rest[1:]
+    rest = rest.strip()
+    if not rest or not rest.split()[0].isdigit():
+        nn_error(f"sample {path} {what} read failed!\n")
+        return None, None, i
+    n = int(rest.split()[0])
+    if n == 0:
+        # the reference prints "input read failed" even for the output count
+        # (copy-paste quirk at libhpnn.c:1122-1125) -- grammar is API, keep it
+        nn_error(f"sample {path} input read failed!\n")
+        return None, None, i
+    vals: list[float] = []
+    i += 1
+    while len(vals) < n and i < len(lines):
+        for tok in lines[i].split():
+            try:
+                vals.append(float(tok))
+            except ValueError:
+                nn_error(f"sample {path} {what} read failed!\n")
+                return None, None, i
+            if len(vals) == n:
+                break
+        i += 1
+    if len(vals) < n:
+        nn_error(f"sample {path} {what} read failed!\n")
+        return None, None, i
+    return n, np.asarray(vals, dtype=np.float64), i
+
+
+def list_sample_dir(dirpath: str) -> list[str] | None:
+    """File names (not paths) in dirpath, dotfiles skipped, sorted.
+
+    The reference walks readdir order (libhpnn.c:1190-1214); we sort for
+    cross-machine determinism (see module docstring).
+    """
+    try:
+        names = os.listdir(dirpath)
+    except OSError:
+        return None
+    return sorted(n for n in names if not n.startswith(".") and os.path.isfile(os.path.join(dirpath, n)))
+
+
+def load_dataset(dirpath: str, order: list[int] | None = None):
+    """Bulk-load a sample directory into stacked arrays.
+
+    This is the batched path the reference lacks (it re-reads and re-parses
+    every text file per epoch); returns (names, X, T) with X (S, n_in) and
+    T (S, n_out) float64.  ``order`` permutes files before stacking.
+    """
+    names = list_sample_dir(dirpath)
+    if names is None:
+        return None, None, None
+    if order is not None:
+        names = [names[i] for i in order]
+    xs, ts, kept = [], [], []
+    for name in names:
+        vec_in, vec_out = read_sample(os.path.join(dirpath, name))
+        if vec_in is None or vec_out is None:
+            continue
+        if xs and (vec_in.shape != xs[0].shape or vec_out.shape != ts[0].shape):
+            # dimensionally inconsistent file: skip like any other bad sample
+            nn_error(f"sample {name} dimension mismatch, skipped!\n")
+            continue
+        xs.append(vec_in)
+        ts.append(vec_out)
+        kept.append(name)
+    if not xs:
+        return kept, None, None
+    return kept, np.stack(xs), np.stack(ts)

@@ -1,0 +1,3 @@
+from .kernel import Kernel, generate_kernel
+
+__all__ = ["Kernel", "generate_kernel"]

@@ -1,0 +1,102 @@
+"""The MLP "kernel" -- the reference's single model data structure.
+
+The reference's ``kernel_ann`` (``/root/reference/include/libhpnn/ann.h:35-55``)
+is a stack of dense layers without biases: each layer is a row-major weight
+matrix W of shape (n_neurons, n_inputs) and an activation vector.  The same
+structure backs all three model families (ANN sigmoid output, SNN softmax
+output, LNN linear output -- the latter declared but unimplemented in the
+reference, ``/root/reference/src/libhpnn.c:975-978``).
+
+Here the host-side kernel is a plain container of float64 numpy arrays; the
+device-side compute path (hpnn_tpu.ops) consumes ``kernel.weights`` as a tuple
+pytree of jnp arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Sequence
+
+import numpy as np
+
+from ..utils.glibc_random import RAND_MAX, GlibcRandom
+
+
+@dataclasses.dataclass
+class Kernel:
+    """Host-side MLP parameter container.
+
+    weights[l] has shape (N_l, M_l) with M_0 == n_inputs and
+    N_{last} == n_outputs; layer l computes act(W_l @ v_{l-1}).
+    """
+
+    name: str
+    weights: list[np.ndarray]
+    momentum: list[np.ndarray] | None = None  # dw buffers (BPM), ann.c:1876-1939
+
+    @property
+    def n_inputs(self) -> int:
+        return int(self.weights[0].shape[1])
+
+    @property
+    def n_outputs(self) -> int:
+        return int(self.weights[-1].shape[0])
+
+    @property
+    def hiddens(self) -> list[int]:
+        return [int(w.shape[0]) for w in self.weights[:-1]]
+
+    @property
+    def n_hiddens(self) -> int:
+        return len(self.weights) - 1
+
+    @property
+    def params(self) -> list[int]:
+        """The `[param]` line: n_inputs, hidden sizes..., n_outputs."""
+        return [self.n_inputs, *self.hiddens, self.n_outputs]
+
+    def momentum_init(self) -> None:
+        """Allocate + zero dw buffers (ann_momentum_init, ann.c:1876-1890)."""
+        self.momentum = [np.zeros_like(w) for w in self.weights]
+
+    def momentum_free(self) -> None:
+        self.momentum = None
+
+    def validate(self) -> bool:
+        """Shape-consistency check (ann_validate_kernel, ann.c:862-879)."""
+        if not self.weights:
+            return False
+        for a, b in zip(self.weights, self.weights[1:]):
+            if a.shape[0] != b.shape[1]:
+                return False
+        return True
+
+
+def generate_kernel(
+    seed: int,
+    n_inputs: int,
+    hiddens: Sequence[int],
+    n_outputs: int,
+    name: str = "noname",
+) -> tuple[Kernel, int]:
+    """Random kernel with the reference's exact init stream.
+
+    Reproduces ``ann_generate`` (``/root/reference/src/ann.c:632-766``):
+    ``srandom(seed)`` (seed 0 replaced by time()), then each layer's weights
+    filled row-major with ``2*(random()/RAND_MAX - 0.5)/sqrt(M)`` -- hidden
+    layers first in order, output layer last.
+
+    Returns (kernel, effective_seed) since the reference writes back the
+    time()-derived seed into the conf when seed==0 (ann.c:653).
+    """
+    seed = int(seed)
+    if seed == 0:
+        seed = int(time.time())
+    rng = GlibcRandom(seed)
+    dims = [int(n_inputs), *[int(h) for h in hiddens], int(n_outputs)]
+    weights: list[np.ndarray] = []
+    for m, n in zip(dims[:-1], dims[1:]):
+        u = rng.uniform_array(n * m).reshape(n, m)
+        weights.append(2.0 * (u - 0.5) / np.sqrt(float(m)))
+    return Kernel(name=name, weights=weights), seed

@@ -1,0 +1,89 @@
+"""Verbosity-gated logging reproducing the reference's stdout grammar.
+
+The reference routes all output through five printf-macros gated on a global
+verbosity level (``/root/reference/include/libhpnn.h:95-122``):
+
+    NN_DBG    verbose > 2   prefix "NN(DBG): "
+    NN_OUT    verbose > 1   prefix "NN: "
+    NN_COUT   verbose > 1   no prefix (continuation lines)
+    NN_WARN   verbose > 0   prefix "NN(WARN): "
+    NN_ERROR  always        prefix "NN(ERR): "   (stderr)
+
+Only process 0 prints (``common.h:81-86`` gates _OUT on MPI rank 0) -- here we
+gate on ``jax.process_index() == 0``, resolved lazily so pure-IO code paths do
+not pull in jax.
+
+The tutorials scrape this grammar with grep/awk (e.g.
+``tutorials/mnist/tutorial.bash:179-183`` counts PASS lines), so these exact
+strings are a de-facto API of the framework.
+"""
+
+from __future__ import annotations
+
+import sys
+
+_verbosity = 0
+_is_main_process: bool | None = None
+
+
+def _main_process() -> bool:
+    global _is_main_process
+    if _is_main_process is None:
+        try:
+            import jax
+
+            _is_main_process = jax.process_index() == 0
+        except Exception:
+            _is_main_process = True
+    return _is_main_process
+
+
+def set_verbosity(level: int) -> None:
+    global _verbosity
+    _verbosity = int(level)
+
+
+def get_verbosity() -> int:
+    return _verbosity
+
+
+def inc_verbosity() -> None:
+    global _verbosity
+    _verbosity += 1
+
+
+def dec_verbosity() -> None:
+    global _verbosity
+    if _verbosity > 0:
+        _verbosity -= 1
+
+
+def _emit(stream, text: str) -> None:
+    if _main_process():
+        stream.write(text)
+        stream.flush()
+
+
+def nn_dbg(text: str) -> None:
+    if _verbosity > 2:
+        _emit(sys.stdout, "NN(DBG): " + text)
+
+
+def nn_out(text: str) -> None:
+    if _verbosity > 1:
+        _emit(sys.stdout, "NN: " + text)
+
+
+def nn_cout(text: str) -> None:
+    """Continuation output -- no prefix (libhpnn.h:107-111)."""
+    if _verbosity > 1:
+        _emit(sys.stdout, text)
+
+
+def nn_warn(text: str) -> None:
+    if _verbosity > 0:
+        _emit(sys.stdout, "NN(WARN): " + text)
+
+
+def nn_error(text: str) -> None:
+    _emit(sys.stderr, "NN(ERR): " + text)

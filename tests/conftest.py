@@ -1,0 +1,17 @@
+"""Test configuration: virtual 8-device CPU mesh + fp64.
+
+The reference tests multi-device paths on one GPU by faking 3 CUDA contexts
+under -DDEBUG (/root/reference/include/libhpnn/common.h:511-572); our analog
+is XLA's host-platform device multiplier.  Must be set before jax import.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

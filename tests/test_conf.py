@@ -1,0 +1,99 @@
+"""Conf file parser/dumper behavior (libhpnn.c:658-937)."""
+
+import io
+
+from hpnn_tpu.io.conf import NNConf, dump_conf, load_conf, parse_conf
+
+MNIST_CONF = """# NN configuration for MNIST (tutorials/mnist/tutorial.bash:125-136)
+[name] mnist_ann
+[type] ANN
+[init] generate
+[seed] 10958
+[input] 784
+[hidden] 300
+[output] 10
+[train] BP
+[sample_dir] samples
+[test_dir] tests
+"""
+
+
+def test_parse_mnist():
+    conf = parse_conf(io.StringIO(MNIST_CONF))
+    assert conf is not None
+    assert conf.name == "mnist_ann"
+    assert conf.type == "ANN"
+    assert conf.need_init is True
+    assert conf.seed == 10958
+    assert conf.n_inputs == 784
+    assert conf.hiddens == [300]
+    assert conf.n_outputs == 10
+    assert conf.train == "BP"
+    assert conf.samples == "samples"
+    assert conf.tests == "tests"
+
+
+def test_parse_type_first_char():
+    for text, want in (("S", "SNN"), ("SNN", "SNN"), ("L", "LNN"), ("A", "ANN"), ("whatever", "ANN")):
+        conf = parse_conf(io.StringIO(f"[type] {text}\n[init] generate\n[input] 1\n[hidden] 1\n[output] 1\n"))
+        assert conf.type == want
+
+
+def test_parse_train_variants():
+    for text, want in (("BP", "BP"), ("BPM", "BPM"), ("CG", "CG"), ("SPLX", "SPLX")):
+        conf = parse_conf(io.StringIO(f"[type] ANN\n[init] k\n[train] {text}\n"))
+        assert conf.train == want
+
+
+def test_init_kernel_file():
+    conf = parse_conf(io.StringIO("[type] ANN\n[init] kernel.opt\n"))
+    assert conf.need_init is False
+    assert conf.f_kernel == "kernel.opt"
+
+
+def test_init_generate_anywhere_in_line():
+    # STRFIND searches the whole line (libhpnn.c:715-717)
+    conf = parse_conf(io.StringIO("[type] ANN\n[init]    GENERATE  \n[input] 2\n[hidden] 2\n[output] 2\n"))
+    assert conf.need_init is True
+
+
+def test_multi_hidden():
+    conf = parse_conf(io.StringIO("[type] ANN\n[init] generate\n[input] 8\n[hidden] 4 5 6\n[output] 2\n"))
+    assert conf.hiddens == [4, 5, 6]
+
+
+def test_missing_type_fails():
+    assert parse_conf(io.StringIO("[init] generate\n[input] 1\n[hidden] 1\n[output] 1\n")) is None
+
+
+def test_value_cleaning_comment():
+    conf = parse_conf(io.StringIO("[type] ANN\n[init] k\n[sample_dir] mydir#comment\n"))
+    assert conf.samples == "mydir"
+
+
+def test_dump_round_trip():
+    conf = parse_conf(io.StringIO(MNIST_CONF))
+    buf = io.StringIO()
+    dump_conf(conf, buf)
+    text = buf.getvalue()
+    assert "[name] mnist_ann\n" in text
+    assert "[type] ANN\n" in text
+    assert "[init] generate\n" in text
+    assert "[seed] 10958\n" in text
+    assert "[train] BP\n" in text
+    # dump uses plural keys (libhpnn.c:911-918) -- grammar check
+    assert "[inputs] 784\n" in text
+    assert "[hiddens] 300 \n" in text
+    assert "[outputs] 10\n" in text
+
+
+def test_extensions_default_off():
+    conf = parse_conf(io.StringIO(MNIST_CONF))
+    assert conf.batch == 0
+    assert conf.dtype == "f64"
+
+
+def test_extensions_parse():
+    conf = parse_conf(io.StringIO(MNIST_CONF + "[batch] 256\n[dtype] bf16\n"))
+    assert conf.batch == 256
+    assert conf.dtype == "bf16"

@@ -1,0 +1,105 @@
+"""Kernel checkpoint format: generation, dump/load round-trip, exact grammar."""
+
+import io as stringio
+
+import numpy as np
+
+from hpnn_tpu.io.kernel_io import dump_kernel, format_weight, load_kernel
+from hpnn_tpu.models.kernel import Kernel, generate_kernel
+
+
+def test_generate_deterministic():
+    k1, s1 = generate_kernel(10958, 4, [3], 2)
+    k2, s2 = generate_kernel(10958, 4, [3], 2)
+    assert s1 == s2 == 10958
+    for a, b in zip(k1.weights, k2.weights):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_generate_scaling():
+    k, _ = generate_kernel(1, 100, [50], 10)
+    # uniform in +-1/sqrt(M) per layer (ann.c:674-677)
+    assert np.abs(k.weights[0]).max() <= 1.0 / np.sqrt(100.0)
+    assert np.abs(k.weights[1]).max() <= 1.0 / np.sqrt(50.0)
+
+
+def test_generate_matches_glibc_stream():
+    from hpnn_tpu.utils.glibc_random import RAND_MAX, GlibcRandom
+
+    k, _ = generate_kernel(77, 2, [3], 2)
+    rng = GlibcRandom(77)
+    # hidden layer first, row-major, then output (ann.c:658-707)
+    for mat in k.weights:
+        n, m = mat.shape
+        for j in range(n):
+            for i in range(m):
+                want = 2.0 * (rng.random() / RAND_MAX - 0.5) / np.sqrt(m)
+                assert mat[j, i] == want
+
+
+def test_seed_zero_uses_time():
+    k, seed = generate_kernel(0, 2, [2], 2)
+    assert seed != 0
+
+
+def test_format_weight_grammar():
+    # C's %17.15f
+    assert format_weight(0.5) == "0.500000000000000"
+    assert format_weight(-0.123456789012345) == "-0.123456789012345"
+    assert format_weight(1.0) == "1.000000000000000"
+
+
+def test_dump_grammar():
+    k = Kernel("mynet", [np.array([[0.5, -0.25]]), np.array([[1.0]])])
+    buf = stringio.StringIO()
+    dump_kernel(k, buf)
+    assert buf.getvalue() == (
+        "[name] mynet\n"
+        "[param] 2 1 1\n"
+        "[input] 2\n"
+        "[hidden 1] 1\n"
+        "[neuron 1] 2\n"
+        "0.500000000000000 -0.250000000000000\n"
+        "[output] 1\n"
+        "[neuron 1] 1\n"
+        "1.000000000000000\n"
+    )
+
+
+def test_round_trip(tmp_path):
+    k, _ = generate_kernel(10958, 7, [5, 4], 3, name="rt")
+    p = tmp_path / "k.kernel"
+    with open(p, "w") as fp:
+        dump_kernel(k, fp)
+    k2 = load_kernel(str(p))
+    assert k2 is not None
+    assert k2.name == "rt"
+    assert k2.params == [7, 5, 4, 3]
+    for a, b in zip(k.weights, k2.weights):
+        # text precision is 15 decimals
+        np.testing.assert_allclose(a, b, atol=5e-16)
+    # second round-trip is byte-identical (idempotent fixed point)
+    buf1, buf2 = stringio.StringIO(), stringio.StringIO()
+    dump_kernel(k2, buf1)
+    k3 = load_kernel(str(p))
+    dump_kernel(k3, buf2)
+    assert buf1.getvalue() == buf2.getvalue()
+
+
+def test_load_rejects_missing_name(tmp_path):
+    p = tmp_path / "bad.kernel"
+    p.write_text("[param] 2 1 1\n")
+    assert load_kernel(str(p)) is None
+
+
+def test_load_rejects_zero_dim(tmp_path):
+    p = tmp_path / "bad.kernel"
+    p.write_text("[name] x\n[param] 2 0 1\n")
+    assert load_kernel(str(p)) is None
+
+
+def test_validate():
+    k, _ = generate_kernel(3, 4, [3], 2)
+    assert k.validate()
+    k.weights[1] = np.zeros((2, 99))
+    assert not k.validate()

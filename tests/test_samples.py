@@ -1,0 +1,59 @@
+"""Sample file reader (libhpnn.c:1070-1145) and dataset loading."""
+
+import numpy as np
+
+from hpnn_tpu.io.samples import list_sample_dir, load_dataset, read_sample
+
+
+def _write_sample(path, vin, vout):
+    with open(path, "w") as fp:
+        fp.write(f"[input] {len(vin)}\n")
+        fp.write(" ".join(f"{v:7.5f}" for v in vin) + "\n")
+        fp.write(f"[output] {len(vout)}\n")
+        fp.write(" ".join(f"{v:5.3f}" for v in vout) + "\n")
+
+
+def test_read_sample(tmp_path):
+    p = tmp_path / "s1"
+    _write_sample(p, [1.0, 2.5, -3.0], [1.0, -1.0])
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 2.5, -3.0])
+    np.testing.assert_allclose(vout, [1.0, -1.0])
+
+
+def test_read_sample_multiline_values(tmp_path):
+    p = tmp_path / "s2"
+    p.write_text("[input] 4\n1.0 2.0\n3.0 4.0\n[output] 1\n1.0\n")
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1, 2, 3, 4])
+    np.testing.assert_allclose(vout, [1])
+
+
+def test_read_sample_missing_file():
+    assert read_sample("/nonexistent/sample") == (None, None)
+
+
+def test_read_sample_bad_count(tmp_path):
+    p = tmp_path / "bad"
+    p.write_text("[input] 0\n\n[output] 1\n1.0\n")
+    assert read_sample(str(p)) == (None, None)
+
+
+def test_list_dir_skips_dotfiles(tmp_path):
+    (tmp_path / ".hidden").write_text("x")
+    (tmp_path / "b").write_text("x")
+    (tmp_path / "a").write_text("x")
+    assert list_sample_dir(str(tmp_path)) == ["a", "b"]
+
+
+def test_load_dataset(tmp_path):
+    for i in range(5):
+        _write_sample(tmp_path / f"s{i}", [float(i)] * 3, [1.0, -1.0])
+    names, x, t = load_dataset(str(tmp_path))
+    assert len(names) == 5
+    assert x.shape == (5, 3)
+    assert t.shape == (5, 2)
+    # bad file is skipped, not fatal (libhpnn.c:1236-1242)
+    (tmp_path / "s_bad").write_text("[input] 2\nnot_a_number x\n")
+    names, x, t = load_dataset(str(tmp_path))
+    assert len(names) == 5
