@@ -1,0 +1,14 @@
+#!/usr/bin/env python3
+"""run_nn -- flag-compatible rebuild of /root/reference/tests/run_nn.c.
+
+Usage: run_nn [-h] [-v]... [-O n] [-B n] [-S n] [conf (default ./nn.conf)]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hpnn_tpu.cli import run_nn_main
+
+if __name__ == "__main__":
+    raise SystemExit(run_nn_main())

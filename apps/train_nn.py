@@ -1,0 +1,14 @@
+#!/usr/bin/env python3
+"""train_nn -- flag-compatible rebuild of /root/reference/tests/train_nn.c.
+
+Usage: train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n] [conf (default ./nn.conf)]
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hpnn_tpu.cli import train_nn_main
+
+if __name__ == "__main__":
+    raise SystemExit(train_nn_main())

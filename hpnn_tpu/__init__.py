@@ -23,6 +23,17 @@ Package map:
 
 __version__ = "0.1.0"
 
-from . import io, models, utils
+from . import io, models, runtime, utils
 
-__all__ = ["io", "models", "utils", "__version__"]
+__all__ = ["io", "models", "runtime", "utils", "__version__"]
+
+
+def __getattr__(name):
+    # ops/api/cli pull in jax; import lazily so pure-IO use stays light
+    if name in ("ops", "api", "cli"):
+        import importlib
+
+        mod = importlib.import_module("." + name, __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,329 @@
+"""`nn_def`-level driver API: configure / train_kernel / run_kernel.
+
+TPU-native rebuild of the reference's orchestration layer
+(``/root/reference/src/libhpnn.c:540-1536``).  The host-visible behavior --
+the ``.conf`` -> kernel workflow, the seeded shuffle, and the per-sample
+stdout grammar the tutorials scrape with grep -- is reproduced exactly, but
+the execution model is redesigned TPU-first:
+
+* the reference re-reads and re-parses every sample text file per epoch and
+  trains it in a host loop (``libhpnn.c:1221-1288``); we bulk-load the sample
+  directory once into stacked (S, n) arrays and run the WHOLE epoch as one
+  jit-compiled ``lax.scan`` on device (hpnn_tpu.ops.train_epoch) -- zero host
+  round-trips per sample;
+* inference stacks the whole test set into one batched GEMM chain
+  (``ops.run_batch``) instead of one GEMV chain per file
+  (``libhpnn.c:1426``);
+* the per-sample console lines are reconstructed afterwards from the scanned
+  statistics, byte-identical to the reference's printf stream.
+
+Stdout grammar (a de-facto API, see SURVEY.md section 5):
+
+  training, one line per sample (NN_OUT so verbose>1; ann.c:2322-2366):
+    "NN: TRAINING FILE: %16.16s\t init=%15.10f"  then " OK"/" NO"  then
+    " N_ITER=%8i final=%15.10f"  then " SUCCESS!\n"/" FAIL!\n"
+    -- except snn_train_BP which ends " final=%15.10f\n" with no verdict
+       (``snn.c:1496-1499``).
+  testing (libhpnn.c:1388-1517):
+    "NN: TESTING FILE: %16.16s\t"  then for ANN " [PASS]\n" or
+    " [FAIL idx=%i]\n"; for SNN " BEST CLASS idx=%i P=%15.10f" first.
+
+Quirks preserved on purpose (each cited):
+
+* skipped unreadable samples leave the "TRAINING FILE: name\t" line without
+  a newline, so the next line concatenates (``libhpnn.c:1230-1242`` prints
+  the header before the read and skips without terminating it);
+* the ANN test verdict initializes its target index to TRUE(=1), so a test
+  file with no target > 0.5 "passes" iff the argmax is 1
+  (``libhpnn.c:1443-1450``);
+* guess starts at n_outputs, so an all-<= -1 output vector fails with an
+  out-of-range guess (``libhpnn.c:1443``);
+* the shuffle consumes glibc random() draws with replacement-retry
+  (``libhpnn.c:1218-1229``) -- reproduced stream-exactly via
+  utils.glibc_random.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+
+from .io.conf import (
+    NN_TRAIN_BP,
+    NN_TRAIN_BPM,
+    NN_TYPE_ANN,
+    NN_TYPE_SNN,
+    NN_TYPE_UKN,
+    NNConf,
+    load_conf,
+)
+from .io.kernel_io import dump_kernel, load_kernel
+from .io.samples import list_sample_dir, read_sample
+from .models.kernel import Kernel, generate_kernel
+from .utils.glibc_random import GlibcRandom, shuffled_indices
+from .utils.nn_log import nn_cout, nn_dbg, nn_error, nn_out
+
+
+@dataclasses.dataclass
+class NNDef:
+    """The reference's `nn_def` handle (include/libhpnn.h:78-89)."""
+
+    conf: NNConf
+    kernel: Kernel | None = None
+
+    # accessor parity with _NN(get,n_inputs) etc. (libhpnn.c:1013-1066)
+    @property
+    def n_inputs(self) -> int:
+        return self.kernel.n_inputs if self.kernel else 0
+
+    @property
+    def n_outputs(self) -> int:
+        return self.kernel.n_outputs if self.kernel else 0
+
+
+def configure(path: str) -> NNDef | None:
+    """_NN(load,conf): parse the .conf then generate or load the kernel
+    (``libhpnn.c:658-884``)."""
+    conf = load_conf(path)
+    if conf is None:
+        return None
+    if conf.need_init:
+        if conf.type == NN_TYPE_UKN:
+            nn_error("no kernel type given!\n")
+            return None
+        kernel, eff_seed = generate_kernel(
+            conf.seed, conf.n_inputs, conf.hiddens, conf.n_outputs,
+            name=conf.name or "noname")
+        # ann_generate writes the time()-derived seed back into the conf
+        # (libhpnn.c:970 passes &_CONF.seed) so the training shuffle and
+        # any conf dump reuse the SAME seed
+        conf.seed = eff_seed
+    else:
+        if conf.f_kernel is None:
+            nn_error("can't load kernel: no filename!\n")
+            return None
+        kernel = load_kernel(conf.f_kernel)
+        if kernel is None:
+            nn_error(f"FAILED to load kernel {conf.f_kernel}\n")
+            return None
+    return NNDef(conf=conf, kernel=kernel)
+
+
+def _dtype_of(conf: NNConf):
+    import jax.numpy as jnp
+
+    return {"f64": jnp.float64, "f32": jnp.float32,
+            "bf16": jnp.bfloat16}.get(conf.dtype, jnp.float64)
+
+
+def _shuffle_order(conf: NNConf, n: int) -> list[int]:
+    """Seeded shuffle of n files (libhpnn.c:1218-1229); seed 0 -> time()
+    written back into the conf, as the reference mutates _CONF.seed."""
+    if conf.seed == 0:
+        conf.seed = int(time.time())
+    return shuffled_indices(GlibcRandom(conf.seed), n)
+
+
+def _load_ordered(dirpath: str, names: list[str], order: list[int],
+                  header: str, n_in: int, n_out: int):
+    """Read samples in shuffled order, skipping unreadable/mismatched files
+    the way the driver does (``libhpnn.c:1230-1242``).
+
+    Returns (events, X, T) where events is a list of (header_line, row)
+    pairs in shuffle order; row is None for skipped files (their header is
+    still printed, unterminated, exactly like the reference which emits the
+    "FILE: name\\t" header before attempting the read).
+    """
+    xs, ts, events = [], [], []
+    for idx in order:
+        name = names[idx]
+        # NN_OUT(stdout,"%s FILE: %16.16s\t") -- printed before the read
+        line = f"{header} FILE: {name[:16]:>16}\t"
+        vec_in, vec_out = read_sample(os.path.join(dirpath, name))
+        if vec_in is None or vec_out is None:
+            events.append((line, None))
+            continue
+        if vec_in.shape[0] != n_in or vec_out.shape[0] != n_out:
+            # the reference would read out of bounds here (no dim check,
+            # libhpnn.c:1243); we skip with a diagnostic -- documented
+            # deviation, cannot reproduce undefined behavior
+            nn_error(f"sample {name} dimension mismatch, skipped!\n")
+            events.append((line, None))
+            continue
+        events.append((line, len(xs)))
+        xs.append(vec_in)
+        ts.append(vec_out)
+    if not xs:
+        return events, None, None
+    return events, np.stack(xs), np.stack(ts)
+
+
+def train_kernel(nn: NNDef) -> bool:
+    """_NN(train,kernel) (``libhpnn.c:1149-1305``): seeded shuffle, per-sample
+    train-to-convergence, per-sample console line -- one on-device epoch."""
+    import jax.numpy as jnp
+
+    from . import ops
+
+    conf = nn.conf
+    if nn.kernel is None or conf.samples is None:
+        return False
+    if conf.type == NN_TYPE_UKN:
+        return False
+    momentum = conf.train == NN_TRAIN_BPM
+    if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN):
+        if momentum:
+            nn.kernel.momentum_init()  # ann_momentum_init (libhpnn.c:1175)
+    else:
+        # LNN: the reference warns here but does NOT return -- training
+        # proceeds through the SNN fallthrough (libhpnn.c:1180-1182,
+        # 1260-1261).  (LNN+BPM would dereference NULL momentum there; we
+        # train with zeroed momentum instead -- documented deviation.)
+        nn_error("unimplemented NN type!\n")
+
+    names = list_sample_dir(conf.samples)
+    if names is None:
+        nn_error(f"can't open sample directory: {conf.samples}\n")
+        return False
+    order = _shuffle_order(conf, len(names))
+    events, xs, ts = _load_ordered(conf.samples, names, order, "TRAINING",
+                                   nn.kernel.n_inputs, nn.kernel.n_outputs)
+    def finish() -> bool:
+        # the tail the reference always runs (libhpnn.c:1291-1301):
+        # momentum teardown for ANN/SNN, second warning for LNN
+        if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN):
+            if momentum:
+                nn.kernel.momentum_free()  # ann_momentum_free (libhpnn.c:1297)
+        else:
+            nn_error("unimplemented NN type!\n")
+        return True
+
+    trainable = conf.train in (NN_TRAIN_BP, NN_TRAIN_BPM)
+    if xs is None or not trainable:
+        # CG/SPLX are declared but unimplemented (libhpnn.c:1253-1257): the
+        # reference still prints each per-file header, runs nothing per
+        # sample (res=0), and returns TRUE -- so every header line is left
+        # unterminated, exactly like a skipped file.
+        for line, _ in events:
+            nn_out(line)
+        return finish()
+
+    dtype = _dtype_of(conf)
+    weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
+    # LNN trains through the SNN fallthrough (libhpnn.c:1260-1261)
+    kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
+    new_weights, stats = ops.train_epoch(
+        weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
+        kind, momentum, alpha=0.2)  # alpha=.2 from the driver (libhpnn.c:1248)
+
+    # reconstruct the per-sample console stream
+    init_err = np.asarray(stats.init_err, dtype=np.float64)
+    first_ok = np.asarray(stats.first_ok)
+    n_iter = np.asarray(stats.n_iter)
+    final_dep = np.asarray(stats.final_dep, dtype=np.float64)
+    success = np.asarray(stats.success)
+    snn_bp = kind == NN_TYPE_SNN and not momentum
+    for line, i in events:
+        nn_out(line)
+        if i is None:
+            continue  # skipped file: header only, no newline (libhpnn.c:1242)
+        nn_cout(f" init={init_err[i]:15.10f}")
+        nn_cout(" OK" if first_ok[i] else " NO")
+        nn_cout(f" N_ITER={int(n_iter[i]):8d}")
+        if snn_bp:
+            # snn_train_BP ends without a verdict (snn.c:1496-1499)
+            nn_cout(f" final={final_dep[i]:15.10f}\n")
+        else:
+            nn_cout(f" final={final_dep[i]:15.10f}")
+            nn_cout(" SUCCESS!\n" if success[i] else " FAIL!\n")
+        if final_dep[i] > 0.1:
+            nn_dbg("bad optimization!\n")
+
+    nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
+    return finish()
+
+
+def run_kernel(nn: NNDef) -> None:
+    """_NN(run,kernel) (``libhpnn.c:1306-1536``): batched evaluation with the
+    reference's PASS/FAIL stdout grammar."""
+    import jax.numpy as jnp
+
+    from . import ops
+
+    conf = nn.conf
+    if nn.kernel is None or conf.tests is None:
+        return
+    if conf.type == NN_TYPE_UKN:
+        return
+    names = list_sample_dir(conf.tests)
+    if names is None:
+        nn_error(f"can't open test directory: {conf.tests}\n")
+        return
+    order = _shuffle_order(conf, len(names))
+    events, xs, ts = _load_ordered(conf.tests, names, order, "TESTING",
+                                   nn.kernel.n_inputs, nn.kernel.n_outputs)
+    if xs is None:
+        for line, _ in events:
+            nn_out(line)
+        return
+
+    dtype = _dtype_of(conf)
+    weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
+    # LNN evaluates through the SNN branch (libhpnn.c:1455-1456)
+    kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
+    outs = np.asarray(
+        ops.run_batch(weights, jnp.asarray(xs, dtype=dtype), kind),
+        dtype=np.float64)
+
+    n_out = nn.kernel.n_outputs
+    for line, i in events:
+        nn_out(line)
+        if i is None:
+            continue
+        out, t = outs[i], ts[i]
+        if kind == NN_TYPE_ANN:
+            # res=-1.; guess=n_outputs; is_ok=TRUE(=1)  (libhpnn.c:1443-1450)
+            res = -1.0
+            guess = n_out
+            target = 1
+            for idx in range(n_out):
+                if res < out[idx]:
+                    guess = idx
+                    res = out[idx]
+                if t[idx] > 0.5:
+                    target = idx
+            if guess == target:
+                nn_cout(" [PASS]\n")
+            else:
+                nn_cout(f" [FAIL idx={target + 1}]\n")
+        else:
+            # SNN: res=0; guess=0; is_ok=0  (libhpnn.c:1499-1514)
+            res = 0.0
+            guess = 0
+            target = 0
+            nn_dbg(" CLASS | PROBABILITY (%)\n")
+            nn_dbg("-------|----------------\n")
+            for idx in range(n_out):
+                nn_dbg(f" {idx + 1:5d} | {out[idx] * 100.0:15.10f}\n")
+                if out[idx] > res:
+                    res = out[idx]
+                    guess = idx
+                if t[idx] > 0.1:
+                    target = idx
+            nn_dbg("-------|----------------\n")
+            nn_cout(f" BEST CLASS idx={guess + 1} P={res * 100.0:15.10f}")
+            if guess == target:
+                nn_cout(" [PASS]\n")
+            else:
+                nn_cout(f" [FAIL idx={target + 1}]\n")
+
+
+def dump_kernel_def(nn: NNDef, fp) -> bool:
+    """_NN(dump,kernel) (libhpnn.c:996-1008)."""
+    if nn.kernel is None:
+        return False
+    dump_kernel(nn.kernel, fp)
+    return True

@@ -1,0 +1,177 @@
+"""train_nn / run_nn command-line drivers.
+
+Flag-compatible rebuilds of the reference demo binaries
+(``/root/reference/tests/train_nn.c``, ``tests/run_nn.c``):
+
+    train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n] [conf]
+    run_nn   [-h] [-v]... [-O n] [-B n] [-S n] [conf]
+
+* flags combine (``-vvv``) and -O/-B/-S accept attached (``-O4``) or
+  separated (``-O 4``) values, like the reference parser
+  (``train_nn.c:100-199``);
+* the conf file defaults to ``./nn.conf`` (``train_nn.c:215``);
+* train_nn dumps the untrained kernel to ``kernel.tmp`` before training and
+  the trained kernel to ``kernel.opt`` after (``train_nn.c:224-243``) --
+  the checkpoint/resume workflow the tutorials build on.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import runtime
+from .api import configure, dump_kernel_def, run_kernel, train_kernel
+from .utils import nn_log
+
+
+def _help_text(name: str, train: bool) -> str:
+    lines = [
+        "***********************************",
+        f"usage:  {name} [-options] [input]",
+        "***********************************",
+        "options:",
+        "-h \tdisplay this help;",
+        "-v \tincrease verbosity;",
+    ]
+    if train:
+        lines.append("-x \tdiscard results.")
+    lines += [
+        "-O \tnumber of host threads (XLA-owned, kept for compatibility).",
+        "-B \tnumber of BLAS threads (XLA-owned, kept for compatibility).",
+        "-S \tnumber of device shards (XLA-owned, kept for compatibility).",
+        "***********************************",
+        "input:     neural network .def file",
+        "contains the network definition and",
+        "topology. May contain weight values",
+        "or context for a random generation.",
+        "***********************************",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+def _parse_args(argv: list[str], name: str, train: bool):
+    """Reference-style parse; returns (filename, verbose) or None on -h,
+    raises SystemExit(-1) on syntax errors."""
+    filename = None
+    verbose = 0
+    numeric = {"O": runtime.set_omp_threads, "B": runtime.set_omp_blas,
+               "S": runtime.set_cuda_streams}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if arg == "-":
+            # bare '-': the reference's switch loop sees ISGRAPH('\0') false
+            # and silently ignores the argument (train_nn.c:86)
+            i += 1
+            continue
+        if arg.startswith("-"):
+            j = 1
+            while j < len(arg):
+                c = arg[j]
+                if c == "h":
+                    sys.stdout.write(_help_text(name, train))
+                    return None
+                if c == "v":
+                    verbose += 1
+                    j += 1
+                    continue
+                if c == "x" and train:
+                    runtime.toggle_dry()  # no-op, as the reference
+                    j += 1
+                    continue
+                if c in numeric:
+                    if j + 1 < len(arg):
+                        value = arg[j + 1:]
+                    else:
+                        i += 1
+                        value = (argv[i] if i < len(argv) else "").lstrip()
+                    # GET_UINT is atoi-style: parse the leading digits
+                    # (train_nn.c:124); trailing junk is ignored
+                    digits = ""
+                    for ch in value:
+                        if not ch.isdigit():
+                            break
+                        digits += ch
+                    if not digits or int(digits) == 0:
+                        sys.stderr.write(
+                            f"syntax error: bad -{c} parameter!\n")
+                        sys.stdout.write(_help_text(name, train))
+                        raise SystemExit(-1)
+                    numeric[c](int(digits))
+                    break  # no combination after a numeric switch
+                sys.stderr.write("syntax error: unrecognized option!\n")
+                sys.stdout.write(_help_text(name, train))
+                raise SystemExit(-1)
+        else:
+            if filename is not None:
+                # second filename: the reference fails silently
+                # (train_nn.c:199 `if(have_filename) goto FAIL;`)
+                raise SystemExit(-1)
+            filename = arg
+        i += 1
+    return filename or "./nn.conf", verbose
+
+
+def train_nn_main(argv: list[str] | None = None) -> int:
+    """train_nn (tests/train_nn.c:59-255)."""
+    argv = sys.argv[1:] if argv is None else argv
+    runtime.init_all(1)
+    parsed = _parse_args(argv, "train_nn", train=True)
+    if parsed is None:
+        runtime.deinit_all()
+        return 0
+    filename, verbose = parsed
+    nn_log.set_verbosity(verbose)
+    neural = configure(filename)
+    if neural is None:
+        sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    try:
+        with open("kernel.tmp", "w") as fp:
+            dump_kernel_def(neural, fp)
+    except OSError:
+        sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
+        runtime.deinit_all()
+        return -1
+    if not train_kernel(neural):
+        sys.stderr.write("FAILED to train kernel!\n")
+        runtime.deinit_all()
+        return -1
+    try:
+        with open("kernel.opt", "w") as fp:
+            dump_kernel_def(neural, fp)
+    except OSError:
+        sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
+        runtime.deinit_all()
+        return -1
+    runtime.deinit_all()
+    return 0
+
+
+def run_nn_main(argv: list[str] | None = None) -> int:
+    """run_nn (tests/run_nn.c:66-234)."""
+    argv = sys.argv[1:] if argv is None else argv
+    runtime.init_all(1)
+    parsed = _parse_args(argv, "run_nn", train=False)
+    if parsed is None:
+        runtime.deinit_all()
+        return 0
+    filename, verbose = parsed
+    nn_log.set_verbosity(verbose)
+    neural = configure(filename)
+    if neural is None:
+        sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    run_kernel(neural)
+    runtime.deinit_all()
+    return 0
+
+
+def train_nn_entry() -> None:  # console_scripts hook
+    raise SystemExit(train_nn_main())
+
+
+def run_nn_entry() -> None:  # console_scripts hook
+    raise SystemExit(run_nn_main())

@@ -1,6 +1,6 @@
 from .conf import NNConf, dump_conf, load_conf, parse_conf
 from .kernel_io import dump_kernel, dump_kernel_to_path, load_kernel
-from .samples import list_sample_dir, load_dataset, read_sample
+from .samples import list_sample_dir, read_sample
 
 __all__ = [
     "NNConf",
@@ -12,5 +12,4 @@ __all__ = [
     "dump_kernel_to_path",
     "read_sample",
     "list_sample_dir",
-    "load_dataset",
 ]

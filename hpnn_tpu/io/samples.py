@@ -102,30 +102,5 @@ def list_sample_dir(dirpath: str) -> list[str] | None:
     return sorted(n for n in names if not n.startswith(".") and os.path.isfile(os.path.join(dirpath, n)))
 
 
-def load_dataset(dirpath: str, order: list[int] | None = None):
-    """Bulk-load a sample directory into stacked arrays.
-
-    This is the batched path the reference lacks (it re-reads and re-parses
-    every text file per epoch); returns (names, X, T) with X (S, n_in) and
-    T (S, n_out) float64.  ``order`` permutes files before stacking.
-    """
-    names = list_sample_dir(dirpath)
-    if names is None:
-        return None, None, None
-    if order is not None:
-        names = [names[i] for i in order]
-    xs, ts, kept = [], [], []
-    for name in names:
-        vec_in, vec_out = read_sample(os.path.join(dirpath, name))
-        if vec_in is None or vec_out is None:
-            continue
-        if xs and (vec_in.shape != xs[0].shape or vec_out.shape != ts[0].shape):
-            # dimensionally inconsistent file: skip like any other bad sample
-            nn_error(f"sample {name} dimension mismatch, skipped!\n")
-            continue
-        xs.append(vec_in)
-        ts.append(vec_out)
-        kept.append(name)
-    if not xs:
-        return kept, None, None
-    return kept, np.stack(xs), np.stack(ts)
+# NOTE: bulk loading in shuffle order lives in hpnn_tpu.api._load_ordered,
+# which owns the driver's skip/diagnostic semantics (one loader, no drift).

@@ -1,0 +1,34 @@
+from .activations import TINY, ann_act, ann_dact, snn_softmax
+from .convergence import SampleStats, run_batch, train_epoch, train_sample
+from .steps import (
+    ANN,
+    LNN,
+    SNN,
+    BP_LEARN_RATE,
+    BPM_LEARN_RATE,
+    DELTA_BP,
+    DELTA_BPM,
+    MAX_BP_ITER,
+    MAX_BPM_ITER,
+    MIN_BP_ITER,
+    MIN_BPM_ITER,
+    SNN_LEARN_RATE,
+    batched_forward,
+    bp_learn_rate,
+    deltas,
+    error,
+    forward,
+    train_step,
+    train_step_momentum,
+)
+
+__all__ = [
+    "TINY", "ann_act", "ann_dact", "snn_softmax",
+    "SampleStats", "run_batch", "train_epoch", "train_sample",
+    "ANN", "SNN", "LNN",
+    "BP_LEARN_RATE", "SNN_LEARN_RATE", "BPM_LEARN_RATE",
+    "DELTA_BP", "DELTA_BPM",
+    "MIN_BP_ITER", "MAX_BP_ITER", "MIN_BPM_ITER", "MAX_BPM_ITER",
+    "batched_forward", "bp_learn_rate", "deltas", "error", "forward",
+    "train_step", "train_step_momentum",
+]

@@ -1,0 +1,46 @@
+"""Activation functions and per-model-family output heads.
+
+The reference has exactly two nonlinearities:
+
+* ``ann_act(x) = 2/(1+exp(-x)) - 1`` (``/root/reference/src/ann.c:883-885``),
+  a [-1,1]-scaled sigmoid, mathematically ``tanh(x/2)`` -- we compute it as
+  ``jnp.tanh(x*0.5)`` (one fused XLA op) and verify the identity to fp64
+  precision in tests/test_ops.py.
+* the SNN softmax head ``o_i = exp(x_i - 1) / (TINY + sum_j exp(x_j - 1))``
+  (``/root/reference/src/snn.c:296-334``): a softmax of (x-1) **without**
+  max-subtraction and with the denominator seeded at TINY=1e-14
+  (``dv=TINY`` before accumulation, ``snn.c:296``;
+  TINY from ``/root/reference/include/libhpnn/common.h:79``).  Both quirks
+  are preserved for bit-parity; inputs are activation-bounded so the missing
+  max-subtraction cannot overflow.
+
+``ann_dact(y) = -0.5*(y*y - 1)`` (``ann.c:886-888``) is the derivative of
+ann_act expressed in terms of the *output* y.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+TINY = 1e-14  # /root/reference/include/libhpnn/common.h:79
+
+
+def ann_act(x):
+    """2/(1+e^-x)-1 == tanh(x/2) (ann.c:883-885)."""
+    return jnp.tanh(x * 0.5)
+
+
+def ann_dact(y):
+    """Derivative of ann_act as a function of its output (ann.c:886-888)."""
+    return -0.5 * (y * y - 1.0)
+
+
+def snn_softmax(x):
+    """Softmax(x-1) with TINY-seeded denominator (snn.c:296-334).
+
+    Works on the last axis so the same code serves single vectors and
+    batches.
+    """
+    e = jnp.exp(x - 1.0)
+    dv = TINY + jnp.sum(e, axis=-1, keepdims=True)
+    return e / dv

@@ -1,0 +1,166 @@
+"""Per-sample train-to-convergence loop and whole-epoch scan.
+
+The reference's defining training behavior is *online, per-sample training to
+convergence*: each sample is BP-iterated until the error improvement drops
+below delta AND the output argmax matches the target class, bounded by
+MIN/MAX iteration counts (``/root/reference/src/ann.c:2281-2372``,
+``src/snn.c:1417-1595``).  The reference evaluates the stop criterion on the
+host every iteration -- under CUDA that is a D2H copy of the output vector
+per iteration (``ann.c:2330-2339``).
+
+TPU-first redesign: the whole do/while becomes ONE ``lax.while_loop`` whose
+carry holds (weights, momentum, activations); the stop criterion (argmax
+match + error delta) is computed on device.  A whole epoch is a
+``lax.scan`` over the (pre-shuffled) sample arrays, so an epoch of training
+is a single XLA computation with zero host round-trips; the per-sample
+console lines the tutorials scrape are reconstructed afterwards from the
+scanned-out statistics (see hpnn_tpu.api).
+
+Exact loop semantics reproduced (ann.c:2322-2362):
+
+    iter=0
+    do { iter++
+         dEp = train()                     # update + fresh forward + error
+         is_ok = argmax(out) == p_trg      # p_trg: LAST idx with t==1.0, else 0
+         if iter==1: record first-try OK/NO
+         if iter > MAX: break              # update already applied
+         is_ok &= iter > MIN
+    } while (dEp > delta || !is_ok)
+
+* the loop body always runs at least once (do/while);
+* the MAX break happens AFTER the update, so iteration MAX+1's weight
+  update is applied;
+* `p_trg` scans forward taking the last index whose target equals 1.0 and
+  defaults to 0 (ann.c:2341-2348);
+* argmax takes the FIRST maximal index (strict `probe<ptr[idx]`);
+* SUCCESS is `is_ok && iter > MIN` (on the break path `iter > MIN` holds
+  trivially, so one expression serves both exits);
+* snn_train_BP compares dEp against the DELTA_BP constant rather than its
+  delta argument (``snn.c:1497`` -- quirk, irrelevant for the in-tree
+  drivers which always pass delta=-1 => DELTA_BP).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .steps import (
+    ANN,
+    SNN,
+    DELTA_BP,
+    DELTA_BPM,
+    MAX_BP_ITER,
+    MAX_BPM_ITER,
+    MIN_BP_ITER,
+    MIN_BPM_ITER,
+    BPM_LEARN_RATE,
+    bp_learn_rate,
+    error,
+    forward,
+    train_step,
+    train_step_momentum,
+)
+
+
+class SampleStats(NamedTuple):
+    """Per-sample training record, enough to reprint the reference's line."""
+
+    init_err: jax.Array   # error after the initial forward ("init=")
+    first_ok: jax.Array   # bool: argmax correct after first iteration (OK/NO)
+    n_iter: jax.Array     # int32: iterations executed ("N_ITER=")
+    final_dep: jax.Array  # last Ep-Epr ("final=")
+    success: jax.Array    # bool: SUCCESS!/FAIL!
+
+
+def _p_trg(t):
+    """Index of the target class: LAST idx with t==1.0, default 0."""
+    n = t.shape[-1]
+    idxs = jnp.arange(n)
+    return jnp.max(jnp.where(t == 1.0, idxs, 0))
+
+
+def train_sample(weights, x, t, kind: str, momentum: bool,
+                 lr=None, alpha=0.2, delta=-1.0):
+    """Train one sample to convergence; returns (weights, SampleStats).
+
+    ``momentum=False`` follows ann_train_BP / snn_train_BP;
+    ``momentum=True`` follows ann_train_BPM / snn_train_BPM, with the dw
+    buffers zeroed at entry exactly like ``ann_raz_momentum``
+    (``ann.c:2391``) -- momentum does NOT persist across samples.
+    delta<=0 selects the reference default (ann.c:2323).
+    """
+    if lr is None:
+        lr = BPM_LEARN_RATE if momentum else bp_learn_rate(kind)
+    if momentum:
+        min_iter, max_iter = MIN_BPM_ITER, MAX_BPM_ITER
+        if delta <= 0.0:
+            delta = DELTA_BPM
+    else:
+        min_iter, max_iter = MIN_BP_ITER, MAX_BP_ITER
+        if delta <= 0.0:
+            delta = DELTA_BP
+
+    acts0 = forward(weights, x, kind)
+    init_err = error(acts0[-1], t, kind)
+    p_trg = _p_trg(t)
+    dw0 = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
+
+    false = jnp.asarray(False)
+    state0 = (weights, dw0, acts0, jnp.int32(0),
+              jnp.zeros_like(init_err), false, false)
+
+    def cond(state):
+        _, _, _, it, dep, is_ok_raw, _ = state
+        ok_eff = is_ok_raw & (it > min_iter)
+        return (it == 0) | ((it <= max_iter) & ((dep > delta) | ~ok_eff))
+
+    def body(state):
+        w, dw, acts, it, _, _, first_ok = state
+        it = it + 1
+        if momentum:
+            w, dw, acts, dep = train_step_momentum(
+                w, dw, acts, x, t, kind, lr, alpha)
+        else:
+            w, acts, dep = train_step(w, acts, x, t, kind, lr)
+        is_ok_raw = jnp.argmax(acts[-1]) == p_trg
+        first_ok = jnp.where(it == 1, is_ok_raw, first_ok)
+        return (w, dw, acts, it, dep, is_ok_raw, first_ok)
+
+    w, _, _, n_iter, dep, is_ok_raw, first_ok = lax.while_loop(
+        cond, body, state0)
+    success = is_ok_raw & (n_iter > min_iter)
+    return w, SampleStats(init_err, first_ok, n_iter, dep, success)
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "momentum"))
+def train_epoch(weights, xs, ts, kind: str, momentum: bool,
+                alpha=0.2, delta=-1.0):
+    """One full epoch: scan `train_sample` over pre-shuffled sample arrays.
+
+    xs (S, n_in), ts (S, n_out).  Replaces the reference's per-file loop
+    (``libhpnn.c:1221-1288``) with a single on-device computation; the
+    sample order must already carry the seeded shuffle (hpnn_tpu.api does
+    this with the glibc-exact PRNG).  Returns (weights, SampleStats with a
+    leading S axis).
+    """
+
+    def step(w, xt):
+        x, t = xt
+        w, stats = train_sample(w, x, t, kind, momentum,
+                                alpha=alpha, delta=delta)
+        return w, stats
+
+    return lax.scan(step, weights, (xs, ts))
+
+
+@functools.partial(jax.jit, static_argnames=("kind",))
+def run_batch(weights, xs, kind: str):
+    """Batched inference over the whole test set (one GEMM chain)."""
+    from .steps import batched_forward
+
+    return batched_forward(weights, xs, kind)

@@ -1,0 +1,173 @@
+"""Pure single-sample step functions: forward, error, deltas, BP/BPM updates.
+
+These are the TPU-native equivalents of the reference's compute-kernel layer
+(``/root/reference/src/ann.c``, ``src/snn.c``, and their CUDA twins
+``src/cuda_ann.cu``, ``src/cuda_snn.cu``).  Instead of 12 preprocessor
+variants per routine, each operation is ONE traced function; XLA owns fusion,
+tiling and (under a sharded mesh, see hpnn_tpu.parallel) the collectives.
+
+Deltas are written out explicitly -- NOT via jax.grad -- because the update
+rules carry reference quirks that a textbook loss gradient would not
+reproduce:
+
+* ANN output delta includes dact:  d_L = (t - o) * ann_dact(o)
+  (``ann.c:1308-1310``).
+* SNN output delta is the softmax+CE shortcut d_L = (t - o) **even though**
+  the targets contain -1 entries (pmnist writes one-hot as +1/-1,
+  ``tutorials/mnist/prepare_mnist.c:47-60``), so it is not the exact CE
+  gradient -- it is the reference's rule (``snn.c:510-512``).
+* learning rates differ per family: BP 0.001 for ANN
+  (``include/libhpnn.h:67``) but 0.01 for SNN (``snn.c:799``); BPM 0.0005
+  for both (``libhpnn.h:71``).  (The CUDA ANN backend uses 0.01,
+  ``cuda_ann.cu:2131`` -- we follow the CPU rates; documented divergence.)
+* BPM order of operations: dw += lr*outer(d,h); W += dw; dw *= alpha --
+  the weight step is applied BEFORE the decay (``ann.c:1996-1999``), i.e.
+  the fresh gradient enters the step unscaled and alpha only discounts
+  history.
+
+All functions take ``weights`` as a tuple of (N_l, M_l) jnp arrays and are
+dtype-polymorphic (fp64 for parity, fp32/bf16 for throughput).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .activations import TINY, ann_act, ann_dact, snn_softmax
+
+ANN = "ANN"
+SNN = "SNN"
+LNN = "LNN"  # declared in the reference, unimplemented (libhpnn.c:975-978)
+
+# Training hyper-parameters (include/libhpnn.h:67-74, snn.c:799)
+BP_LEARN_RATE = 0.001      # ANN BP (libhpnn.h:67)
+SNN_LEARN_RATE = 0.01      # SNN BP (snn.c:799)
+BPM_LEARN_RATE = 0.0005    # both families, BPM (libhpnn.h:71)
+MIN_BP_ITER = 31           # libhpnn.h:68
+MAX_BP_ITER = 102399       # libhpnn.h:69
+DELTA_BP = 1e-6            # libhpnn.h:70
+MIN_BPM_ITER = 15          # libhpnn.h:72
+MAX_BPM_ITER = 102399      # libhpnn.h:73
+DELTA_BPM = 1e-6           # libhpnn.h:74
+
+
+def bp_learn_rate(kind: str) -> float:
+    return SNN_LEARN_RATE if kind == SNN else BP_LEARN_RATE
+
+
+def forward(weights, x, kind: str):
+    """All layer activations for one sample; acts[-1] is the output vector.
+
+    ANN: every layer (hidden and output) applies ann_act (``ann.c:892-1242``).
+    SNN: hidden layers apply ann_act, output applies softmax(x-1)
+    (``snn.c:79-443``).
+    """
+    acts = []
+    v = x
+    n = len(weights)
+    for i, w in enumerate(weights):
+        z = w @ v
+        if kind == SNN and i == n - 1:
+            v = snn_softmax(z)
+        else:
+            v = ann_act(z)
+        acts.append(v)
+    return tuple(acts)
+
+
+def batched_forward(weights, xs, kind: str):
+    """Batched forward: xs (S, n_in) -> outputs (S, n_out).
+
+    The reference runs one GEMV per file per layer (``libhpnn.c:1426``); on
+    TPU we stack the whole evaluation set into one GEMM chain so the MXU sees
+    (S, M) @ (M, N) matmuls.  Numerically identical per-row to `forward`.
+    """
+    v = xs
+    n = len(weights)
+    for i, w in enumerate(weights):
+        z = v @ w.T
+        if kind == SNN and i == n - 1:
+            v = snn_softmax(z)
+        else:
+            v = ann_act(z)
+    return v
+
+
+def error(out, t, kind: str):
+    """Training error of one sample (scalar).
+
+    ANN: 0.5 * sum((t-o)^2)                        (``ann.c:1246-1275``)
+    SNN: -(1/N) * sum_{o>0} t*log(o + TINY)        (``snn.c:447-477``)
+    The o>0 guard is the reference's serial-path behavior; softmax outputs
+    are strictly positive so it only matters for pathological inputs.
+    """
+    if kind == SNN:
+        n = out.shape[-1]
+        terms = jnp.where(out > 0.0, t * jnp.log(out + TINY), 0.0)
+        return -jnp.sum(terms, axis=-1) / n
+    d = t - out
+    return 0.5 * jnp.sum(d * d, axis=-1)
+
+
+def deltas(weights, acts, t, kind: str):
+    """Back-propagated error terms per layer (``ann.c:1279-1592``,
+    ``snn.c:481-796``).
+
+    Output layer: ANN d=(t-o)*dact(o); SNN d=(t-o).
+    Hidden l:     d_l = (W_{l+1}^T @ d_{l+1}) * dact(h_l).
+    """
+    out = acts[-1]
+    if kind == SNN:
+        d = t - out
+    else:
+        d = (t - out) * ann_dact(out)
+    ds = [d]
+    for l in range(len(weights) - 1, 0, -1):
+        d = (weights[l].T @ ds[0]) * ann_dact(acts[l - 1])
+        ds.insert(0, d)
+    return tuple(ds)
+
+
+def _inputs_per_layer(acts, x):
+    """v_{l-1} for each layer l: the sample for layer 0, else acts[l-1]."""
+    return (x, *acts[:-1])
+
+
+def train_step(weights, acts, x, t, kind: str, lr):
+    """One BP iteration given current activations; the reference's
+    ``ann_kernel_train`` (``ann.c:1596-1872``) / ``snn_kernel_train``
+    (``snn.c:798-1077``).
+
+    Sequence (the forward for `acts` happened previously): error(acts) ->
+    deltas -> rank-1 updates W_l += lr * outer(d_l, v_{l-1}) -> fresh forward
+    -> error.  Returns (new_weights, new_acts, Ep - Epr).
+    """
+    ep = error(acts[-1], t, kind)
+    ds = deltas(weights, acts, t, kind)
+    hs = _inputs_per_layer(acts, x)
+    new_weights = tuple(
+        w + lr * jnp.outer(d, h) for w, d, h in zip(weights, ds, hs)
+    )
+    new_acts = forward(new_weights, x, kind)
+    epr = error(new_acts[-1], t, kind)
+    return new_weights, new_acts, ep - epr
+
+
+def train_step_momentum(weights, dw, acts, x, t, kind: str, lr, alpha):
+    """One BPM iteration (``ann.c:1943-2277``, ``snn.c:1078-1416``).
+
+    dw_l += lr * outer(d_l, v_{l-1});  W_l += dw_l;  dw_l *= alpha
+    (dger/daxpy/dscal triplet, ``ann.c:1996-1999``) -- update before decay.
+    Returns (new_weights, new_dw, new_acts, Ep - Epr).
+    """
+    ep = error(acts[-1], t, kind)
+    ds = deltas(weights, acts, t, kind)
+    hs = _inputs_per_layer(acts, x)
+    dw_stepped = tuple(
+        b + lr * jnp.outer(d, h) for b, d, h in zip(dw, ds, hs)
+    )
+    new_weights = tuple(w + b for w, b in zip(weights, dw_stepped))
+    new_dw = tuple(alpha * b for b in dw_stepped)
+    new_acts = forward(new_weights, x, kind)
+    epr = error(new_acts[-1], t, kind)
+    return new_weights, new_dw, new_acts, ep - epr

@@ -1,0 +1,166 @@
+"""Library runtime: capability report, init/deinit, resource knobs.
+
+TPU-native replacement of the reference's runtime singleton
+(``/root/reference/src/libhpnn.c:58-539``).  The reference compiles a
+capability bitmask (OMP/MPI/CUDA/CUBLAS/PBLAS/SBLAS,
+``include/libhpnn.h:26-35``) and initializes each subsystem; here the
+subsystems are JAX/XLA constructs:
+
+* MPI init          -> ``jax.distributed.initialize()`` (multi-host DCN)
+* CUDA init + probe -> PJRT client init; device discovery via jax.devices()
+* stream pool       -> owned by XLA; the knob survives as a no-op alias
+* BLAS threads      -> XLA host threadpool; no-op alias
+
+The `_NN(set/get,...)` knob surface is kept callable so reference-driven
+programs (and the C shim) work unchanged: setters store the value and warn
+that XLA owns the resource where applicable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from .utils import nn_log
+
+# capability bits: reference values (include/libhpnn.h:26-35) + TPU additions
+NN_CAP_NONE = 0
+NN_CAP_OMP = 1 << 0
+NN_CAP_MPI = 1 << 1
+NN_CAP_CUDA = 1 << 2
+NN_CAP_CUBLAS = 1 << 3
+NN_CAP_PBLAS = 1 << 5
+NN_CAP_SBLAS = 1 << 6
+# new bits, disjoint from the reference's
+NN_CAP_XLA = 1 << 8
+NN_CAP_TPU = 1 << 9
+NN_CAP_X64 = 1 << 10
+
+
+@dataclasses.dataclass
+class NNRuntime:
+    """The `nn_runtime` singleton state (libhpnn.c:58-90)."""
+
+    capability: int = 0
+    nn_dry: bool = False
+    nn_num_threads: int = 1   # -O knob; XLA owns host threads (alias)
+    nn_num_blas: int = 1      # -B knob; alias
+    nn_num_tasks: int = 1     # MPI task count -> jax.process_count()
+    n_devices: int = 1        # CUDA gpu/stream pool -> jax.device_count()
+    n_streams: int = 1        # -S knob; alias (XLA owns streams)
+    initialized: bool = False
+
+
+lib_runtime = NNRuntime()
+
+
+def return_capabilities() -> int:
+    """Compile-time capability probe (libhpnn.c:113-134): here resolved at
+    runtime from the JAX backend."""
+    cap = NN_CAP_XLA
+    try:
+        import jax
+
+        if any(d.platform == "tpu" for d in jax.devices()):
+            cap |= NN_CAP_TPU
+        if jax.config.jax_enable_x64:
+            cap |= NN_CAP_X64
+        if jax.process_count() > 1:
+            cap |= NN_CAP_MPI  # multi-host: the MPI capability analog
+    except Exception:
+        pass
+    return cap
+
+
+def init_runtime() -> None:
+    """_NN(init,runtime) (libhpnn.c:160-172)."""
+    global lib_runtime
+    lib_runtime = NNRuntime()
+    nn_log.set_verbosity(0)
+
+
+def init_all(init_verbose: int = 0) -> int:
+    """_NN(init,all) (libhpnn.c:326-347): bring up the device runtime.
+
+    Enables fp64 (the reference is fp64 throughout, common.h:153) and
+    discovers the device topology.  Returns 0 on success, -1 on failure.
+    """
+    init_runtime()
+    nn_log.set_verbosity(init_verbose)
+    try:
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+        if os.environ.get("HPNN_DISTRIBUTED"):  # multi-host opt-in
+            jax.distributed.initialize()
+        devs = jax.devices()
+        lib_runtime.n_devices = len(devs)
+        lib_runtime.nn_num_tasks = jax.process_count()
+        lib_runtime.capability = return_capabilities()
+        nn_log.nn_out(
+            f"runtime: {len(devs)} {devs[0].platform} device(s), "
+            f"{lib_runtime.nn_num_tasks} process(es)\n")
+        ok = True
+    except Exception as exc:  # pragma: no cover - backend init failure
+        nn_log.nn_error(f"device runtime init failed: {exc}\n")
+        ok = False
+    nn_log.set_verbosity(0)
+    lib_runtime.initialized = ok
+    return 0 if ok else -1
+
+
+def deinit_all() -> int:
+    """_NN(deinit,all) (libhpnn.c:395-407): XLA owns teardown; reset state."""
+    init_runtime()
+    return 0
+
+
+def toggle_dry() -> None:
+    """_NN(toggle,dry): the reference's XOR is a no-op bug
+    (``nn_dry^=nn_dry`` always yields FALSE, libhpnn.c:88-90).  Behavior
+    preserved: toggling dry mode never enables it."""
+    lib_runtime.nn_dry = False
+
+
+# --- knob aliases (set/get triplets, libhpnn.c:409-539) --------------------
+
+def set_omp_threads(n: int) -> bool:
+    lib_runtime.nn_num_threads = max(1, int(n))
+    return True
+
+
+def get_omp_threads() -> int:
+    return lib_runtime.nn_num_threads
+
+
+def set_omp_blas(n: int) -> bool:
+    lib_runtime.nn_num_blas = max(1, int(n))
+    return True
+
+
+def get_omp_blas() -> int:
+    return lib_runtime.nn_num_blas
+
+
+def set_cuda_streams(n: int) -> bool:
+    """Stream-pool knob (libhpnn.c:471-505): XLA owns streams; the value is
+    kept as a shard-count hint for the parallel layer."""
+    lib_runtime.n_streams = max(1, int(n))
+    return True
+
+
+def get_mpi_tasks() -> int:
+    return lib_runtime.nn_num_tasks
+
+
+def get_curr_mpi_task() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def get_n_devices() -> int:
+    return lib_runtime.n_devices

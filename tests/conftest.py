@@ -7,11 +7,17 @@ is XLA's host-platform device multiplier.  Must be set before jax import.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU for tests even when the environment selects a TPU platform
+# (bench.py and the graft entry use the ambient platform instead).  The env
+# var alone is not enough here: the image's sitecustomize registers the TPU
+# plugin and overwrites the jax_platforms config at interpreter startup, so
+# the config must be set again after importing jax (before any backend use).
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,68 @@
+"""Driver-quirk parity tests (behaviors found in code review, each cited)."""
+
+import re
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import cli
+from hpnn_tpu.api import configure, train_kernel
+from hpnn_tpu.utils import nn_log
+
+from test_cli_e2e import N_IN, N_OUT, N_SAMP, corpus  # noqa: F401 (fixture)
+
+
+def test_generate_seed_written_back(tmp_path):
+    """[seed] 0 + generate: the time()-derived seed must be written back so
+    the shuffle reuses it (ann_generate via libhpnn.c:970 takes &_CONF.seed)."""
+    conf = tmp_path / "c.conf"
+    conf.write_text(
+        "[name] x\n[type] ANN\n[init] generate\n[seed] 0\n[input] 4\n"
+        "[hidden] 3\n[output] 2\n[train] BP\n[sample_dir] .\n[test_dir] .\n")
+    nn = configure(str(conf))
+    assert nn is not None
+    assert nn.conf.seed != 0
+
+
+def test_cg_prints_headers_and_succeeds(corpus, capsys):  # noqa: F811
+    """[train] CG: unimplemented, but the reference still prints one
+    unterminated header per file and returns TRUE (libhpnn.c:1231,1253-1257)."""
+    text = open(str(corpus)).read()
+    with open("cg.conf", "w") as fp:
+        fp.write(text.replace("[train] BP", "[train] CG"))
+    rc = cli.train_nn_main(["-vv", "cg.conf"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    headers = re.findall(r"NN: TRAINING FILE: .{16}\t", out)
+    assert len(headers) == N_SAMP
+    assert "N_ITER" not in out
+
+
+def test_lnn_trains_via_snn_fallthrough(corpus, capsys):  # noqa: F811
+    """[type] LNN falls through to the SNN training path with a warning
+    (libhpnn.c:1180-1182, 1260-1261)."""
+    text = open(str(corpus)).read()
+    with open("lnn.conf", "w") as fp:
+        fp.write(text.replace("[type] ANN", "[type] LNN"))
+    rc = cli.train_nn_main(["-vv", "lnn.conf"])
+    captured = capsys.readouterr()
+    assert rc == 0  # kernel.opt written; training ran
+    assert "unimplemented NN type!" in captured.err
+    # SNN-BP grammar: N_ITER lines, no SUCCESS! verdict (snn.c:1496-1499)
+    assert len(re.findall(r"N_ITER=", captured.out)) == N_SAMP
+    assert "SUCCESS!" not in captured.out
+
+
+def test_cli_numeric_flag_atoi_prefix(capsys):
+    """-O 4x parses as 4, atoi-style (GET_UINT, train_nn.c:124)."""
+    parsed = cli._parse_args(["-O", "4x", "-h"], "train_nn", train=True)
+    assert parsed is None  # -h handled after -O consumed its value
+    from hpnn_tpu import runtime
+
+    assert runtime.lib_runtime.nn_num_threads == 4
+    capsys.readouterr()
+
+
+def test_cli_numeric_flag_rejects_nondigit():
+    with pytest.raises(SystemExit):
+        cli._parse_args(["-O", "x4"], "train_nn", train=True)

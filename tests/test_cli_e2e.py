@@ -1,0 +1,135 @@
+"""End-to-end driver tests: train_nn / run_nn on a tiny synthetic corpus.
+
+Replicates the reference's tutorial workflow at miniature scale: generate a
+corpus of one-hot classification samples, train with train_nn (writes
+kernel.tmp / kernel.opt, tests/train_nn.c:224-243), evaluate with run_nn,
+and scrape the stdout grammar exactly like tutorials/mnist/tutorial.bash
+(grep OK on the train log, grep PASS on the results)."""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import cli
+from hpnn_tpu.io.kernel_io import load_kernel
+from hpnn_tpu.utils import nn_log
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+def _write_corpus(dirpath, rng, n):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0  # separable signal
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n")
+            fp.write(" ".join(f"{v:7.5f}" for v in x) + "\n")
+            fp.write(f"[output] {N_OUT}\n")
+            fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+@pytest.fixture()
+def corpus(tmp_path, monkeypatch):
+    rng = np.random.default_rng(99)
+    _write_corpus(tmp_path / "samples", rng, N_SAMP)
+    _write_corpus(tmp_path / "tests", rng, N_SAMP)
+    conf = tmp_path / "nn.conf"
+    conf.write_text(
+        "[name] tiny\n[type] ANN\n[init] generate\n[seed] 1234\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        "[train] BP\n"
+        f"[sample_dir] {tmp_path}/samples\n[test_dir] {tmp_path}/tests\n")
+    monkeypatch.chdir(tmp_path)
+    yield conf
+    nn_log.set_verbosity(0)
+
+
+def test_train_and_run_end_to_end(corpus, capsys):
+    rc = cli.train_nn_main(["-v", "-v", "-v", str(corpus)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # per-sample grammar: one line per sample
+    lines = re.findall(
+        r"NN: TRAINING FILE: .{16}\t init=[ \-\d.]+ (?:OK|NO) "
+        r"N_ITER=[ \d]+ final=[ \-\d.]+ (?:SUCCESS!|FAIL!)", out)
+    assert len(lines) == N_SAMP
+    assert os.path.exists("kernel.tmp")
+    assert os.path.exists("kernel.opt")
+    # kernel.opt must load and differ from kernel.tmp (training happened)
+    k_tmp = load_kernel("kernel.tmp")
+    k_opt = load_kernel("kernel.opt")
+    assert not np.allclose(k_tmp.weights[0], k_opt.weights[0])
+
+    # now evaluate with run_nn against the trained kernel
+    cont = "cont.conf"
+    with open(str(corpus)) as fp:
+        text = fp.read()
+    with open(cont, "w") as fp:
+        fp.write(text.replace("[init] generate", "[init] kernel.opt"))
+    rc = cli.run_nn_main(["-v", "-v", cont])
+    assert rc == 0
+    out = capsys.readouterr().out
+    results = re.findall(r"NN: TESTING FILE: .{16}\t \[(PASS|FAIL)", out)
+    assert len(results) == N_SAMP
+    # trained-to-convergence on a separable corpus: most tests must pass
+    n_pass = sum(1 for r in results if r == "PASS")
+    assert n_pass >= N_SAMP - 2
+
+
+def test_snn_bpm_grammar(corpus, capsys):
+    text = open(str(corpus)).read()
+    with open("snn.conf", "w") as fp:
+        fp.write(text.replace("[type] ANN", "[type] SNN")
+                     .replace("[train] BP", "[train] BPM"))
+    rc = cli.train_nn_main(["-vvv", "snn.conf"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # SNN BPM prints the SUCCESS!/FAIL! verdict (snn.c:1586-1590)
+    assert len(re.findall(r"(?:SUCCESS!|FAIL!)", out)) == N_SAMP
+    with open("snn_run.conf", "w") as fp:
+        fp.write(open("snn.conf").read().replace("[init] generate",
+                                                 "[init] kernel.opt"))
+    rc = cli.run_nn_main(["-vv", "snn_run.conf"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    # SNN grammar: BEST CLASS line before the verdict (libhpnn.c:1512-1514)
+    best = re.findall(r" BEST CLASS idx=\d+ P=[ \d.]+ \[(?:PASS|FAIL)", out)
+    assert len(best) == N_SAMP
+
+
+def test_snn_bp_no_verdict(corpus, capsys):
+    """snn_train_BP ends lines without SUCCESS!/FAIL! (snn.c:1496-1499)."""
+    text = open(str(corpus)).read()
+    with open("snnbp.conf", "w") as fp:
+        fp.write(text.replace("[type] ANN", "[type] SNN"))
+    rc = cli.train_nn_main(["-vvv", "snnbp.conf"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "SUCCESS!" not in out and "FAIL!" not in out
+    assert len(re.findall(r"N_ITER=[ \d]+ final=[ \-\d.]+\n", out)) == N_SAMP
+
+
+def test_help_flag(capsys):
+    assert cli.train_nn_main(["-h"]) == 0
+    out = capsys.readouterr().out
+    assert "usage:  train_nn" in out
+
+
+def test_shuffle_reproducible(corpus, capsys):
+    """Same seed -> identical file order (glibc-exact shuffle)."""
+    cli.train_nn_main(["-vv", str(corpus)])
+    out1 = capsys.readouterr().out
+    files1 = re.findall(r"TRAINING FILE: +(\S+)\t", out1)
+    cli.train_nn_main(["-vv", str(corpus)])
+    out2 = capsys.readouterr().out
+    files2 = re.findall(r"TRAINING FILE: +(\S+)\t", out2)
+    assert files1 == files2
+    assert files1 != sorted(files1)  # the shuffle actually permutes
+    assert len(files1) == N_SAMP

@@ -1,0 +1,224 @@
+"""Compute-kernel tests: parity vs an independent NumPy model of the
+reference algorithms (fp64, tolerances from /root/reference/ChangeLog:34-44:
+1e-14 on vectors, 1e-12 on weight matrices)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hpnn_tpu import ops
+from hpnn_tpu.models.kernel import generate_kernel
+
+RNG = np.random.default_rng(1234)
+
+
+# --- independent NumPy re-derivation of the reference math -----------------
+
+def np_act(x):
+    return 2.0 / (1.0 + np.exp(-x)) - 1.0
+
+
+def np_dact(y):
+    return -0.5 * (y * y - 1.0)
+
+
+def np_forward(ws, x, kind):
+    acts = []
+    v = x
+    for i, w in enumerate(ws):
+        z = w @ v
+        if kind == "SNN" and i == len(ws) - 1:
+            e = np.exp(z - 1.0)
+            v = e / (1e-14 + e.sum())
+        else:
+            v = np_act(z)
+        acts.append(v)
+    return acts
+
+
+def np_error(out, t, kind):
+    if kind == "SNN":
+        return -np.sum(np.where(out > 0, t * np.log(out + 1e-14), 0.0)) / out.size
+    return 0.5 * np.sum((t - out) ** 2)
+
+
+def np_bp_step(ws, acts, x, t, kind, lr):
+    out = acts[-1]
+    ep = np_error(out, t, kind)
+    d = (t - out) if kind == "SNN" else (t - out) * np_dact(out)
+    ds = [d]
+    for l in range(len(ws) - 1, 0, -1):
+        ds.insert(0, (ws[l].T @ ds[0]) * np_dact(acts[l - 1]))
+    hs = [x] + acts[:-1]
+    new_ws = [w + lr * np.outer(d, h) for w, d, h in zip(ws, ds, hs)]
+    new_acts = np_forward(new_ws, x, kind)
+    return new_ws, new_acts, ep - np_error(new_acts[-1], t, kind)
+
+
+def make_net(dims, seed=7):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.uniform(-1, 1, size=(n, m)) / np.sqrt(m)
+        for m, n in zip(dims[:-1], dims[1:])
+    ]
+
+
+# --- activations -----------------------------------------------------------
+
+def test_ann_act_identity():
+    x = np.linspace(-20, 20, 1001)
+    np.testing.assert_allclose(
+        np.asarray(ops.ann_act(jnp.asarray(x))), np_act(x), atol=1e-15)
+
+
+def test_ann_dact():
+    y = np.linspace(-1, 1, 101)
+    np.testing.assert_allclose(
+        np.asarray(ops.ann_dact(jnp.asarray(y))), np_dact(y), atol=1e-16)
+
+
+def test_snn_softmax_tiny_denominator():
+    x = np.array([0.3, -0.2, 1.5])
+    got = np.asarray(ops.snn_softmax(jnp.asarray(x)))
+    e = np.exp(x - 1.0)
+    np.testing.assert_allclose(got, e / (1e-14 + e.sum()), rtol=1e-14)
+    # softmax(x-1) with TINY: sums to slightly under 1
+    assert got.sum() < 1.0
+
+
+# --- forward / error / deltas ---------------------------------------------
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_forward_matches_numpy(kind):
+    ws = make_net([13, 7, 5, 4])
+    x = RNG.uniform(-1, 1, 13)
+    acts = ops.forward(tuple(jnp.asarray(w) for w in ws), jnp.asarray(x), kind)
+    ref = np_forward(ws, x, kind)
+    for a, r in zip(acts, ref):
+        np.testing.assert_allclose(np.asarray(a), r, atol=1e-14)
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_batched_forward_matches_single(kind):
+    ws = tuple(jnp.asarray(w) for w in make_net([9, 6, 4]))
+    xs = RNG.uniform(-1, 1, (11, 9))
+    batched = np.asarray(ops.batched_forward(ws, jnp.asarray(xs), kind))
+    for i in range(11):
+        single = np.asarray(ops.forward(ws, jnp.asarray(xs[i]), kind)[-1])
+        np.testing.assert_allclose(batched[i], single, atol=1e-14)
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_error_matches_numpy(kind):
+    ws = make_net([8, 5, 3])
+    x = RNG.uniform(-1, 1, 8)
+    t = np.full(3, -1.0)
+    t[1] = 1.0
+    acts = np_forward(ws, x, kind)
+    got = float(ops.error(jnp.asarray(acts[-1]), jnp.asarray(t), kind))
+    assert got == pytest.approx(np_error(acts[-1], t, kind), rel=1e-13)
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_bp_step_matches_numpy(kind):
+    lr = 0.01 if kind == "SNN" else 0.001
+    ws = make_net([10, 8, 6, 4])
+    x = RNG.uniform(-1, 1, 10)
+    t = np.full(4, -1.0)
+    t[2] = 1.0
+    jws = tuple(jnp.asarray(w) for w in ws)
+    acts = ops.forward(jws, jnp.asarray(x), kind)
+    new_ws, new_acts, dep = ops.train_step(jws, acts, jnp.asarray(x),
+                                           jnp.asarray(t), kind, lr)
+    ref_ws, ref_acts, ref_dep = np_bp_step(
+        ws, np_forward(ws, x, kind), x, t, kind, lr)
+    for a, b in zip(new_ws, ref_ws):
+        np.testing.assert_allclose(np.asarray(a), b, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(new_acts[-1]), ref_acts[-1], atol=1e-14)
+    assert float(dep) == pytest.approx(ref_dep, abs=1e-14)
+
+
+def test_bpm_step_order_of_operations():
+    """dw += lr*outer; W += dw; dw *= alpha (ann.c:1996-1999)."""
+    ws = make_net([6, 4, 3])
+    x = RNG.uniform(-1, 1, 6)
+    t = np.full(3, -1.0)
+    t[0] = 1.0
+    alpha, lr = 0.2, 0.0005
+    jws = tuple(jnp.asarray(w) for w in ws)
+    dw = tuple(jnp.asarray(RNG.uniform(-0.01, 0.01, w.shape)) for w in ws)
+    acts = ops.forward(jws, jnp.asarray(x), "ANN")
+    new_ws, new_dw, _, _ = ops.train_step_momentum(
+        jws, dw, acts, jnp.asarray(x), jnp.asarray(t), "ANN", lr, alpha)
+    # reference order: the fresh gradient enters W unscaled
+    acts_np = np_forward(ws, x, "ANN")
+    d = (t - acts_np[-1]) * np_dact(acts_np[-1])
+    ds = [d]
+    ds.insert(0, (ws[1].T @ d) * np_dact(acts_np[0]))
+    hs = [x] + acts_np[:-1]
+    for i in range(2):
+        step = np.asarray(dw[i]) + lr * np.outer(ds[i], hs[i])
+        np.testing.assert_allclose(np.asarray(new_ws[i]), ws[i] + step, atol=1e-13)
+        np.testing.assert_allclose(np.asarray(new_dw[i]), alpha * step, atol=1e-13)
+
+
+# --- convergence loop ------------------------------------------------------
+
+def test_train_sample_min_iterations():
+    """Even a converged sample runs > MIN_BP_ITER iterations (do/while with
+    is_ok &= iter>MIN, ann.c:2325-2362)."""
+    kern, _ = generate_kernel(42, 6, [5], 3)
+    ws = tuple(jnp.asarray(w) for w in kern.weights)
+    x = jnp.asarray(RNG.uniform(-1, 1, 6))
+    t = jnp.asarray(np.array([-1.0, 1.0, -1.0]))
+    new_ws, stats = ops.train_sample(ws, x, t, "ANN", momentum=False)
+    assert int(stats.n_iter) > ops.MIN_BP_ITER
+    assert bool(stats.success) or int(stats.n_iter) > ops.MAX_BP_ITER
+    # training must actually reduce the error
+    final_err = float(ops.error(ops.forward(new_ws, x, "ANN")[-1], t, "ANN"))
+    assert final_err < float(stats.init_err)
+
+
+def test_train_sample_bpm_min_iterations():
+    kern, _ = generate_kernel(43, 6, [5], 3)
+    ws = tuple(jnp.asarray(w) for w in kern.weights)
+    x = jnp.asarray(RNG.uniform(-1, 1, 6))
+    t = jnp.asarray(np.array([1.0, -1.0, -1.0]))
+    _, stats = ops.train_sample(ws, x, t, "ANN", momentum=True, alpha=0.2)
+    assert int(stats.n_iter) > ops.MIN_BPM_ITER
+
+
+def test_p_trg_last_match_default_zero():
+    from hpnn_tpu.ops.convergence import _p_trg
+    assert int(_p_trg(jnp.asarray([0.0, 1.0, 0.0, 1.0]))) == 3  # last wins
+    assert int(_p_trg(jnp.asarray([-1.0, -1.0]))) == 0          # default 0
+
+
+def test_train_epoch_scan():
+    kern, _ = generate_kernel(44, 6, [5], 3)
+    ws = tuple(jnp.asarray(w) for w in kern.weights)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (4, 6)))
+    ts_np = -np.ones((4, 3))
+    ts_np[np.arange(4), [0, 1, 2, 1]] = 1.0
+    new_ws, stats = ops.train_epoch(ws, xs, jnp.asarray(ts_np),
+                                    "ANN", False)
+    assert stats.n_iter.shape == (4,)
+    assert all(int(n) > ops.MIN_BP_ITER for n in stats.n_iter)
+    # sequential semantics: sample 0 trained on the initial weights; compare
+    # against a standalone train_sample
+    ws1, s1 = ops.train_sample(ws, xs[0], jnp.asarray(ts_np[0]), "ANN", False)
+    assert float(s1.init_err) == pytest.approx(float(stats.init_err[0]), abs=1e-14)
+    assert int(s1.n_iter) == int(stats.n_iter[0])
+
+
+@pytest.mark.parametrize("kind,momentum", [("ANN", False), ("ANN", True),
+                                           ("SNN", False), ("SNN", True)])
+def test_train_sample_all_variants_run(kind, momentum):
+    kern, _ = generate_kernel(45, 5, [4], 3)
+    ws = tuple(jnp.asarray(w) for w in kern.weights)
+    x = jnp.asarray(RNG.uniform(-1, 1, 5))
+    t = jnp.asarray(np.array([-1.0, -1.0, 1.0]))
+    new_ws, stats = ops.train_sample(ws, x, t, kind, momentum=momentum)
+    assert np.isfinite(float(stats.final_dep))
+    assert int(stats.n_iter) >= 1

@@ -2,7 +2,7 @@
 
 import numpy as np
 
-from hpnn_tpu.io.samples import list_sample_dir, load_dataset, read_sample
+from hpnn_tpu.io.samples import list_sample_dir, read_sample
 
 
 def _write_sample(path, vin, vout):
@@ -46,14 +46,3 @@ def test_list_dir_skips_dotfiles(tmp_path):
     assert list_sample_dir(str(tmp_path)) == ["a", "b"]
 
 
-def test_load_dataset(tmp_path):
-    for i in range(5):
-        _write_sample(tmp_path / f"s{i}", [float(i)] * 3, [1.0, -1.0])
-    names, x, t = load_dataset(str(tmp_path))
-    assert len(names) == 5
-    assert x.shape == (5, 3)
-    assert t.shape == (5, 2)
-    # bad file is skipped, not fatal (libhpnn.c:1236-1242)
-    (tmp_path / "s_bad").write_text("[input] 2\nnot_a_number x\n")
-    names, x, t = load_dataset(str(tmp_path))
-    assert len(names) == 5
