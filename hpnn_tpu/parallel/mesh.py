@@ -1,0 +1,132 @@
+"""Device-mesh construction and sharding helpers.
+
+Replaces the reference's distributed plumbing -- the MPI topology
+(``/root/reference/src/libhpnn.c:182-200``) and the CUDA multi-GPU/stream
+pool (``libhpnn.c:201-305,471-505``) -- with ONE abstraction: a
+``jax.sharding.Mesh`` whose axes carry the two parallel strategies the
+framework supports:
+
+* ``"model"`` -- intra-layer neuron-row sharding, the reference's only
+  distributed strategy (each rank owns a contiguous row block of every
+  weight matrix, re-assembled per layer with ``MPI_Allgather``,
+  ``ann.c:913-936``).  On TPU the rows are sharded with
+  ``P("model", None)`` and GSPMD inserts the all-gathers over ICI.
+* ``"data"`` -- sample-batch sharding (NEW capability, BASELINE.json
+  config 5): batches split over ``P("data", ...)``, gradients averaged
+  with an XLA all-reduce.
+
+Within one host the axes map over ICI; multi-host meshes get DCN between
+process slices via ``jax.distributed`` (runtime.init_all).
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+
+
+def make_mesh(n_data: int | None = None, n_model: int = 1,
+              devices=None) -> Mesh:
+    """A (data, model) mesh over the available devices.
+
+    Defaults to all devices on the data axis (pure DP).  ``n_model``
+    splits neuron rows the way MPI ranks did in the reference.
+    """
+    devices = jax.devices() if devices is None else devices
+    if n_data is None:
+        n_data = max(1, len(devices) // n_model)
+    n = n_data * n_model
+    if n > len(devices):
+        raise ValueError(
+            f"mesh {n_data}x{n_model} needs {n} devices, "
+            f"have {len(devices)}")
+    grid = np.asarray(devices[:n]).reshape(n_data, n_model)
+    return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def row_sharding(mesh: Mesh) -> NamedSharding:
+    """Weight-row sharding: each model-rank owns a row block of every
+    layer, the reference's layout (``ann.c:913-926``)."""
+    return NamedSharding(mesh, P(MODEL_AXIS, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sample-batch sharding over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, None))
+
+
+def pad_topology(weights, k: int):
+    """Zero-pad hidden layer widths up to multiples of ``k`` so row sharding
+    divides evenly.
+
+    Bit-exactness argument (why padding never changes results): a padded
+    hidden neuron has all-zero inbound weights, so its pre-activation is 0
+    and ``ann_act(0) == 0``; its outbound column in the next layer is zero,
+    so it contributes nothing forward.  In backprop its delta is
+    ``(W_next^T d)[pad] * dact(0) == 0`` (zero column), so its row update is
+    zero, and the outbound-column update is ``lr * d * h_pad == 0`` -- the
+    padding is invariant under BP/BPM training, forever zero.  This replaces
+    the reference's redundant remainder-row computation (``ann.c:928-936``),
+    which existed to avoid uneven MPI collectives.
+
+    The output layer is never padded (an SNN softmax over padded logits
+    would change the denominator; an ANN argmax could pick a padded slot).
+    Returns (padded_weights, original_row_dims).
+    """
+    import jax.numpy as jnp
+
+    orig = [int(w.shape[0]) for w in weights]
+    padded = []
+    prev_pad = 0
+    for i, w in enumerate(weights):
+        w = jnp.asarray(w)
+        if prev_pad:
+            w = jnp.concatenate(
+                [w, jnp.zeros((w.shape[0], prev_pad), w.dtype)], axis=1)
+        if i < len(weights) - 1:
+            pad = (-w.shape[0]) % k
+            if pad:
+                w = jnp.concatenate(
+                    [w, jnp.zeros((pad, w.shape[1]), w.dtype)], axis=0)
+            prev_pad = pad
+        padded.append(w)
+    return tuple(padded), orig
+
+
+def unpad_topology(weights, orig_dims):
+    """Undo pad_topology: slice rows to the original widths and columns to
+    the previous layer's original width."""
+    out = []
+    for i, w in enumerate(weights):
+        n = orig_dims[i]
+        m = w.shape[1] if i == 0 else orig_dims[i - 1]
+        out.append(w[:n, :m])
+    return tuple(out)
+
+
+def layer_sharding(w, mesh: Mesh) -> NamedSharding:
+    """Row sharding when the row count divides the model axis, else
+    replicated (the unpadded output layer, typically)."""
+    k = mesh.shape[MODEL_AXIS]
+    return row_sharding(mesh) if w.shape[0] % k == 0 else replicated(mesh)
+
+
+def shard_weights(weights, mesh: Mesh, rows: bool = True):
+    """Place a weight pytree on the mesh.
+
+    ``rows=True`` reproduces the reference's tensor-parallel layout
+    (row blocks per model-rank); ``rows=False`` replicates -- the right
+    call for the tiny reference nets, where weights fit everywhere and
+    replication avoids per-layer gathers (the EXP memory model's replica
+    idea, ``cuda_ann.cu:192-258``, without the hub-and-spoke copies).
+    """
+    sh = row_sharding(mesh) if rows else replicated(mesh)
+    return tuple(jax.device_put(w, sh) for w in weights)

@@ -66,3 +66,20 @@ def test_cli_numeric_flag_atoi_prefix(capsys):
 def test_cli_numeric_flag_rejects_nondigit():
     with pytest.raises(SystemExit):
         cli._parse_args(["-O", "x4"], "train_nn", train=True)
+
+
+def test_dp_batch_mode(corpus, capsys):  # noqa: F811
+    """[batch] B conf extension routes to data-parallel minibatch training."""
+    text = open(str(corpus)).read()
+    with open("dp.conf", "w") as fp:
+        fp.write(text + "[batch] 3\n")
+    rc = cli.train_nn_main(["-vv", "dp.conf"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert len(re.findall(r"TRAINING BATCH ", out)) == N_SAMP // 3
+    import numpy as np
+    from hpnn_tpu.io.kernel_io import load_kernel
+
+    k_tmp = load_kernel("kernel.tmp")
+    k_opt = load_kernel("kernel.opt")
+    assert not np.allclose(k_tmp.weights[0], k_opt.weights[0])

@@ -1,0 +1,149 @@
+"""Multi-device tests on the virtual 8-device CPU mesh.
+
+The analog of the reference's fake 3-GPU DEBUG backend
+(/root/reference/include/libhpnn/common.h:511-572): all distributed paths
+are validated without real multi-chip hardware, with single-device results
+as the parity oracle (ChangeLog:34-44 criteria: 1e-14 vectors / 1e-12
+weights -- "all variants should give the exact same answer")."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hpnn_tpu import ops
+from hpnn_tpu.models.kernel import generate_kernel
+from hpnn_tpu.parallel import (
+    dp_shard,
+    dp_train_epoch,
+    dp_train_step,
+    dp_train_step_momentum,
+    make_mesh,
+    tp_forward,
+    tp_forward_explicit,
+    tp_train_sample,
+)
+
+RNG = np.random.default_rng(5150)
+
+
+def _net(dims, seed=11):
+    kern, _ = generate_kernel(seed, dims[0], dims[1:-1], dims[-1])
+    return tuple(jnp.asarray(w) for w in kern.weights)
+
+
+def test_eight_devices_available():
+    assert jax.device_count() >= 8
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_tp_forward_gspmd_parity(kind):
+    ws = _net([19, 13, 7, 5])
+    x = jnp.asarray(RNG.uniform(-1, 1, 19))
+    mesh = make_mesh(n_data=1, n_model=8)
+    got = tp_forward(ws, x, kind, mesh)
+    want = ops.forward(ws, x, kind)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-14)
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_tp_forward_explicit_parity(kind):
+    """shard_map row blocks + all_gather == single device (ann.c:913-936)."""
+    ws = _net([19, 13, 7, 5], seed=12)
+    x = jnp.asarray(RNG.uniform(-1, 1, 19))
+    mesh = make_mesh(n_data=1, n_model=8)
+    got = tp_forward_explicit(ws, x, kind, mesh)
+    want = ops.forward(ws, x, kind)[-1]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-14)
+
+
+def test_tp_train_sample_parity():
+    """Whole convergence loop under row sharding == single device."""
+    ws = _net([10, 8, 4], seed=13)
+    x = jnp.asarray(RNG.uniform(-1, 1, 10))
+    t = jnp.asarray(np.array([-1.0, 1.0, -1.0, -1.0]))
+    mesh = make_mesh(n_data=1, n_model=4)
+    w_tp, stats_tp = tp_train_sample(ws, x, t, "ANN", False, mesh)
+    w_1d, stats_1d = ops.train_sample(ws, x, t, "ANN", False)
+    assert int(stats_tp.n_iter) == int(stats_1d.n_iter)
+    for a, b in zip(w_tp, w_1d):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+
+
+@pytest.mark.parametrize("kind,momentum", [("ANN", False), ("SNN", True)])
+def test_dp_step_sharded_parity(kind, momentum):
+    """Minibatch step with the batch split over 8 devices == 1 device."""
+    ws = _net([12, 9, 4], seed=14)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (16, 12)))
+    ts_np = -np.ones((16, 4))
+    ts_np[np.arange(16), RNG.integers(0, 4, 16)] = 1.0
+    ts = jnp.asarray(ts_np)
+    lr, alpha = 0.001, 0.2
+    mesh = make_mesh(n_data=8, n_model=1)
+    sws, sxs, sts = dp_shard(ws, xs, ts, mesh)
+    if momentum:
+        dw = tuple(jnp.zeros_like(w) for w in ws)
+        sdw = tuple(jnp.zeros_like(w) for w in sws)
+        got_w, got_dw, got_e = dp_train_step_momentum(
+            sws, sdw, sxs, sts, kind, lr, alpha)
+        want_w, want_dw, want_e = dp_train_step_momentum(
+            ws, dw, xs, ts, kind, lr, alpha)
+    else:
+        got_w, got_e = dp_train_step(sws, sxs, sts, kind, lr)
+        want_w, want_e = dp_train_step(ws, xs, ts, kind, lr)
+    for a, b in zip(got_w, want_w):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+    assert float(got_e) == pytest.approx(float(want_e), rel=1e-12)
+
+
+def test_dp_epoch_reduces_error():
+    ws = _net([8, 6, 3], seed=15)
+    xs_np = RNG.uniform(-1, 1, (32, 8))
+    ts_np = -np.ones((32, 3))
+    cls = RNG.integers(0, 3, 32)
+    xs_np[np.arange(32), cls] += 2.0
+    ts_np[np.arange(32), cls] = 1.0
+    w, errs0 = dp_train_epoch(ws, jnp.asarray(xs_np), jnp.asarray(ts_np),
+                              "ANN", False, n_batches=4, lr=0.05)
+    for _ in range(199):
+        w, errs = dp_train_epoch(w, jnp.asarray(xs_np), jnp.asarray(ts_np),
+                                 "ANN", False, n_batches=4, lr=0.05)
+    assert float(errs.mean()) < float(errs0.mean())
+    assert float(errs.mean()) < 0.5
+
+
+def test_tp_collective_compiled():
+    """The GSPMD TP forward must actually lower to a collective, not a
+    gather-by-copy: check the optimized HLO mentions all-gather."""
+    import functools
+
+    from hpnn_tpu.ops import steps
+    from hpnn_tpu.parallel.mesh import replicated, row_sharding
+
+    ws = _net([16, 16, 8], seed=16)
+    mesh = make_mesh(n_data=1, n_model=8)
+    sws = tuple(jax.device_put(w, row_sharding(mesh)) for w in ws)
+    x = jax.device_put(jnp.asarray(RNG.uniform(-1, 1, 16)), replicated(mesh))
+    fn = jax.jit(functools.partial(steps.forward, kind="ANN"),
+                 out_shardings=replicated(mesh))
+    txt = fn.lower(sws, x).compile().as_text()
+    assert "all-gather" in txt or "all-reduce" in txt
+
+
+def test_dp_epoch_mesh_sharded_parity():
+    """Epoch with per-batch data-axis sharding == unsharded epoch."""
+    ws = _net([8, 8, 4], seed=17)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (32, 8)))
+    ts_np = -np.ones((32, 4))
+    ts_np[np.arange(32), RNG.integers(0, 4, 32)] = 1.0
+    ts = jnp.asarray(ts_np)
+    mesh = make_mesh(n_data=8, n_model=1)
+    w_m, e_m = dp_train_epoch(ws, xs, ts, "ANN", False, n_batches=4,
+                              lr=0.01, mesh=mesh)
+    w_1, e_1 = dp_train_epoch(ws, xs, ts, "ANN", False, n_batches=4,
+                              lr=0.01)
+    for a, b in zip(w_m, w_1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(e_m), np.asarray(e_1), atol=1e-12)
