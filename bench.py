@@ -48,8 +48,10 @@ def main() -> None:
     jxs = jnp.asarray(xs, dtype=dtype)
     jts = jnp.asarray(ts, dtype=dtype)
 
-    # warmup / compile
-    w, stats = train_epoch(weights, jxs[:2], jts[:2], "ANN", False)
+    # warmup / compile at the SAME shapes as the timed run (the scan length
+    # is part of the compiled program; a different S would recompile inside
+    # the timed region)
+    w, stats = train_epoch(weights, jxs, jts, "ANN", False)
     jax.block_until_ready(w)
 
     t0 = time.perf_counter()

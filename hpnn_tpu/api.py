@@ -289,6 +289,10 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     if dropped:
         nn_out(f"DP: dropping {dropped} tail sample(s) "
                f"(S={s} not divisible by batch={bsz})\n")
+        # slice here so dp_train_epoch's bsz = s // n_batches equals the
+        # configured batch size (it would otherwise absorb the tail)
+        jxs = jxs[: n_batches * bsz]
+        jts = jts[: n_batches * bsz]
     new_weights, errs = dp_train_epoch(weights, jxs, jts, kind, momentum,
                                        n_batches, lr, alpha=0.2, mesh=mesh)
     errs = np.asarray(errs, dtype=np.float64)

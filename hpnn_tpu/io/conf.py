@@ -166,7 +166,7 @@ def parse_conf(fp: IO[str]) -> NNConf | None:
             v = _get_uint(_after(line, "[batch"))
             if v is None:
                 nn_error("Malformed NN configuration file!\n")
-                nn_error(f"[batch] value: {_after(line, '[batch')}")
+                nn_error(f"[batch] value: {_after(line, '[batch').strip()}\n")
                 return None
             conf.batch = v
         if "[dtype" in line:

@@ -52,15 +52,32 @@ def _shard_padded(weights, mesh):
     return sharded, orig
 
 
+@functools.lru_cache(maxsize=64)
+def _tp_forward_fn(kind: str, out_sharding):
+    """Cached jitted forward (a fresh jax.jit per call would re-trace and
+    re-compile the program every invocation)."""
+    return jax.jit(functools.partial(steps.forward, kind=kind),
+                   out_shardings=out_sharding)
+
+
+@functools.lru_cache(maxsize=64)
+def _tp_train_fn(kind: str, momentum: bool, shardings, kw_items):
+    from ..ops import convergence
+
+    return jax.jit(
+        functools.partial(convergence.train_sample, kind=kind,
+                          momentum=momentum, **dict(kw_items)),
+        out_shardings=(shardings, None),
+    )
+
+
 def tp_forward(weights, x, kind: str, mesh):
     """Row-sharded forward via GSPMD: same math as ops.forward, hidden
     rows placed ``P('model', None)``; XLA compiles the per-layer gathers.
     Returns all activations, sliced back to the unpadded widths."""
     sharded, orig = _shard_padded(weights, mesh)
     x = jax.device_put(x, replicated(mesh))
-    fn = jax.jit(functools.partial(steps.forward, kind=kind),
-                 out_shardings=replicated(mesh))
-    acts = fn(sharded, x)
+    acts = _tp_forward_fn(kind, replicated(mesh))(sharded, x)
     return tuple(a[:n] for a, n in zip(acts, orig))
 
 
@@ -74,17 +91,11 @@ def tp_train_sample(weights, x, t, kind: str, momentum: bool, mesh, **kw):
     Zero padding is training-invariant (see mesh.pad_topology), so the
     returned weights slice back to the exact unpadded result.
     """
-    from ..ops import convergence
-
     sharded, orig = _shard_padded(weights, mesh)
     shardings = tuple(layer_sharding(w, mesh) for w in sharded)
+    fn = _tp_train_fn(kind, momentum, shardings, tuple(sorted(kw.items())))
     x = jax.device_put(x, replicated(mesh))
     t = jax.device_put(t, replicated(mesh))
-    fn = jax.jit(
-        functools.partial(convergence.train_sample, kind=kind,
-                          momentum=momentum, **kw),
-        out_shardings=(shardings, None),
-    )
     new_w, stats = fn(sharded, x, t)
     return unpad_topology(new_w, orig), stats
 

@@ -1,0 +1,4 @@
+"""Data-preparation tools: pmnist (MNIST idx -> samples) and pdif
+(RRUFF DIF/XY -> XRD samples), rebuilds of the reference converters in
+/root/reference/tutorials/mnist/prepare_mnist.c and
+/root/reference/tutorials/ann/{prepare_dif.c,file_dif.c}."""

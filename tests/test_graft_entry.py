@@ -1,11 +1,12 @@
 """Keep the driver entry points green (they run outside the test env)."""
 
+import os
 import sys
 
 import jax
 import pytest
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import __graft_entry__ as graft
 

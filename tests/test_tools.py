@@ -1,0 +1,138 @@
+"""pmnist / pdif converter tests on synthetic corpora."""
+
+import os
+import struct
+
+import pytest
+
+from hpnn_tpu.io.samples import read_sample
+from hpnn_tpu.tools import pdif, pmnist
+
+
+def _write_idx(tmp_path, stem, images, labels, rows=2, cols=2):
+    with open(tmp_path / f"{stem}_labels", "wb") as fp:
+        fp.write(struct.pack(">II", 0x801, len(labels)))
+        fp.write(bytes(labels))
+    with open(tmp_path / f"{stem}_images", "wb") as fp:
+        fp.write(struct.pack(">IIII", 0x803, len(images), rows, cols))
+        for img in images:
+            fp.write(bytes(img))
+
+
+@pytest.fixture()
+def mnist_dir(tmp_path, monkeypatch):
+    _write_idx(tmp_path, "train",
+               [[0, 128, 255, 7], [1, 2, 3, 4], [9, 8, 7, 6]], [3, 0, 9])
+    _write_idx(tmp_path, "test", [[5, 5, 5, 5], [250, 0, 0, 1]], [1, 2])
+    (tmp_path / "samples").mkdir()
+    (tmp_path / "tests").mkdir()
+    monkeypatch.chdir(tmp_path)
+    return tmp_path
+
+
+def test_pmnist_format(mnist_dir, capsys):
+    assert pmnist.main(["samples", "tests"]) == 0
+    text = open("samples/s00001.txt").read()
+    assert text == ("[input] 4\n"
+                    "0.00000 128.00000 255.00000 7.00000\n"
+                    "[output] 10  #3\n"
+                    "-1.0 -1.0 -1.0 1.0 -1.0 -1.0 -1.0 -1.0 -1.0 -1.0\n")
+    # index continues into the test set (prepare_mnist.c:73)
+    assert sorted(os.listdir("tests")) == ["s00004.txt", "s00005.txt"]
+    vec_in, vec_out = read_sample("tests/s00004.txt")
+    assert vec_out[1] == 1.0  # correct pairing by default
+    out = capsys.readouterr().out
+    assert "# Opened samples label=801 image=803" in out.replace("0x", "")
+
+
+def test_pmnist_reference_quirk(mnist_dir):
+    """--reference-quirks: test image i pairs with label i+1, last dropped
+    (prepare_mnist.c:228-231 double first-label read)."""
+    assert pmnist.main(["--reference-quirks", "samples", "tests"]) == 0
+    names = sorted(os.listdir("tests"))
+    assert names == ["s00004.txt"]  # one of two test images dropped
+    _, vec_out = read_sample("tests/s00004.txt")
+    assert vec_out[2] == 1.0  # image 0 mislabeled with label[1] == 2
+
+
+DIF_TEXT = """Quartz
+Sample: powder, T = 25 C
+CELL PARAMETERS: 4.913 4.913 5.405 90.0 90.0 120.0
+SPACE GROUP: P3_221
+X-RAY WAVELENGTH: 1.541838
+        2-THETA      INTENSITY
+        20.85         55.00
+        26.63        100.00
+"""
+
+RAW_TEXT = """##RRUFF raw header
+4.00 1.0
+10.0 2.0
+20.0 10.0
+50.0 4.0
+89.0 1.0
+"""
+
+
+@pytest.fixture()
+def rruff_dir(tmp_path):
+    (tmp_path / "rruff" / "dif").mkdir(parents=True)
+    (tmp_path / "rruff" / "raw").mkdir()
+    (tmp_path / "samples").mkdir()
+    (tmp_path / "rruff" / "dif" / "R001.txt").write_text(DIF_TEXT)
+    (tmp_path / "rruff" / "raw" / "R001.txt").write_text(RAW_TEXT)
+    return tmp_path
+
+
+def test_pdif_sample(rruff_dir, monkeypatch, capsys):
+    monkeypatch.chdir(rruff_dir)
+    assert pdif.main(["rruff", "-i", "10", "-o", "230"]) == 0
+    vec_in, vec_out = read_sample("samples/R001.txt")
+    assert vec_in.shape == (11,)  # 10 bins + temperature
+    assert vec_in[0] == pytest.approx(298.15 / 273.15, abs=1e-5)
+    # bins of width 8.5 from 5: [5,13.5) has i=2, [13.5,22) has i=10 (max),
+    # [47.5,56) has i=4, [81.5,90) has i=1; 4.00 is below MIN_THETA
+    assert vec_in[1] == pytest.approx(0.2, abs=1e-5)
+    assert vec_in[2] == pytest.approx(1.0, abs=1e-5)
+    assert vec_in[6] == pytest.approx(0.4, abs=1e-5)
+    assert vec_in[10] == pytest.approx(0.1, abs=1e-5)
+    # P3_221 is space group 154 -> slot index 153
+    assert vec_out[153] == 1.0
+    assert (vec_out == 1.0).sum() == 1
+
+
+def test_pdif_unknown_space_group(rruff_dir, monkeypatch, capsys):
+    monkeypatch.chdir(rruff_dir)
+    (rruff_dir / "rruff" / "dif" / "R001.txt").write_text(
+        DIF_TEXT.replace("P3_221", "Zz_99"))
+    assert pdif.main(["rruff", "-i", "10", "-o", "230"]) == 0
+    out = capsys.readouterr().out
+    assert "#DBG: NO_space group = Zz_99" in out
+    _, vec_out = read_sample("samples/R001.txt")
+    assert (vec_out == 1.0).sum() == 0  # all -1: unknown group
+
+
+def test_pdif_temperature_kelvin(rruff_dir, monkeypatch):
+    monkeypatch.chdir(rruff_dir)
+    (rruff_dir / "rruff" / "dif" / "R001.txt").write_text(
+        DIF_TEXT.replace("T = 25 C", "T = 100 K"))
+    assert pdif.main(["rruff", "-i", "10", "-o", "230"]) == 0
+    vec_in, _ = read_sample("samples/R001.txt")
+    assert vec_in[0] == pytest.approx(100.0 / 273.15, abs=1e-5)
+
+
+def test_pdif_mo_wavelength_skipped(rruff_dir, monkeypatch, capsys):
+    monkeypatch.chdir(rruff_dir)
+    (rruff_dir / "rruff" / "dif" / "R001.txt").write_text(
+        DIF_TEXT.replace("1.541838", "0.710730"))
+    assert pdif.main(["rruff", "-i", "10", "-o", "230"]) == 0
+    assert not os.path.exists("samples/R001.txt")
+    assert "wavelength of 0.710730! SKIP" in capsys.readouterr().err
+
+
+def test_pdif_no_peaks_rejected(rruff_dir, monkeypatch, capsys):
+    monkeypatch.chdir(rruff_dir)
+    (rruff_dir / "rruff" / "dif" / "R001.txt").write_text(
+        DIF_TEXT.split("        2-THETA")[0])
+    assert pdif.main(["rruff", "-i", "10", "-o", "230"]) == 0
+    assert not os.path.exists("samples/R001.txt")
