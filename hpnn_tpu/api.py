@@ -94,9 +94,11 @@ def configure(path: str) -> NNDef | None:
         if conf.type == NN_TYPE_UKN:
             nn_error("no kernel type given!\n")
             return None
+        # ann_generate leaves the kernel name NULL (libhpnn.c:969-971 never
+        # copies the conf name), so the dump prints glibc's "(null)"
         kernel, eff_seed = generate_kernel(
             conf.seed, conf.n_inputs, conf.hiddens, conf.n_outputs,
-            name=conf.name or "noname")
+            name="(null)")
         # ann_generate writes the time()-derived seed back into the conf
         # (libhpnn.c:970 passes &_CONF.seed) so the training shuffle and
         # any conf dump reuse the SAME seed
@@ -270,7 +272,7 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     from .parallel.mesh import replicated as replicated_sharding
 
     conf = nn.conf
-    lr = ops.BPM_LEARN_RATE if momentum else ops.bp_learn_rate(kind)
+    lr = ops.bpm_learn_rate(kind) if momentum else ops.bp_learn_rate(kind)
     s = xs.shape[0]
     bsz = min(conf.batch, s)
     n_batches = max(1, s // bsz)

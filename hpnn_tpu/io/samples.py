@@ -11,12 +11,10 @@ A sample file is text (``_NN(read,sample)``,
 The reference reads all N values from the single line following the header
 (libhpnn.c:1102-1111); we additionally accept values spanning several lines
 (documented deviation -- strictly more permissive, every reference-valid file
-parses identically).  Directory listing skips dotfiles (``libhpnn.c:1194-1198``) and preserves the
-OS readdir order, which the seeded shuffle then permutes -- for reproducible
-runs across filesystems we sort the listing first and document the deviation
-(readdir order is inode-dependent and not reproducible even for the reference
-itself across machines; the shuffle seed only fixes the permutation applied on
-top of it).
+parses identically).  Directory listing skips dotfiles (``libhpnn.c:1194-1198``)
+and preserves the OS readdir order, exactly like the reference -- required for
+the end-to-end training parity proven in tests/test_reference_parity.py (see
+list_sample_dir's docstring).
 """
 
 from __future__ import annotations
@@ -90,16 +88,23 @@ def _read_vector(lines, i, key, path, what):
 
 
 def list_sample_dir(dirpath: str) -> list[str] | None:
-    """File names (not paths) in dirpath, dotfiles skipped, sorted.
+    """File names (not paths) in dirpath, dotfiles skipped, READDIR order.
 
-    The reference walks readdir order (libhpnn.c:1190-1214); we sort for
-    cross-machine determinism (see module docstring).
+    The reference walks readdir order (libhpnn.c:1190-1214) and applies the
+    seeded shuffle on top of it; os.listdir returns the same readdir order,
+    so keeping it unsorted makes the shuffled sequence -- and therefore the
+    whole training trajectory -- identical to the reference's on the same
+    filesystem (verified against the compiled reference in
+    tests/test_reference_parity.py).  Note readdir order is filesystem-
+    dependent, so runs are reproducible per-machine, exactly like the
+    reference.
     """
     try:
         names = os.listdir(dirpath)
     except OSError:
         return None
-    return sorted(n for n in names if not n.startswith(".") and os.path.isfile(os.path.join(dirpath, n)))
+    return [n for n in names if not n.startswith(".")
+            and os.path.isfile(os.path.join(dirpath, n))]
 
 
 # NOTE: bulk loading in shuffle order lives in hpnn_tpu.api._load_ordered,

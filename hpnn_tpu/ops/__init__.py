@@ -15,6 +15,7 @@ from .steps import (
     SNN_LEARN_RATE,
     batched_forward,
     bp_learn_rate,
+    bpm_learn_rate,
     deltas,
     error,
     forward,
@@ -29,6 +30,7 @@ __all__ = [
     "BP_LEARN_RATE", "SNN_LEARN_RATE", "BPM_LEARN_RATE",
     "DELTA_BP", "DELTA_BPM",
     "MIN_BP_ITER", "MAX_BP_ITER", "MIN_BPM_ITER", "MAX_BPM_ITER",
-    "batched_forward", "bp_learn_rate", "deltas", "error", "forward",
+    "batched_forward", "bp_learn_rate", "bpm_learn_rate", "deltas",
+    "error", "forward",
     "train_step", "train_step_momentum",
 ]

@@ -58,8 +58,8 @@ from .steps import (
     MAX_BPM_ITER,
     MIN_BP_ITER,
     MIN_BPM_ITER,
-    BPM_LEARN_RATE,
     bp_learn_rate,
+    bpm_learn_rate,
     error,
     forward,
     train_step,
@@ -95,7 +95,7 @@ def train_sample(weights, x, t, kind: str, momentum: bool,
     delta<=0 selects the reference default (ann.c:2323).
     """
     if lr is None:
-        lr = BPM_LEARN_RATE if momentum else bp_learn_rate(kind)
+        lr = bpm_learn_rate(kind) if momentum else bp_learn_rate(kind)
     if momentum:
         min_iter, max_iter = MIN_BPM_ITER, MAX_BPM_ITER
         if delta <= 0.0:

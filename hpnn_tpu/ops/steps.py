@@ -55,6 +55,14 @@ def bp_learn_rate(kind: str) -> float:
     return SNN_LEARN_RATE if kind == SNN else BP_LEARN_RATE
 
 
+def bpm_learn_rate(kind: str) -> float:
+    """SNN's momentum update feeds dw with LEARN_RATE=0.01 (the dger at
+    ``snn.c:1117-1135`` uses LEARN_RATE, not BPM_LEARN_RATE); ANN BPM uses
+    BPM_LEARN_RATE=0.0005 (``ann.c:1996``).  Verified end-to-end against
+    the compiled reference in tests/test_reference_parity.py."""
+    return SNN_LEARN_RATE if kind == SNN else BPM_LEARN_RATE
+
+
 def forward(weights, x, kind: str):
     """All layer activations for one sample; acts[-1] is the output vector.
 

@@ -30,16 +30,34 @@ import struct
 import sys
 
 
-def _read_idx_labels(path: str) -> list[int]:
-    with open(path, "rb") as fp:
-        magic, size = struct.unpack(">II", fp.read(8))
+def _read_idx_labels(path: str) -> tuple[int, list[int]]:
+    try:
+        fp = open(path, "rb")
+    except OSError:
+        sys.stderr.write(f"FAILED to open label file {path} for READ!\n")
+        raise SystemExit(-1)
+    with fp:
+        try:
+            magic, size = struct.unpack(">II", fp.read(8))
+        except struct.error:
+            sys.stderr.write(f"READ FAIL: {path}\n")
+            raise SystemExit(-1)
         data = fp.read(size)
     return magic, list(data)
 
 
-def _read_idx_images(path: str):
-    with open(path, "rb") as fp:
-        magic, size, rows, cols = struct.unpack(">IIII", fp.read(16))
+def _read_idx_images(path: str) -> tuple[int, list[bytes], int]:
+    try:
+        fp = open(path, "rb")
+    except OSError:
+        sys.stderr.write(f"FAILED to open image file {path} for READ!\n")
+        raise SystemExit(-1)
+    with fp:
+        try:
+            magic, size, rows, cols = struct.unpack(">IIII", fp.read(16))
+        except struct.error:
+            sys.stderr.write(f"READ FAIL: {path}\n")
+            raise SystemExit(-1)
         npx = rows * cols
         images = [fp.read(npx) for _ in range(size)]
     return magic, images, npx
@@ -79,7 +97,13 @@ def convert_set(label_path: str, image_path: str, out_dir: str,
         if label > 9:
             sys.stderr.write("ERROR: label out of boundaries!\n")
             continue
-        with open(os.path.join(out_dir, f"s{index:05d}.txt"), "w") as fp:
+        name = os.path.join(out_dir, f"s{index:05d}.txt")
+        try:
+            fp = open(name, "w")
+        except OSError:
+            sys.stderr.write(f"FAILED to open sample {name} for WRITE!\n")
+            raise SystemExit(-1)
+        with fp:
             write_sample(fp, img, label)
     return index
 

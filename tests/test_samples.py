@@ -43,6 +43,7 @@ def test_list_dir_skips_dotfiles(tmp_path):
     (tmp_path / ".hidden").write_text("x")
     (tmp_path / "b").write_text("x")
     (tmp_path / "a").write_text("x")
-    assert list_sample_dir(str(tmp_path)) == ["a", "b"]
+    # readdir order preserved (reference parity), dotfiles dropped
+    assert sorted(list_sample_dir(str(tmp_path))) == ["a", "b"]
 
 
