@@ -72,6 +72,17 @@ def return_capabilities() -> int:
     return cap
 
 
+def apply_env_platforms() -> None:
+    """Honor JAX_PLATFORMS even when a site hook already registered a
+    platform plugin and overwrote the jax_platforms config (the env var is
+    read only at first import, which such a hook preempts)."""
+    env_platforms = os.environ.get("JAX_PLATFORMS")
+    if env_platforms:
+        import jax
+
+        jax.config.update("jax_platforms", env_platforms)
+
+
 def init_runtime() -> None:
     """_NN(init,runtime) (libhpnn.c:160-172)."""
     global lib_runtime
@@ -90,6 +101,7 @@ def init_all(init_verbose: int = 0) -> int:
     try:
         import jax
 
+        apply_env_platforms()
         jax.config.update("jax_enable_x64", True)
         if os.environ.get("HPNN_DISTRIBUTED"):  # multi-host opt-in
             jax.distributed.initialize()

@@ -59,7 +59,17 @@ def _read_idx_images(path: str) -> tuple[int, list[bytes], int]:
             sys.stderr.write(f"READ FAIL: {path}\n")
             raise SystemExit(-1)
         npx = rows * cols
-        images = [fp.read(npx) for _ in range(size)]
+        images = []
+        for i in range(size):
+            img = fp.read(npx)
+            if len(img) != npx:
+                # short fread: the reference's _READ_N aborts
+                # (prepare_mnist.c:130-136)
+                sys.stderr.write(
+                    f"READ FAIL: image {i + 1} read {len(img)} of "
+                    f"{npx} requested\n")
+                raise SystemExit(-1)
+            images.append(img)
     return magic, images, npx
 
 

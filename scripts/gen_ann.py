@@ -1,0 +1,46 @@
+#!/usr/bin/env python3
+"""gen_ann: author a random kernel file offline.
+
+Rebuild of ``/root/reference/scripts/gen_ann.bash`` (pure bash/awk there):
+writes a ``[name]/[param]/[input]/[hidden i]/[neuron j]`` text kernel with
+weights uniform in +-1/sqrt(M), the reference's init scaling
+(``ann.c:674-677``).  The reference draws from /dev/urandom, so there is no
+stream-parity requirement -- only format compatibility (the output loads in
+both implementations).
+
+usage: gen_ann.py [-s seed] n_inputs hidden1 [hidden2 ...] n_outputs > file
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
+import numpy as np
+
+from hpnn_tpu.io.kernel_io import dump_kernel
+from hpnn_tpu.models.kernel import Kernel
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("-s", "--seed", type=int, default=None)
+    ap.add_argument("-n", "--name", default="gen_ann")
+    ap.add_argument("dims", type=int, nargs="+",
+                    help="n_inputs hidden... n_outputs (>= 3 values)")
+    args = ap.parse_args(argv)
+    if len(args.dims) < 3:
+        ap.error("need at least n_inputs, one hidden, and n_outputs")
+    rng = np.random.default_rng(args.seed)
+    weights = [
+        (2.0 * (rng.random((n, m)) - 0.5)) / np.sqrt(m)
+        for m, n in zip(args.dims[:-1], args.dims[1:])
+    ]
+    dump_kernel(Kernel(name=args.name, weights=weights), sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

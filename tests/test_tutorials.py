@@ -1,0 +1,49 @@
+"""Tutorial-script smoke tests (bash level, mini corpora)."""
+
+import os
+import struct
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write_idx(path, stem, images, labels, rows=28, cols=28):
+    with open(os.path.join(path, f"{stem}_labels"), "wb") as fp:
+        fp.write(struct.pack(">II", 0x801, len(labels)))
+        fp.write(bytes(labels))
+    with open(os.path.join(path, f"{stem}_images"), "wb") as fp:
+        fp.write(struct.pack(">IIII", 0x803, len(images), rows, cols))
+        for img in images:
+            fp.write(bytes(img))
+
+
+def test_mnist_tutorial_mini(tmp_path):
+    rng = np.random.default_rng(31)
+
+    def img(cls):
+        px = np.zeros(784, dtype=np.uint8)
+        px[cls * 60:cls * 60 + 60] = 250
+        px[rng.integers(0, 784)] = rng.integers(0, 256)
+        return px.tobytes()
+
+    tl = [i % 3 for i in range(6)]
+    _write_idx(tmp_path, "train", [img(c) for c in tl], tl)
+    _write_idx(tmp_path, "test", [img(c) for c in tl], tl)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", ROUNDS="1")
+    out = subprocess.run(
+        ["bash", os.path.join(REPO, "tutorials", "mnist", "tutorial.bash")],
+        cwd=tmp_path, env=env, capture_output=True, text=True, timeout=500)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "All DONE!" in out.stdout
+    # the scraped metrics lines must carry numbers
+    lines = [l for l in out.stdout.splitlines() if l.startswith("ITER[")]
+    assert len(lines) == 2
+    assert "PASS = " in lines[0] and "%" in lines[0]
+    raw = (tmp_path / "mnist" / "raw").read_text().splitlines()
+    assert len(raw) == 2
+    # a separable mini corpus must reach high accuracy after round 1
+    final_pass = float(raw[-1].split()[1])
+    assert final_pass >= 80.0

@@ -1,0 +1,52 @@
+#!/bin/bash
+# RRUFF XRD tutorial -- rebuild of /root/reference/tutorials/ann/tutorial.bash
+# Converts the RRUFF powder-XRD corpus with pdif (-i 850 -o 230), then trains
+# an 851-230-230 ANN with BPM (alpha=0.2) for 1 + 10 rounds, finally testing
+# the trained kernel against its own training set (the reference's self-test,
+# tutorial.bash:158-159).
+#
+# Prereqs: RRUFF data unpacked under ./rruff/{dif,raw}/ (the reference
+# downloads these from rruff.info; this image has no network egress).
+set -u
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+ROUNDS=${ROUNDS:-10}
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+TRAIN="python3 $REPO/apps/train_nn.py"
+RUN="python3 $REPO/apps/run_nn.py"
+PDIF="python3 -m hpnn_tpu.tools.pdif"
+
+if [ ! -d rruff/dif ] || [ ! -d rruff/raw ]; then
+  echo "Missing rruff/{dif,raw} directories with the RRUFF corpus!"
+  exit 1
+fi
+mkdir -p samples
+if [ -z "$(ls samples 2>/dev/null)" ]; then
+  $PDIF rruff -i 850 -o 230 -s samples
+fi
+# tests = copy of samples (reference tutorial.bash:158)
+mkdir -p tests
+cp -n samples/* tests/ 2>/dev/null || true
+
+cat > xrd_ann.conf <<!
+[name] XRD
+[type] ANN
+[init] generate
+[seed] 0
+[input] 851
+[hidden] 230
+[output] 230
+[train] BPM
+[sample_dir] ./samples
+[test_dir] ./tests
+!
+N_TEST=$(ls tests | wc -l)
+eval $TRAIN -v -v -v ./xrd_ann.conf &> log
+sed -e 's/^\[init\].*/[init] kernel.opt/g' xrd_ann.conf > cont_xrd_ann.conf
+for IDX in $(seq 1 $ROUNDS); do
+  eval $TRAIN -v -v -v ./cont_xrd_ann.conf &> log
+  echo "round $IDX done"
+done
+eval $RUN -v -v ./cont_xrd_ann.conf &> results
+NRS=$(grep -c PASS results || true)
+echo "self-test: $NRS / $N_TEST PASS"
+echo "All DONE!"

@@ -1,0 +1,37 @@
+#!/bin/bash
+# SNN variant of the MNIST tutorial -- rebuild of
+# /root/reference/tutorials/mnist/opt_mnist.bash: a 784-300-10 SNN
+# (softmax + cross-entropy) trained with BP for 30 rounds.
+set -u
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+ROUNDS=${ROUNDS:-30}
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+TRAIN="python3 $REPO/apps/train_nn.py"
+RUN="python3 $REPO/apps/run_nn.py"
+
+cd mnist 2>/dev/null || { echo "run tutorial.bash first (prepares mnist/)"; exit 1; }
+cat > mnist_snn.conf <<!
+[name] MNIST
+[type] SNN
+[init] generate
+[seed] 10958
+[input] 784
+[hidden] 300
+[output] 10
+[train] BP
+[sample_dir] ./samples
+[test_dir] ./tests
+!
+N_TEST=$(ls tests | wc -l)
+eval $TRAIN -v -v -v ./mnist_snn.conf &> log
+sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' mnist_snn.conf > cont_mnist_snn.conf
+rm -f raw_snn
+for IDX in $(seq 1 $ROUNDS); do
+  eval $RUN -v -v ./cont_mnist_snn.conf &> results
+  NRS=$(grep -c PASS results || true)
+  XRS=$(awk "BEGIN{printf \"%.1f\", 100*$NRS/$N_TEST}")
+  echo "$IDX $XRS" >> raw_snn
+  echo "ITER[$IDX] PASS = $XRS%"
+  eval $TRAIN -v -v -v ./cont_mnist_snn.conf &> log
+done
+echo "All DONE!"

@@ -1,0 +1,75 @@
+#!/bin/bash
+# MNIST tutorial -- rebuild of /root/reference/tutorials/mnist/tutorial.bash
+# Trains a 784-300-10 ANN with BP on MNIST, 1 first pass + 50 continuation
+# rounds resuming from kernel.opt, tracking PASS% (test accuracy) and OPT%
+# (first-try training accuracy) per round by scraping the stdout grammar
+# exactly like the reference (grep PASS / grep OK).
+#
+# Prereqs: the four MNIST idx files renamed to train_images train_labels
+# test_images test_labels in this directory (see pmnist -h).
+set -u
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+ROUNDS=${ROUNDS:-50}
+TRAIN="python3 $REPO/apps/train_nn.py"
+RUN="python3 $REPO/apps/run_nn.py"
+PMNIST="python3 -m hpnn_tpu.tools.pmnist"
+export PYTHONPATH="$REPO${PYTHONPATH:+:$PYTHONPATH}"
+
+FIRST_TRAIN_ARG="-v -v -v ./mnist_ann.conf"
+TRAIN_ARG="-v -v -v ./cont_mnist_ann.conf"
+RUN_ARG="-v -v ./cont_mnist_ann.conf"
+
+for f in train_images train_labels test_images test_labels; do
+  if [ ! -f "$f" ]; then
+    echo "Missing $f! Rename the MNIST idx files first (see pmnist -h)."
+    exit 1
+  fi
+done
+
+mkdir -p mnist/samples mnist/tests
+cd mnist
+if [ -z "$(ls samples 2>/dev/null)" ]; then
+  echo "preparing MNIST samples"
+  (cd .. && $PMNIST mnist/samples mnist/tests)
+fi
+echo "preparing configuration files"
+cat > mnist_ann.conf <<!
+[name] MNIST
+[type] ANN
+[init] generate
+[seed] 10958
+[input] 784
+[hidden] 300
+[output] 10
+[train] BP
+[sample_dir] ./samples
+[test_dir] ./tests
+!
+N_TRAIN=$(ls samples | wc -l)
+N_TEST=$(ls tests | wc -l)
+rm -f raw log results
+touch raw
+# first pass
+eval $TRAIN $FIRST_TRAIN_ARG &> log
+sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' mnist_ann.conf > cont_mnist_ann.conf
+eval $RUN $RUN_ARG &> results
+NRS=$(grep -c PASS results || true)
+XRS=$(awk "BEGIN{printf \"%.1f\", 100*$NRS/$N_TEST}")
+NOK=$(grep -c " OK" ./log || true)
+XOK=$(awk "BEGIN{printf \"%.1f\", 100*$NOK/$N_TRAIN}")
+echo "0 $XRS $XOK" > raw
+echo "ITER[0] PASS = $XRS% OPT = $XOK%"
+ITER=1
+for IDX in $(seq 1 $ROUNDS); do
+  sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' mnist_ann.conf > cont_mnist_ann.conf
+  eval $TRAIN $TRAIN_ARG &> log
+  eval $RUN $RUN_ARG &> results
+  NRS=$(grep -c PASS results || true)
+  XRS=$(awk "BEGIN{printf \"%.1f\", 100*$NRS/$N_TEST}")
+  NOK=$(grep -c " OK" ./log || true)
+  XOK=$(awk "BEGIN{printf \"%.1f\", 100*$NOK/$N_TRAIN}")
+  echo "$ITER $XRS $XOK" >> raw
+  echo "ITER[$ITER] PASS = $XRS% OPT = $XOK%"
+  (( ITER += 1 ))
+done
+echo "All DONE!"
