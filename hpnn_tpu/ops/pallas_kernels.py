@@ -1,0 +1,170 @@
+"""Pallas TPU kernels: fused layer forward and fused momentum update.
+
+TPU-native equivalents of the reference's two fused CUDA kernels:
+
+* ``fw_mv_acc`` (``/root/reference/src/cuda_ann.cu:77-86``): one thread per
+  output row, dot product over the inputs with the sigmoid fused in.  Here:
+  a tiled matmul on the MXU whose epilogue applies ``ann_act`` on the last
+  reduction tile, so activations never round-trip through HBM
+  (`fused_linear_act`).
+* ``ger_dw_acc`` (``/root/reference/src/cuda_ann.cu:134-148``): the fused
+  BPM triple dw += lr*outer(delta, h); W += dw; dw *= alpha in one pass.
+  Here: one Pallas kernel writing both W and dw in place via
+  input_output_aliases, reading each operand from HBM exactly once
+  (`fused_bpm_update`) -- the XLA version materializes the outer product
+  and streams W/dw three times.
+
+These kernels are the throughput path (fp32/bf16); the fp64 parity path
+stays on plain XLA (ops.steps).  Numerical identity with the XLA path is
+asserted in tests/test_pallas.py (interpret mode on CPU, compiled on TPU).
+
+Tiling: TILE_N x TILE_M blocks aligned to the fp32 (8, 128) VMEM tile; the
+grid's last dimension is the reduction axis, which Pallas executes
+sequentially per output block, so the accumulator lives in the output ref
+(zeroed on the first tile, activated on the last).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from .activations import ann_act
+
+_INTERPRET = False  # flipped by tests on CPU
+
+
+def _interpret() -> bool:
+    return _INTERPRET or jax.default_backend() == "cpu"
+
+
+def _pad_to(x, mult, axis):
+    pad = (-x.shape[axis]) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _fused_linear_act_kernel(x_ref, w_ref, o_ref, *, n_red, act):
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    o_ref[:] += jax.lax.dot_general(
+        x_ref[:], w_ref[:],
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=o_ref.dtype,
+    )
+
+    if act:
+        @pl.when(j == n_red - 1)
+        def _():
+            o_ref[:] = jnp.tanh(o_ref[:] * 0.5)
+
+
+def fused_linear_act(w, xs, act: bool = True, tile_b: int = 256,
+                     tile_n: int = 256, tile_m: int = 512):
+    """act(xs @ w.T) with the activation fused into the matmul epilogue.
+
+    w (N, M), xs (B, M) -> (B, N).  The fw_mv_acc analog, batched: the
+    reference fuses sigmoid into its GEMV (cuda_ann.cu:77-86); on TPU the
+    same fusion rides the MXU tiles.  ``act=False`` gives the plain tiled
+    matmul (used by the SNN head, whose softmax needs the full row).
+    All three dimensions are tiled (the batch too -- a whole-corpus eval
+    batch would otherwise exceed the ~16 MB VMEM per core).
+    """
+    n, m = w.shape
+    b = xs.shape[0]
+    tile_b = min(tile_b, max(8, b))
+    tile_n = min(tile_n, max(8, n))
+    tile_m = min(tile_m, max(128, m))
+    wp = _pad_to(_pad_to(w, tile_n, 0), tile_m, 1)
+    xp = _pad_to(_pad_to(xs, tile_b, 0), tile_m, 1)
+    np_, mp = wp.shape
+    bp = xp.shape[0]
+    grid = (bp // tile_b, np_ // tile_n, mp // tile_m)
+    out = pl.pallas_call(
+        functools.partial(_fused_linear_act_kernel, n_red=grid[2], act=act),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_b, tile_m), lambda bi, i, j: (bi, j)),
+            pl.BlockSpec((tile_n, tile_m), lambda bi, i, j: (i, j)),
+        ],
+        out_specs=pl.BlockSpec((tile_b, tile_n), lambda bi, i, j: (bi, i)),
+        out_shape=jax.ShapeDtypeStruct((bp, np_), xs.dtype),
+        interpret=_interpret(),
+    )(xp, wp)
+    return out[:b, :n]
+
+
+def _fused_bpm_kernel(d_ref, h_ref, w_ref, dw_ref, w_out, dw_out, *,
+                      lr, alpha):
+    step = dw_ref[:] + lr * d_ref[:] * h_ref[:]
+    w_out[:] = w_ref[:] + step
+    dw_out[:] = alpha * step
+
+
+def fused_bpm_update(w, dw, d, h, lr, alpha,
+                     tile_n: int = 256, tile_m: int = 512):
+    """One-pass BPM weight update (ger_dw_acc analog, cuda_ann.cu:134-148).
+
+    w, dw (N, M); d (N,) delta; h (M,) layer input.  Returns (w', dw')
+    with the reference's order: the fresh step enters W unscaled, alpha
+    discounts only the history (ann.c:1996-1999).
+    """
+    n, m = w.shape
+    tile_n = min(tile_n, max(8, n))
+    tile_m = min(tile_m, max(128, m))
+    wp = _pad_to(_pad_to(w, tile_n, 0), tile_m, 1)
+    dwp = _pad_to(_pad_to(dw, tile_n, 0), tile_m, 1)
+    dp = _pad_to(d.reshape(-1, 1), tile_n, 0)
+    hp = _pad_to(h.reshape(1, -1), tile_m, 1)
+    np_, mp = wp.shape
+    grid = (np_ // tile_n, mp // tile_m)
+    w2, dw2 = pl.pallas_call(
+        functools.partial(_fused_bpm_kernel, lr=lr, alpha=alpha),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((tile_n, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((1, tile_m), lambda i, j: (0, j)),
+            pl.BlockSpec((tile_n, tile_m), lambda i, j: (i, j)),
+            pl.BlockSpec((tile_n, tile_m), lambda i, j: (i, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_n, tile_m), lambda i, j: (i, j)),
+            pl.BlockSpec((tile_n, tile_m), lambda i, j: (i, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((np_, mp), w.dtype),
+            jax.ShapeDtypeStruct((np_, mp), dw.dtype),
+        ],
+        input_output_aliases={2: 0, 3: 1},
+        interpret=_interpret(),
+    )(dp, hp, wp, dwp)
+    return w2[:n, :m], dw2[:n, :m]
+
+
+def batched_forward_pallas(weights, xs, kind: str):
+    """Whole-net batched forward on the fused kernels (throughput path).
+
+    Hidden layers fuse act into the matmul; the SNN output head computes
+    the softmax(x-1) on the un-activated final matmul.  Matches
+    ops.steps.batched_forward to fp32 accuracy (asserted in tests).
+    """
+    from .activations import snn_softmax
+
+    v = xs
+    last = len(weights) - 1
+    for i, w in enumerate(weights):
+        if kind == "SNN" and i == last:
+            v = snn_softmax(fused_linear_act(w, v, act=False))
+        else:
+            v = fused_linear_act(w, v, act=True)
+    return v

@@ -1,0 +1,95 @@
+"""Pallas fused-kernel parity vs the plain XLA path (fp32).
+
+Runs in interpret mode on the CPU test backend; the same code compiles on
+TPU (the bench exercises it there).  Includes the 8x4096 MLP stress shape
+from BASELINE.json config 4 at reduced batch."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hpnn_tpu.ops import batched_forward, steps
+from hpnn_tpu.ops.pallas_kernels import (
+    batched_forward_pallas,
+    fused_bpm_update,
+    fused_linear_act,
+)
+
+RNG = np.random.default_rng(77)
+
+
+def _w(n, m):
+    return jnp.asarray(
+        RNG.uniform(-1, 1, (n, m)) / np.sqrt(m), dtype=jnp.float32)
+
+
+def test_fused_linear_act_matches_xla():
+    w = _w(300, 784)
+    xs = jnp.asarray(RNG.uniform(0, 255, (32, 784)), dtype=jnp.float32)
+    got = np.asarray(fused_linear_act(w, xs))
+    want = np.asarray(jnp.tanh((xs @ w.T) * 0.5))
+    # pre-activations are O(100) at MNIST pixel scale: fp32 reduction-order
+    # differences reach ~1e-4, worth ~5e-5 after tanh where it is not
+    # saturated
+    np.testing.assert_allclose(got, want, atol=1e-4)
+
+
+def test_fused_linear_no_act():
+    w = _w(10, 300)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (8, 300)), dtype=jnp.float32)
+    got = np.asarray(fused_linear_act(w, xs, act=False))
+    np.testing.assert_allclose(got, np.asarray(xs @ w.T), atol=2e-5)
+
+
+def test_fused_linear_unaligned_shapes():
+    """Row/col counts that don't divide the tiles (padding path)."""
+    w = _w(13, 37)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (5, 37)), dtype=jnp.float32)
+    got = np.asarray(fused_linear_act(w, xs))
+    want = np.asarray(jnp.tanh((xs @ w.T) * 0.5))
+    np.testing.assert_allclose(got, want, atol=2e-6)
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_batched_forward_pallas_matches(kind):
+    ws = tuple(_w(n, m) for m, n in [(19, 16), (16, 8), (8, 5)])
+    xs = jnp.asarray(RNG.uniform(-1, 1, (6, 19)), dtype=jnp.float32)
+    got = np.asarray(batched_forward_pallas(ws, xs, kind))
+    want = np.asarray(batched_forward(ws, xs, kind))
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_fused_bpm_update_matches_reference_order():
+    """dw += lr*outer; W += dw; dw *= alpha (ann.c:1996-1999)."""
+    n, m = 23, 41
+    w = _w(n, m)
+    dw = jnp.asarray(RNG.uniform(-0.01, 0.01, (n, m)), dtype=jnp.float32)
+    d = jnp.asarray(RNG.uniform(-1, 1, n), dtype=jnp.float32)
+    h = jnp.asarray(RNG.uniform(-1, 1, m), dtype=jnp.float32)
+    lr, alpha = 0.0005, 0.2
+    w2, dw2 = fused_bpm_update(w, dw, d, h, lr, alpha)
+    step = np.asarray(dw) + lr * np.outer(np.asarray(d), np.asarray(h))
+    np.testing.assert_allclose(np.asarray(w2), np.asarray(w) + step,
+                               atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dw2), alpha * step, atol=1e-6)
+
+
+def test_stress_8x4096_shape():
+    """BASELINE.json config 4: deep/wide MLP tiling (reduced batch here)."""
+    dims = [512] + [4096] * 3 + [512]  # 3 hidden of the 8 (CPU test time)
+    ws = tuple(_w(n, m) for m, n in zip(dims[:-1], dims[1:]))
+    xs = jnp.asarray(RNG.uniform(-1, 1, (4, 512)), dtype=jnp.float32)
+    got = np.asarray(batched_forward_pallas(ws, xs, "ANN"))
+    want = np.asarray(batched_forward(ws, xs, "ANN"))
+    assert got.shape == (4, 512)
+    np.testing.assert_allclose(got, want, atol=3e-5)
+
+
+def test_fused_linear_batch_tiling():
+    """Batch larger than one tile (VMEM-safe batched eval)."""
+    w = _w(64, 96)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (700, 96)), dtype=jnp.float32)
+    got = np.asarray(fused_linear_act(w, xs, tile_b=256))
+    want = np.asarray(jnp.tanh((xs @ w.T) * 0.5))
+    np.testing.assert_allclose(got, want, atol=1e-5)

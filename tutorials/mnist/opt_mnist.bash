@@ -27,11 +27,11 @@ eval $TRAIN -v -v -v ./mnist_snn.conf &> log
 sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' mnist_snn.conf > cont_mnist_snn.conf
 rm -f raw_snn
 for IDX in $(seq 1 $ROUNDS); do
+  eval $TRAIN -v -v -v ./cont_mnist_snn.conf &> log
   eval $RUN -v -v ./cont_mnist_snn.conf &> results
   NRS=$(grep -c PASS results || true)
   XRS=$(awk "BEGIN{printf \"%.1f\", 100*$NRS/$N_TEST}")
   echo "$IDX $XRS" >> raw_snn
   echo "ITER[$IDX] PASS = $XRS%"
-  eval $TRAIN -v -v -v ./cont_mnist_snn.conf &> log
 done
 echo "All DONE!"

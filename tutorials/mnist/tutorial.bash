@@ -59,17 +59,14 @@ NOK=$(grep -c " OK" ./log || true)
 XOK=$(awk "BEGIN{printf \"%.1f\", 100*$NOK/$N_TRAIN}")
 echo "0 $XRS $XOK" > raw
 echo "ITER[0] PASS = $XRS% OPT = $XOK%"
-ITER=1
 for IDX in $(seq 1 $ROUNDS); do
-  sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' mnist_ann.conf > cont_mnist_ann.conf
   eval $TRAIN $TRAIN_ARG &> log
   eval $RUN $RUN_ARG &> results
   NRS=$(grep -c PASS results || true)
   XRS=$(awk "BEGIN{printf \"%.1f\", 100*$NRS/$N_TEST}")
   NOK=$(grep -c " OK" ./log || true)
   XOK=$(awk "BEGIN{printf \"%.1f\", 100*$NOK/$N_TRAIN}")
-  echo "$ITER $XRS $XOK" >> raw
-  echo "ITER[$ITER] PASS = $XRS% OPT = $XOK%"
-  (( ITER += 1 ))
+  echo "$IDX $XRS $XOK" >> raw
+  echo "ITER[$IDX] PASS = $XRS% OPT = $XOK%"
 done
 echo "All DONE!"
