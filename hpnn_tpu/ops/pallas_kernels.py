@@ -34,11 +34,9 @@ from jax.experimental import pallas as pl
 
 from .activations import ann_act
 
-_INTERPRET = False  # flipped by tests on CPU
-
-
 def _interpret() -> bool:
-    return _INTERPRET or jax.default_backend() == "cpu"
+    """Interpret mode off-TPU (the CPU test backend has no Mosaic)."""
+    return jax.default_backend() == "cpu"
 
 
 def _pad_to(x, mult, axis):
@@ -66,7 +64,7 @@ def _fused_linear_act_kernel(x_ref, w_ref, o_ref, *, n_red, act):
     if act:
         @pl.when(j == n_red - 1)
         def _():
-            o_ref[:] = jnp.tanh(o_ref[:] * 0.5)
+            o_ref[:] = ann_act(o_ref[:])
 
 
 def fused_linear_act(w, xs, act: bool = True, tile_b: int = 256,
@@ -90,6 +88,10 @@ def fused_linear_act(w, xs, act: bool = True, tile_b: int = 256,
     np_, mp = wp.shape
     bp = xp.shape[0]
     grid = (bp // tile_b, np_ // tile_n, mp // tile_m)
+    # accumulate cross-tile partial sums in fp32 even for bf16 operands
+    # (bf16 running sums over a wide reduction lose the mantissa; XLA's
+    # own bf16 matmuls accumulate fp32 too), cast back at the end
+    acc_dtype = jnp.float32 if xs.dtype == jnp.bfloat16 else xs.dtype
     out = pl.pallas_call(
         functools.partial(_fused_linear_act_kernel, n_red=grid[2], act=act),
         grid=grid,
@@ -98,10 +100,10 @@ def fused_linear_act(w, xs, act: bool = True, tile_b: int = 256,
             pl.BlockSpec((tile_n, tile_m), lambda bi, i, j: (i, j)),
         ],
         out_specs=pl.BlockSpec((tile_b, tile_n), lambda bi, i, j: (bi, i)),
-        out_shape=jax.ShapeDtypeStruct((bp, np_), xs.dtype),
+        out_shape=jax.ShapeDtypeStruct((bp, np_), acc_dtype),
         interpret=_interpret(),
     )(xp, wp)
-    return out[:b, :n]
+    return out[:b, :n].astype(xs.dtype)
 
 
 def _fused_bpm_kernel(d_ref, h_ref, w_ref, dw_ref, w_out, dw_out, *,

@@ -1,0 +1,255 @@
+/* hpnn_shim.c -- serves the libhpnn_tpu.h C API from the Python package.
+ *
+ * The reference's native layer is ~16 kLoC of C/CUDA compute; here the
+ * compute lives in XLA, so the native layer's job is dispatch: an embedded
+ * CPython interpreter loads hpnn_tpu and forwards each _NN call.  This is
+ * the "thin shim" of the north star -- C programs keep the reference's
+ * call sequence (init -> load_conf -> dump kernel.tmp -> train -> dump
+ * kernel.opt) and file formats, while forward/backward/update run on TPU.
+ *
+ * Thread-safety: calls must come from one thread (the reference's library
+ * is equally single-threaded at the API level, holding one global
+ * lib_runtime singleton, libhpnn.c:60).
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <unistd.h>
+
+#include "libhpnn_tpu.h"
+
+#ifndef HPNN_PYROOT
+#define HPNN_PYROOT "/root/repo"
+#endif
+
+struct nn_def_ {
+    PyObject *obj; /* hpnn_tpu.api.NNDef */
+};
+
+static PyObject *mod_api = NULL;      /* hpnn_tpu.api */
+static PyObject *mod_runtime = NULL;  /* hpnn_tpu.runtime */
+static PyObject *mod_log = NULL;      /* hpnn_tpu.utils.nn_log */
+
+static int ensure_python(void)
+{
+    const char *root;
+    PyObject *sys_path, *p;
+    if (mod_api != NULL) return 0;
+    if (!Py_IsInitialized()) Py_InitializeEx(0);
+    root = getenv("HPNN_PYROOT");
+    if (root == NULL) root = HPNN_PYROOT;
+    sys_path = PySys_GetObject("path"); /* borrowed */
+    if (sys_path != NULL) {
+        p = PyUnicode_FromString(root);
+        if (p != NULL) {
+            PyList_Insert(sys_path, 0, p);
+            Py_DECREF(p);
+        }
+    }
+    mod_api = PyImport_ImportModule("hpnn_tpu.api");
+    mod_runtime = PyImport_ImportModule("hpnn_tpu.runtime");
+    mod_log = PyImport_ImportModule("hpnn_tpu.utils.nn_log");
+    if (mod_api == NULL || mod_runtime == NULL || mod_log == NULL) {
+        PyErr_Print();
+        fprintf(stderr, "libhpnn_tpu: failed to import hpnn_tpu from %s\n",
+                root);
+        Py_CLEAR(mod_api);
+        Py_CLEAR(mod_runtime);
+        Py_CLEAR(mod_log);
+        return -1;
+    }
+    return 0;
+}
+
+/* call mod.fn(args); returns new ref or NULL (error printed) */
+static PyObject *call(PyObject *mod, const char *fn, PyObject *args)
+{
+    PyObject *f, *r = NULL;
+    f = PyObject_GetAttrString(mod, fn);
+    if (f != NULL) {
+        r = PyObject_CallObject(f, args);
+        Py_DECREF(f);
+    }
+    if (r == NULL) PyErr_Print();
+    Py_XDECREF(args);
+    return r;
+}
+
+static long call_long(PyObject *mod, const char *fn, PyObject *args,
+                      long fallback)
+{
+    long v = fallback;
+    PyObject *r = call(mod, fn, args);
+    if (r != NULL) {
+        if (r == Py_None) v = fallback;
+        else if (PyBool_Check(r)) v = (r == Py_True);
+        else v = PyLong_AsLong(r);
+        Py_DECREF(r);
+        if (PyErr_Occurred()) { PyErr_Print(); v = fallback; }
+    }
+    return v;
+}
+
+/* ---- runtime ---------------------------------------------------------- */
+
+int nn_init_all(UINT init_verbose)
+{
+    if (ensure_python() != 0) return -1;
+    return (int)call_long(mod_runtime, "init_all",
+                          Py_BuildValue("(I)", init_verbose), -1);
+}
+
+int nn_deinit_all(void)
+{
+    if (mod_api == NULL) return 0;
+    return (int)call_long(mod_runtime, "deinit_all", NULL, -1);
+}
+
+void nn_inc_verbose(void)
+{
+    if (ensure_python() != 0) return;
+    Py_XDECREF(call(mod_log, "inc_verbosity", NULL));
+}
+
+void nn_dec_verbose(void)
+{
+    if (ensure_python() != 0) return;
+    Py_XDECREF(call(mod_log, "dec_verbosity", NULL));
+}
+
+UINT nn_return_verbose(void)
+{
+    if (ensure_python() != 0) return 0;
+    return (UINT)call_long(mod_log, "get_verbosity", NULL, 0);
+}
+
+void nn_toggle_dry(void)
+{
+    if (ensure_python() != 0) return;
+    Py_XDECREF(call(mod_runtime, "toggle_dry", NULL));
+}
+
+BOOL nn_set_omp_threads(UINT n)
+{
+    if (ensure_python() != 0) return 0;
+    return (BOOL)call_long(mod_runtime, "set_omp_threads",
+                           Py_BuildValue("(I)", n), 0);
+}
+
+BOOL nn_set_omp_blas(UINT n)
+{
+    if (ensure_python() != 0) return 0;
+    return (BOOL)call_long(mod_runtime, "set_omp_blas",
+                           Py_BuildValue("(I)", n), 0);
+}
+
+BOOL nn_set_cuda_streams(UINT n)
+{
+    if (ensure_python() != 0) return 0;
+    return (BOOL)call_long(mod_runtime, "set_cuda_streams",
+                           Py_BuildValue("(I)", n), 0);
+}
+
+UINT nn_get_mpi_tasks(void)
+{
+    if (ensure_python() != 0) return 1;
+    return (UINT)call_long(mod_runtime, "get_mpi_tasks", NULL, 1);
+}
+
+UINT nn_get_curr_mpi_task(void)
+{
+    if (ensure_python() != 0) return 0;
+    return (UINT)call_long(mod_runtime, "get_curr_mpi_task", NULL, 0);
+}
+
+/* ---- conf / kernel ---------------------------------------------------- */
+
+nn_def *nn_load_conf(const char *filename)
+{
+    PyObject *r;
+    nn_def *h;
+    if (ensure_python() != 0) return NULL;
+    r = call(mod_api, "configure", Py_BuildValue("(s)", filename));
+    if (r == NULL || r == Py_None) {
+        Py_XDECREF(r);
+        return NULL;
+    }
+    h = (nn_def *)malloc(sizeof(*h));
+    if (h == NULL) { Py_DECREF(r); return NULL; }
+    h->obj = r;
+    return h;
+}
+
+void nn_free_conf(nn_def *neural)
+{
+    if (neural == NULL) return;
+    Py_XDECREF(neural->obj);
+    free(neural);
+}
+
+BOOL nn_dump_kernel(nn_def *neural, FILE *out)
+{
+    PyObject *os_mod, *pyf, *r;
+    int fd;
+    BOOL ok = 0;
+    if (neural == NULL || out == NULL) return 0;
+    if (ensure_python() != 0) return 0;
+    fflush(out);
+    fd = dup(fileno(out));
+    if (fd < 0) return 0;
+    os_mod = PyImport_ImportModule("os");
+    if (os_mod == NULL) { PyErr_Print(); close(fd); return 0; }
+    /* os.fdopen(fd, "w") -- closing it closes only the dup'd fd */
+    pyf = PyObject_CallMethod(os_mod, "fdopen", "is", fd, "w");
+    Py_DECREF(os_mod);
+    if (pyf == NULL) { PyErr_Print(); close(fd); return 0; }
+    r = call(mod_api, "dump_kernel_def",
+             Py_BuildValue("(OO)", neural->obj, pyf));
+    if (r != NULL) {
+        ok = (r == Py_True);
+        Py_DECREF(r);
+    }
+    Py_XDECREF(PyObject_CallMethod(pyf, "close", NULL));
+    Py_DECREF(pyf);
+    return ok;
+}
+
+UINT nn_get_n_inputs(nn_def *neural)
+{
+    PyObject *r;
+    UINT v = 0;
+    if (neural == NULL) return 0;
+    r = PyObject_GetAttrString(neural->obj, "n_inputs");
+    if (r != NULL) { v = (UINT)PyLong_AsLong(r); Py_DECREF(r); }
+    else PyErr_Print();
+    return v;
+}
+
+UINT nn_get_n_outputs(nn_def *neural)
+{
+    PyObject *r;
+    UINT v = 0;
+    if (neural == NULL) return 0;
+    r = PyObject_GetAttrString(neural->obj, "n_outputs");
+    if (r != NULL) { v = (UINT)PyLong_AsLong(r); Py_DECREF(r); }
+    else PyErr_Print();
+    return v;
+}
+
+/* ---- drivers ---------------------------------------------------------- */
+
+BOOL nn_train_kernel(nn_def *neural)
+{
+    if (neural == NULL) return 0;
+    return (BOOL)call_long(mod_api, "train_kernel",
+                           Py_BuildValue("(O)", neural->obj), 0);
+}
+
+void nn_run_kernel(nn_def *neural)
+{
+    if (neural == NULL) return;
+    Py_XDECREF(call(mod_api, "run_kernel",
+                    Py_BuildValue("(O)", neural->obj)));
+}

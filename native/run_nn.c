@@ -1,0 +1,103 @@
+/* run_nn (C) -- inference driver against libhpnn_tpu
+ * (reference: /root/reference/tests/run_nn.c).  Same flags as train_nn
+ * minus -x; evaluates the test directory, printing the PASS/FAIL grammar.
+ */
+#include <ctype.h>
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "libhpnn_tpu.h"
+
+static void dump_help(void)
+{
+    printf("***********************************\n");
+    printf("usage:    run_nn [-options] [input]\n");
+    printf("***********************************\n");
+    printf("options:\n");
+    printf("-h \tdisplay this help;\n");
+    printf("-v \tincrease verbosity;\n");
+    printf("-O \tnumber of host threads (XLA-owned).\n");
+    printf("-B \tnumber of BLAS threads (XLA-owned).\n");
+    printf("-S \tnumber of device shards (XLA-owned).\n");
+    printf("***********************************\n");
+}
+
+static unsigned parse_num(int argc, char *argv[], int *i, int j)
+{
+    const char *s;
+    if (argv[*i][j + 1] != '\0') {
+        s = &argv[*i][j + 1];
+    } else {
+        if (*i + 1 >= argc) return 0;
+        *i += 1;
+        s = argv[*i];
+        while (*s == ' ' || *s == '\t') s++;
+    }
+    if (!isdigit((unsigned char)*s)) return 0;
+    return (unsigned)atoi(s);
+}
+
+int main(int argc, char *argv[])
+{
+    const char *filename = NULL;
+    nn_def *neural;
+    unsigned n;
+    int i, j, done;
+
+    _NN(init,all)(1);
+    for (i = 1; i < argc; i++) {
+        if (argv[i][0] == '-' && argv[i][1] != '\0') {
+            done = 0;
+            for (j = 1; argv[i][j] != '\0' && !done; j++) {
+                switch (argv[i][j]) {
+                case 'h':
+                    dump_help();
+                    _NN(deinit,all)();
+                    return 0;
+                case 'v':
+                    _NN(inc,verbose)();
+                    break;
+                case 'O': case 'B': case 'S': {
+                    char sw = argv[i][j]; /* parse_num may advance i */
+                    n = parse_num(argc, argv, &i, j);
+                    if (n == 0) {
+                        fprintf(stderr,
+                                "syntax error: bad -%c parameter!\n", sw);
+                        dump_help();
+                        _NN(deinit,all)();
+                        return -1;
+                    }
+                    if (sw == 'O') _NN(set,omp_threads)(n);
+                    else if (sw == 'B') _NN(set,omp_blas)(n);
+                    else _NN(set,cuda_streams)(n);
+                    done = 1;
+                    break;
+                }
+                default:
+                    fprintf(stderr, "syntax error: unrecognized option!\n");
+                    dump_help();
+                    _NN(deinit,all)();
+                    return -1;
+                }
+            }
+        } else if (argv[i][0] != '-') {
+            if (filename != NULL) {
+                _NN(deinit,all)();
+                return -1;
+            }
+            filename = argv[i];
+        }
+    }
+    if (filename == NULL) filename = "./nn.conf";
+
+    neural = _NN(load,conf)(filename);
+    if (neural == NULL) {
+        fprintf(stderr, "FAILED to read NN configuration file! (ABORTING)\n");
+        _NN(deinit,all)();
+        return -1;
+    }
+    _NN(run,kernel)(neural);
+    nn_free_conf(neural);
+    _NN(deinit,all)();
+    return 0;
+}

@@ -1,0 +1,77 @@
+"""C-shim tests: the native train_nn/run_nn must match the COMPILED
+reference binaries byte-for-byte on the same corpus (the strongest form of
+the north star's "keep the C-side dispatch unchanged")."""
+
+import os
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+from hpnn_tpu.io.kernel_io import load_kernel
+
+from test_reference_parity import _corpus, _nn_lines, _oracle, _run_ref
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("make") is None
+    or not os.path.isdir("/root/reference"),
+    reason="needs gcc/make and the reference tree")
+
+
+@pytest.fixture(scope="module")
+def native_bins():
+    r = subprocess.run(["make", "-C", NATIVE], capture_output=True,
+                       text=True)
+    if r.returncode != 0:
+        pytest.skip(f"native build failed: {r.stderr[-500:]}")
+    return (os.path.join(NATIVE, "train_nn"),
+            os.path.join(NATIVE, "run_nn"))
+
+
+def _run_c(binary, args, cwd):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", HPNN_PYROOT=REPO)
+    return subprocess.run([binary, *args], cwd=cwd, capture_output=True,
+                          text=True, timeout=600, env=env)
+
+
+def test_c_train_matches_reference(tmp_path, native_bins):
+    c_train, c_run = native_bins
+    _corpus(tmp_path, kind="ANN", train="BP", seed=31337)
+    ref_out = _run_ref(_oracle("train_nn"), ["-v", "-v", "-v", "nn.conf"],
+                       tmp_path)
+    os.rename(tmp_path / "kernel.tmp", tmp_path / "ref_kernel.tmp")
+    os.rename(tmp_path / "kernel.opt", tmp_path / "ref_kernel.opt")
+    mine = _run_c(c_train, ["-v", "-v", "-v", "nn.conf"], tmp_path)
+    assert mine.returncode == 0, mine.stderr[-500:]
+    assert _nn_lines(ref_out, "TRAINING") == _nn_lines(mine.stdout,
+                                                      "TRAINING")
+    assert (tmp_path / "ref_kernel.tmp").read_text() == \
+        (tmp_path / "kernel.tmp").read_text()
+    ref_k = load_kernel(str(tmp_path / "ref_kernel.opt"))
+    my_k = load_kernel(str(tmp_path / "kernel.opt"))
+    for a, b in zip(ref_k.weights, my_k.weights):
+        assert np.abs(a - b).max() < 5e-12
+
+    # evaluation through the C shim
+    (tmp_path / "cont.conf").write_text(
+        (tmp_path / "nn.conf").read_text().replace("[init] generate",
+                                                   "[init] kernel.opt"))
+    ref_run = _run_ref(_oracle("run_nn"), ["-v", "-v", "cont.conf"],
+                       tmp_path)
+    my_run = _run_c(c_run, ["-v", "-v", "cont.conf"], tmp_path)
+    assert _nn_lines(ref_run, "TESTING") == _nn_lines(my_run.stdout,
+                                                      "TESTING")
+
+
+def test_c_help_and_errors(tmp_path, native_bins):
+    c_train, _ = native_bins
+    out = _run_c(c_train, ["-h"], tmp_path)
+    assert out.returncode == 0
+    assert "usage:  train_nn" in out.stdout
+    out = _run_c(c_train, ["missing.conf"], tmp_path)
+    assert out.returncode != 0
+    assert "FAILED to read NN configuration file" in out.stderr

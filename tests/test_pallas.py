@@ -9,7 +9,7 @@ import pytest
 
 import jax.numpy as jnp
 
-from hpnn_tpu.ops import batched_forward, steps
+from hpnn_tpu.ops import batched_forward
 from hpnn_tpu.ops.pallas_kernels import (
     batched_forward_pallas,
     fused_bpm_update,
@@ -93,3 +93,17 @@ def test_fused_linear_batch_tiling():
     got = np.asarray(fused_linear_act(w, xs, tile_b=256))
     want = np.asarray(jnp.tanh((xs @ w.T) * 0.5))
     np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_fused_linear_bf16_fp32_accumulation():
+    """bf16 operands accumulate in fp32 across reduction tiles."""
+    w = jnp.asarray(RNG.uniform(-1, 1, (64, 2048)) / 45,
+                    dtype=jnp.bfloat16)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (16, 2048)), dtype=jnp.bfloat16)
+    got = np.asarray(fused_linear_act(w, xs, tile_m=512),
+                     dtype=np.float32)
+    want = np.tanh(
+        (np.asarray(xs, np.float32) @ np.asarray(w, np.float32).T) * 0.5)
+    # bf16 rounding of inputs dominates; fp32 accumulation keeps the
+    # error at the bf16-quantization level, not reduction-length level
+    np.testing.assert_allclose(got, want, atol=0.02)
