@@ -83,6 +83,44 @@ class NNDef:
     def n_outputs(self) -> int:
         return self.kernel.n_outputs if self.kernel else 0
 
+    # the get/set/return triplet family (libhpnn.c:544-657); the reference
+    # exposes each conf field through the _NN surface
+    def get_name(self) -> str | None:
+        return self.conf.name
+
+    def set_name(self, name: str) -> None:
+        self.conf.name = name
+
+    def get_type(self) -> str:
+        return self.conf.type
+
+    def set_type(self, kind: str) -> None:
+        self.conf.type = kind
+
+    def get_seed(self) -> int:
+        return self.conf.seed
+
+    def set_seed(self, seed: int) -> None:
+        self.conf.seed = int(seed)
+
+    def get_train(self) -> str:
+        return self.conf.train
+
+    def set_train(self, train: str) -> None:
+        self.conf.train = train
+
+    def get_sample_dir(self) -> str | None:
+        return self.conf.samples
+
+    def set_sample_dir(self, path: str) -> None:
+        self.conf.samples = path
+
+    def get_test_dir(self) -> str | None:
+        return self.conf.tests
+
+    def set_test_dir(self, path: str) -> None:
+        self.conf.tests = path
+
 
 def configure(path: str) -> NNDef | None:
     """_NN(load,conf): parse the .conf then generate or load the kernel
@@ -111,6 +149,17 @@ def configure(path: str) -> NNDef | None:
         if kernel is None:
             nn_error(f"FAILED to load kernel {conf.f_kernel}\n")
             return None
+    # ann_kernel_allocate's memory accounting line (ann.c:197), printed on
+    # both the generate and load paths
+    nn_out(f"[CPU] ANN total allocation: {kernel.allocation_bytes} "
+           "(bytes)\n")
+    # _NN(load,conf)'s own accounting (libhpnn.c:872): sizeof(nn_def)=72
+    # plus the strlen (no NUL -- STRDUP_REPORT, common.h:122-127) of every
+    # duplicated string and 4 bytes per [hidden] entry
+    def_bytes = 72 + len(conf.name or "") + 4 * len(conf.hiddens) \
+        + len(conf.f_kernel or "") + len(conf.samples or "") \
+        + len(conf.tests or "")
+    nn_out(f"NN definition allocation: {def_bytes} (bytes)\n")
     return NNDef(conf=conf, kernel=kernel)
 
 

@@ -53,7 +53,6 @@ def _parse_args(argv: list[str], name: str, train: bool):
     """Reference-style parse; returns (filename, verbose) or None on -h,
     raises SystemExit(-1) on syntax errors."""
     filename = None
-    verbose = 0
     numeric = {"O": runtime.set_omp_threads, "B": runtime.set_omp_blas,
                "S": runtime.set_cuda_streams}
     i = 0
@@ -72,7 +71,9 @@ def _parse_args(argv: list[str], name: str, train: bool):
                     sys.stdout.write(_help_text(name, train))
                     return None
                 if c == "v":
-                    verbose += 1
+                    # increment live so the third -v logs "verbosity set
+                    # to 3." exactly like _NN(inc,verbose) (libhpnn.c:73)
+                    nn_log.inc_verbosity()
                     j += 1
                     continue
                 if c == "x" and train:
@@ -109,7 +110,7 @@ def _parse_args(argv: list[str], name: str, train: bool):
                 raise SystemExit(-1)
             filename = arg
         i += 1
-    return filename or "./nn.conf", verbose
+    return filename or "./nn.conf", nn_log.get_verbosity()
 
 
 def train_nn_main(argv: list[str] | None = None) -> int:
@@ -120,8 +121,7 @@ def train_nn_main(argv: list[str] | None = None) -> int:
     if parsed is None:
         runtime.deinit_all()
         return 0
-    filename, verbose = parsed
-    nn_log.set_verbosity(verbose)
+    filename, _verbose = parsed
     neural = configure(filename)
     if neural is None:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
@@ -157,8 +157,7 @@ def run_nn_main(argv: list[str] | None = None) -> int:
     if parsed is None:
         runtime.deinit_all()
         return 0
-    filename, verbose = parsed
-    nn_log.set_verbosity(verbose)
+    filename, _verbose = parsed
     neural = configure(filename)
     if neural is None:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")

@@ -58,10 +58,30 @@ class Kernel:
 
     def momentum_init(self) -> None:
         """Allocate + zero dw buffers (ann_momentum_init, ann.c:1876-1890)."""
+        from ..utils.nn_log import nn_out
+
         self.momentum = [np.zeros_like(w) for w in self.weights]
+        # accounting line (ann.c:1904): the dw pointer array (8 bytes per
+        # layer) plus each dw matrix at 8 bytes per weight
+        n_bytes = 8 * len(self.weights) + 8 * sum(
+            int(w.size) for w in self.weights)
+        nn_out(f"[CPU] MOMENTUM ALLOC: {n_bytes} (bytes)\n")
 
     def momentum_free(self) -> None:
         self.momentum = None
+
+    @property
+    def allocation_bytes(self) -> int:
+        """The byte count ann_kernel_allocate reports (ann.c:113-200):
+        n_hiddens * sizeof(layer_ann)=24, the max_index scratch, the input
+        vector, and every layer's weights+activation vector at 8 bytes each
+        (verified against the compiled reference's '[CPU] ANN total
+        allocation' line)."""
+        n_hiddens = self.n_hiddens
+        max_index = max(self.n_inputs, self.n_outputs, *self.hiddens)
+        doubles = max_index + self.n_inputs + sum(
+            w.shape[0] * w.shape[1] + w.shape[0] for w in self.weights)
+        return 24 * n_hiddens + 8 * doubles
 
     def validate(self) -> bool:
         """Shape-consistency check (ann_validate_kernel, ann.c:862-879)."""

@@ -14,13 +14,19 @@ from .mesh import (
     row_sharding,
     shard_weights,
 )
-from .tp import tp_forward, tp_forward_explicit, tp_train_sample
+from .tp import (
+    tp_forward,
+    tp_forward_colsharded,
+    tp_forward_explicit,
+    tp_train_sample,
+)
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS",
     "make_mesh", "batch_sharding", "replicated", "row_sharding",
     "shard_weights",
-    "tp_forward", "tp_forward_explicit", "tp_train_sample",
+    "tp_forward", "tp_forward_colsharded", "tp_forward_explicit",
+    "tp_train_sample",
     "batched_grads", "dp_shard", "dp_train_epoch", "dp_train_step",
     "dp_train_step_momentum",
 ]

@@ -141,3 +141,41 @@ def tp_forward_explicit(weights, x, kind: str, mesh):
         return v
 
     return run(padded, jnp.asarray(x))
+
+
+def tp_forward_colsharded(weights, x, kind: str, mesh):
+    """Input-dimension (contraction) sharding: the sequence-parallel analog.
+
+    The reference has no sequence axis (SURVEY.md section 2.3: the "long
+    input" is the 851-dim XRD vector); the corresponding scale-out is to
+    split the INPUT dimension of the first layer across the mesh -- each
+    device holds a column block of W_0 and the matching slice of x,
+    computes a partial pre-activation, and a ``lax.psum`` over ICI
+    reassembles it (where row sharding all-gathers activations, column
+    sharding all-reduces partial sums -- the same duality as sequence
+    parallelism vs tensor parallelism in transformer stacks).  Remaining
+    layers run replicated.
+    """
+    k = mesh.shape[MODEL_AXIS]
+    w0 = jnp.asarray(weights[0])
+    m = w0.shape[1]
+    pad = (-m) % k
+    if pad:
+        w0 = jnp.concatenate(
+            [w0, jnp.zeros((w0.shape[0], pad), w0.dtype)], axis=1)
+        x = jnp.concatenate([jnp.asarray(x), jnp.zeros(pad, w0.dtype)])
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, MODEL_AXIS), P(MODEL_AXIS)),
+        out_specs=P(),
+        check_vma=False)  # psum output is replicated by construction
+    def first_layer(w_blk, x_blk):
+        return lax.psum(w_blk @ x_blk, MODEL_AXIS)
+
+    z0 = first_layer(w0, jnp.asarray(x))
+    from ..ops.activations import ann_act, snn_softmax
+
+    if len(weights) == 1:  # single layer: z0 is the output pre-activation
+        return snn_softmax(z0) if kind == steps.SNN else ann_act(z0)
+    return steps.forward(tuple(weights[1:]), ann_act(z0), kind)[-1]

@@ -50,6 +50,9 @@ def get_verbosity() -> int:
 def inc_verbosity() -> None:
     global _verbosity
     _verbosity += 1
+    # _NN(inc,verbose) logs the new level at DBG, so only the third -v
+    # onward actually prints (libhpnn.c:73)
+    nn_dbg(f"verbosity set to {_verbosity}.\n")
 
 
 def dec_verbosity() -> None:

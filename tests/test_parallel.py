@@ -147,3 +147,18 @@ def test_dp_epoch_mesh_sharded_parity():
     for a, b in zip(w_m, w_1):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-12)
     np.testing.assert_allclose(np.asarray(e_m), np.asarray(e_1), atol=1e-12)
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_tp_forward_colsharded_parity(kind):
+    """Input-dim (contraction) sharding with psum == single device --
+    the sequence-parallel analog (851-dim XRD input, SURVEY.md 2.3)."""
+    from hpnn_tpu.parallel import tp_forward_colsharded
+
+    ws = _net([851, 16, 5], seed=21)
+    x = jnp.asarray(RNG.uniform(-1, 1, 851))
+    mesh = make_mesh(n_data=1, n_model=8)
+    got = tp_forward_colsharded(ws, x, kind, mesh)
+    want = ops.forward(ws, x, kind)[-1]
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-14)

@@ -89,8 +89,11 @@ def _run_mine(app, args, cwd):
         env=env).stdout
 
 
-def _nn_lines(text, what):
-    lines = [l for l in text.splitlines() if l.startswith(f"NN: {what}")]
+def _nn_lines(text, what="NN"):
+    """All reference-grammar lines ('NN: ', 'NN(DBG): ', ...); pass a
+    keyword to narrow to e.g. TRAINING lines."""
+    prefix = "NN" if what == "NN" else f"NN: {what}"
+    lines = [l for l in text.splitlines() if l.startswith(prefix)]
     # a final dEp of +-1e-15 prints as 0.0000000000 vs -0.0000000000
     # depending on the last ulp; the sign of an effectively-zero delta is
     # not part of the parity contract
@@ -108,8 +111,9 @@ def test_training_parity(tmp_path, kind, train):
     os.rename(tmp_path / "kernel.opt", tmp_path / "ref_kernel.opt")
     my_out = _run_mine("train_nn", ["-v", "-v", "-v", "nn.conf"], tmp_path)
 
-    # byte-identical per-sample training lines (shuffle + loop + grammar)
-    assert _nn_lines(ref_out, "TRAINING") == _nn_lines(my_out, "TRAINING")
+    # byte-identical console stream: verbosity DBG line, generate +
+    # allocation-report lines, and every per-sample training line
+    assert _nn_lines(ref_out) == _nn_lines(my_out)
 
     # bit-identical generated kernel
     assert (tmp_path / "ref_kernel.tmp").read_text() == \
