@@ -1,72 +1,321 @@
-"""Benchmark: MNIST-shaped online training throughput, samples/sec/chip.
+"""Benchmark matrix: online-convergence training + batched-GEMM throughput.
 
-Workload: the reference's flagship configuration -- a 784-300-10 ANN trained
-per-sample to convergence with BP (``/root/reference/tutorials/mnist/
-tutorial.bash:125-136``; loop semantics ``src/ann.c:2281-2372``) -- on
-synthetic MNIST-statistics data, run as ONE on-device lax.scan epoch.
+Workloads (BASELINE.md "Rebuild targets"):
 
-The reference publishes no numbers (BASELINE.md), so ``vs_baseline`` is
-reported against a measured reference-implementation proxy: the serial C
-algorithm's arithmetic cost executed at the same convergence budget -- i.e.
-value 1.0 until a real reference measurement lands in BASELINE.md.
+* ``mnist_ann_bp``   -- the flagship 784-300-10 ANN trained per-sample to
+  convergence with BP (``/root/reference/tutorials/mnist/tutorial.bash:
+  125-136``; loop ``src/ann.c:2281-2372``).
+* ``xrd_ann_bpm``    -- the RRUFF-XRD shape 851-230-230, BPM alpha=0.2
+  (``tutorials/ann/tutorial.bash:129-140``, alpha ``src/libhpnn.c:1248``).
+* ``mnist_snn_bp``   -- SNN 784-300-10 (``tutorials/mnist/opt_mnist.bash``).
+* ``stress_8x4096``  -- deep/wide MLP 8x4096 hidden, batched forward on the
+  Pallas fused kernels (BASELINE config 4, Pallas GEMM tiling).
+* ``dp_epoch``       -- data-parallel minibatch epoch ([batch] extension,
+  BASELINE config 5).
 
-Prints exactly one JSON line:
-    {"metric": ..., "value": N, "unit": "samples/sec", "vs_baseline": N}
+Timing methodology (VERDICT round 1: ``jax.block_until_ready`` could not be
+trusted on this platform -- re-confirmed this round: it returns early for
+some dispatch patterns, yielding impossible >1000 TFLOPS readings): every
+timed region ends with a forced device-to-host read.  A bulk ``np.asarray``
+would be just as wrong in the other direction -- the chip is reached
+through a tunnel whose D2H path moves ~35 MB/s and costs ~65 ms per
+round-trip -- so the sync is a 4-byte scalar checksum (``float(jnp.sum(
+out))``): it provably waits for the real computation while adding only one
+tunnel round-trip, which is itself measured and reported as ``sync_rtt_s``
+in the JSON.  Each config runs one compile/warmup pass then ``REPEATS``
+timed passes; the median is reported.  Workloads are sized so one timed
+pass is ~0.5-5 s, keeping the sync overhead at the few-percent level.
+Convergence configs also report the executed BP-iteration count and a
+derived FLOPS figure computed FROM that count, so the rate is
+self-consistent (the round-1 failure mode -- a rate implying impossible
+FLOPS -- is checkable from the JSON itself).
+
+``vs_baseline``: the serial C reference compiled from /root/reference does
+**1.43 samples/sec** on this host on the same flagship workload (64-sample
+corpus, seed 10958; measured by the round-1 judge, VERDICT.md "Headline").
+The flagship line reports sps/1.43.  The reference itself publishes no
+numbers (BASELINE.md).
+
+Prints exactly ONE JSON line:
+    {"metric": ..., "value": N, "unit": "samples/sec/chip",
+     "vs_baseline": N, "configs": [ ...one record per workload... ]}
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import statistics
 import time
 
 import numpy as np
 
-N_SAMPLES = 256
-DTYPE = "f32"  # throughput dtype (parity path is fp64; BASELINE.md note)
+REPEATS = 3
+# measured by the round-1 judge on this host: serial C reference,
+# flagship MNIST workload (VERDICT.md) -- samples/sec
+C_REFERENCE_SPS = 1.43
+# per-chip peak used for the MFU denominator: TPU v5e ~197 TFLOPS bf16
+# (f32 runs below this; MFU is therefore conservative for f32 configs)
+PEAK_TFLOPS_BF16 = 197.0
 
 
-def main() -> None:
+def _sync(tree):
+    """Honest completion barrier: pull a 4-byte checksum derived from every
+    leaf to the host.  float() genuinely blocks on the computation
+    (block_until_ready does not on this platform) while moving only a
+    scalar through the slow tunnel."""
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return float(sum(jnp.sum(x.astype(jnp.float32)) for x in leaves))
+
+
+def _measure_sync_rtt():
+    """One-round-trip cost of the scalar sync itself (reported in JSON)."""
+    import jax.numpy as jnp
+
+    x = jnp.zeros((8, 128), jnp.float32)
+    _sync(x)
+    t0 = time.perf_counter()
+    _sync(x)
+    return time.perf_counter() - t0
+
+
+def _mnist_corpus(n, rng_seed=42):
+    rng = np.random.default_rng(rng_seed)
+    # MNIST-statistics inputs: raw 0..255 pixels (pmnist does not normalize,
+    # prepare_mnist.c:47-60), ~80% zeros like real digits
+    xs = rng.uniform(0, 255, (n, 784))
+    xs *= rng.uniform(0, 1, (n, 784)) > 0.8
+    ts = -np.ones((n, 10))
+    ts[np.arange(n), rng.integers(0, 10, n)] = 1.0
+    return xs, ts
+
+
+def _xrd_corpus(n, rng_seed=7):
+    rng = np.random.default_rng(rng_seed)
+    # pdif statistics: input[0]=T/273.15, then 850 intensity bins in [0,1]
+    # normalized to max 1 (file_dif.c:425-465); output 230 slots at +-1
+    xs = np.concatenate([
+        rng.uniform(0.9, 1.2, (n, 1)),
+        rng.uniform(0, 1, (n, 850)) * (rng.uniform(0, 1, (n, 850)) > 0.7),
+    ], axis=1)
+    xs[:, 1:] /= xs[:, 1:].max(axis=1, keepdims=True) + 1e-9
+    ts = -np.ones((n, 230))
+    ts[np.arange(n), rng.integers(0, 230, n)] = 1.0
+    return xs, ts
+
+
+def _convergence_flops_per_iter(dims, momentum):
+    """FLOPs of one BP/BPM iteration of the reference algorithm.
+
+    dims = [n_in, h1, ..., n_out].  Per layer l (N=dims[l+1], M=dims[l]):
+    fresh forward 2NM; weight update 2NM (BP: W+=lr*outer) or 4NM (BPM:
+    dw+=lr*outer; W+=dw; dw*=alpha); backward transposed matvec 2NM for
+    every non-first layer (hidden deltas, ann.c:1336-1338).  Elementwise
+    act/dact/error terms are O(N) noise and ignored.
+    """
+    upd = 4 if momentum else 2
+    total = 0
+    for l in range(len(dims) - 1):
+        nm = dims[l + 1] * dims[l]
+        total += (2 + upd) * nm
+        if l >= 1:
+            total += 2 * nm
+    return total
+
+
+def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
+                       dtype_str):
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models.kernel import generate_kernel
+    from hpnn_tpu.ops import select_train_epoch
+
+    dtype = {"f32": jnp.float32, "f64": jnp.float64,
+             "bf16": jnp.bfloat16}[dtype_str]
+    kern, _ = generate_kernel(10958, dims[0], list(dims[1:-1]), dims[-1])
+    weights = tuple(jnp.asarray(w, dtype=dtype) for w in kern.weights)
+    xs, ts = corpus_fn(n_samples)
+    jxs = jnp.asarray(xs, dtype=dtype)
+    jts = jnp.asarray(ts, dtype=dtype)
+
+    train_epoch, path = select_train_epoch(dtype)
+    # compile/warmup at the exact timed shapes
+    w, stats = train_epoch(weights, jxs, jts, kind, momentum)
+    _sync((w, stats.n_iter))
+
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        w, stats = train_epoch(weights, jxs, jts, kind, momentum)
+        _sync((w,))
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
+    n_iter = int(np.asarray(stats.n_iter, dtype=np.int64).sum())
+    flops = n_iter * _convergence_flops_per_iter(dims, momentum)
+    tflops = flops / dt / 1e12
+    return {
+        "metric": f"{name}_{dtype_str}",
+        "value": round(n_samples / dt, 3),
+        "unit": "samples/sec/chip",
+        "seconds": round(dt, 4),
+        "bp_iterations": n_iter,
+        "tflops_effective": round(tflops, 4),
+        "mfu_vs_bf16_peak": round(tflops / PEAK_TFLOPS_BF16, 6),
+        "path": path,
+    }
+
+
+def _bench_stress():
+    """BASELINE config 4: 8x4096-hidden MLP, batched fwd via Pallas GEMMs."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models.kernel import generate_kernel
+    from hpnn_tpu.ops.pallas_kernels import batched_forward_pallas
+
+    dims = [1024] + [4096] * 8 + [1024]
+    batch, chain = 2048, 20
+    kern, _ = generate_kernel(1, dims[0], dims[1:-1], dims[-1])
+    weights = tuple(jnp.asarray(w, dtype=jnp.bfloat16) for w in kern.weights)
+    rng = np.random.default_rng(3)
+    xs = jnp.asarray(rng.uniform(-1, 1, (batch, dims[0])), dtype=jnp.bfloat16)
+
+    import jax
+    fwd = jax.jit(lambda w, x: batched_forward_pallas(w, x, "ANN"))
+    _sync(fwd(weights, xs))
+    times = []
+    for _ in range(REPEATS):
+        # n_in == n_out, so chain the net end-to-end `chain` times (async
+        # dispatches pipeline; ONE scalar sync at the end) -- amortizes the
+        # ~65 ms tunnel round-trip over real MXU work
+        t0 = time.perf_counter()
+        out = xs
+        for _ in range(chain):
+            out = fwd(weights, out)
+        _sync(out)
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
+    flops = chain * 2 * batch * sum(
+        dims[i + 1] * dims[i] for i in range(len(dims) - 1))
+    tflops = flops / dt / 1e12
+    return {
+        "metric": "stress_mlp_8x4096_fwd_bf16",
+        "value": round(chain * batch / dt, 3),
+        "unit": "samples/sec/chip",
+        "seconds": round(dt, 5),
+        "tflops_effective": round(tflops, 3),
+        "mfu_vs_bf16_peak": round(tflops / PEAK_TFLOPS_BF16, 4),
+        "path": "pallas",
+    }
+
+
+def _bench_dp():
+    """BASELINE config 5: data-parallel minibatch epoch (batch extension)."""
     import jax
     import jax.numpy as jnp
 
     from hpnn_tpu.models.kernel import generate_kernel
-    from hpnn_tpu.ops import train_epoch
+    from hpnn_tpu.ops import bp_learn_rate
+    from hpnn_tpu.parallel import dp_train_epoch, make_mesh
+    from hpnn_tpu.parallel.mesh import replicated as replicated_sharding
+
+    n, bsz = 16384, 256
+    kern, _ = generate_kernel(10958, 784, [300], 10)
+    weights = tuple(jnp.asarray(w, dtype=jnp.float32) for w in kern.weights)
+    xs, ts = _mnist_corpus(n)
+    jxs = jnp.asarray(xs, dtype=jnp.float32)
+    jts = jnp.asarray(ts, dtype=jnp.float32)
+    mesh = None
+    if jax.device_count() > 1:
+        mesh = make_mesh()
+        weights = tuple(
+            jax.device_put(w, replicated_sharding(mesh)) for w in weights)
+    n_batches = n // bsz
+    lr = bp_learn_rate("ANN")
+
+    w, errs = dp_train_epoch(weights, jxs, jts, "ANN", False, n_batches, lr,
+                             alpha=0.2, mesh=mesh)
+    _sync((w, errs))
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        w, errs = dp_train_epoch(weights, jxs, jts, "ANN", False, n_batches,
+                                 lr, alpha=0.2, mesh=mesh)
+        _sync((w,))
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
+    # one fwd + one bwd(~2x fwd) per sample per epoch
+    flops = 6 * n * sum(w.shape[0] * w.shape[1] for w in weights)
+    tflops = flops / dt / 1e12
+    return {
+        "metric": "dp_mnist_batch256_epoch_f32",
+        "value": round(n / dt, 3),
+        "unit": "samples/sec/chip",
+        "seconds": round(dt, 5),
+        "devices": jax.device_count(),
+        "tflops_effective": round(tflops, 4),
+        "mfu_vs_bf16_peak": round(tflops / PEAK_TFLOPS_BF16, 6),
+        "path": "xla",
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--only", default=None,
+                        help="run a single config by name prefix")
+    args = parser.parse_args()
+
+    import jax
 
     jax.config.update("jax_enable_x64", True)
-    dtype = {"f32": jnp.float32, "f64": jnp.float64}[DTYPE]
 
-    kern, _ = generate_kernel(10958, 784, [300], 10)
-    weights = tuple(jnp.asarray(w, dtype=dtype) for w in kern.weights)
+    benches = {
+        "mnist_ann_bp": lambda: _bench_convergence(
+            "mnist_784-300-10_ann_bp", [784, 300, 10], "ANN", False, 512,
+            _mnist_corpus, "f32"),
+        "xrd_ann_bpm": lambda: _bench_convergence(
+            "xrd_851-230-230_ann_bpm", [851, 230, 230], "ANN", True, 128,
+            _xrd_corpus, "f32"),
+        "mnist_snn_bp": lambda: _bench_convergence(
+            "mnist_784-300-10_snn_bp", [784, 300, 10], "SNN", False, 32,
+            _mnist_corpus, "f32"),
+        "stress_8x4096": _bench_stress,
+        "dp_epoch": _bench_dp,
+    }
+    if args.only:
+        benches = {k: v for k, v in benches.items() if k.startswith(args.only)}
 
-    rng = np.random.default_rng(42)
-    # MNIST-statistics inputs: raw 0..255 pixel values (pmnist does not
-    # normalize, prepare_mnist.c:47-60), ~80% zeros like real digits
-    xs = rng.uniform(0, 255, (N_SAMPLES, 784))
-    xs *= rng.uniform(0, 1, (N_SAMPLES, 784)) > 0.8
-    ts = -np.ones((N_SAMPLES, 10))
-    ts[np.arange(N_SAMPLES), rng.integers(0, 10, N_SAMPLES)] = 1.0
-    jxs = jnp.asarray(xs, dtype=dtype)
-    jts = jnp.asarray(ts, dtype=dtype)
+    rtt = _measure_sync_rtt()
+    records = []
+    for name, fn in benches.items():
+        try:
+            records.append(fn())
+        except Exception as exc:  # a broken config must not hide the others
+            records.append({"metric": name, "error": f"{type(exc).__name__}: {exc}"})
 
-    # warmup / compile at the SAME shapes as the timed run (the scan length
-    # is part of the compiled program; a different S would recompile inside
-    # the timed region)
-    w, stats = train_epoch(weights, jxs, jts, "ANN", False)
-    jax.block_until_ready(w)
-
-    t0 = time.perf_counter()
-    w, stats = train_epoch(weights, jxs, jts, "ANN", False)
-    jax.block_until_ready(w)
-    dt = time.perf_counter() - t0
-
-    # train_epoch runs unsharded on one device, so the per-chip rate is the
-    # measured rate itself regardless of how many chips are visible
-    sps = N_SAMPLES / dt
+    flagship = next((r for r in records if "mnist_784-300-10_ann_bp" in
+                     r.get("metric", "") and "error" not in r), None)
+    is_flagship = flagship is not None
+    if flagship is None:
+        flagship = next((r for r in records if "error" not in r),
+                        {"metric": "none", "value": 0.0,
+                         "unit": "samples/sec/chip"})
     print(json.dumps({
-        "metric": f"mnist_784-300-10_bp_convergence_train_{DTYPE}",
-        "value": round(sps, 3),
-        "unit": "samples/sec/chip",
-        "vs_baseline": 1.0,
+        "metric": flagship["metric"],
+        "value": flagship["value"],
+        # the C baseline is the flagship MNIST workload; comparing any
+        # other config against it would be meaningless
+        "vs_baseline": round(flagship["value"] / C_REFERENCE_SPS, 3)
+        if is_flagship else None,
+        "unit": flagship["unit"],
+        "baseline": f"serial C reference {C_REFERENCE_SPS} samples/sec "
+                    "on this host (VERDICT.md round-1 measurement)"
+        if is_flagship else None,
+        "peak_tflops_bf16": PEAK_TFLOPS_BF16,
+        "sync_rtt_s": round(rtt, 4),
+        "configs": records,
     }))
 
 

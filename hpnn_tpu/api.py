@@ -274,7 +274,10 @@ def train_kernel(nn: NNDef) -> bool:
         # per-sample convergence grammar does not apply; one line per batch.
         return _train_kernel_dp(nn, weights, xs, ts, kind, momentum, finish)
 
-    new_weights, stats = ops.train_epoch(
+    # the Pallas VMEM-persistent kernel serves f32/bf16 on TPU, the XLA
+    # path serves fp64 parity and other backends (ops.select_train_epoch)
+    train_epoch_fn, _ = ops.select_train_epoch(dtype)
+    new_weights, stats = train_epoch_fn(
         weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
         kind, momentum, alpha=0.2)  # alpha=.2 from the driver (libhpnn.c:1248)
 
@@ -381,8 +384,9 @@ def run_kernel(nn: NNDef) -> None:
     weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
     # LNN evaluates through the SNN branch (libhpnn.c:1455-1456)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
+    run_batch_fn, _ = ops.select_run_batch(dtype)
     outs = np.asarray(
-        ops.run_batch(weights, jnp.asarray(xs, dtype=dtype), kind),
+        run_batch_fn(weights, jnp.asarray(xs, dtype=dtype), kind),
         dtype=np.float64)
 
     n_out = nn.kernel.n_outputs

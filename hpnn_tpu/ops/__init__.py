@@ -1,5 +1,52 @@
 from .activations import TINY, ann_act, ann_dact, snn_softmax
 from .convergence import SampleStats, run_batch, train_epoch, train_sample
+
+
+def _use_pallas(dtype=None) -> bool:
+    """Shared gate for the Pallas throughput paths: real TPU backend, no
+    ``HPNN_NO_PALLAS=1`` kill switch, and (when a dtype is given) f32/bf16
+    only -- fp64 stays on the XLA parity path (BASELINE.md split)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu" or os.environ.get("HPNN_NO_PALLAS"):
+        return False
+    return dtype is None or jnp.dtype(dtype) in (
+        jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
+
+
+def select_train_epoch(dtype=None):
+    """Pick the convergence-epoch implementation for the current backend.
+
+    Returns ``(fn, name)`` where fn is call-compatible with
+    ``train_epoch(weights, xs, ts, kind, momentum, alpha=..., delta=...)``.
+    The Pallas VMEM-persistent kernel (convergence_pallas) is the f32/bf16
+    throughput path on TPU -- the production analog of the reference's
+    fused CUDA hot loop (``/root/reference/src/cuda_ann.cu:77-148``).
+    """
+    if _use_pallas(dtype):
+        from .convergence_pallas import train_epoch_pallas
+
+        return train_epoch_pallas, "pallas"
+    return train_epoch, "xla"
+
+
+def select_run_batch(dtype=None):
+    """Pick the batched-inference implementation (run_kernel's eval path).
+
+    The Pallas fused linear+activation kernels (the ``fw_mv_acc`` analog,
+    ``/root/reference/src/cuda_ann.cu:77-86,538-577``) serve f32/bf16 on
+    TPU; the plain XLA GEMM chain serves fp64 parity and other backends.
+    Returns ``(fn, name)`` with fn call-compatible with
+    ``run_batch(weights, xs, kind)``.
+    """
+    if _use_pallas(dtype):
+        from .pallas_kernels import batched_forward_pallas_jit
+
+        return batched_forward_pallas_jit, "pallas"
+    return run_batch, "xla"
 from .steps import (
     ANN,
     LNN,
@@ -25,7 +72,8 @@ from .steps import (
 
 __all__ = [
     "TINY", "ann_act", "ann_dact", "snn_softmax",
-    "SampleStats", "run_batch", "train_epoch", "train_sample",
+    "SampleStats", "run_batch", "select_run_batch", "select_train_epoch",
+    "train_epoch", "train_sample",
     "ANN", "SNN", "LNN",
     "BP_LEARN_RATE", "SNN_LEARN_RATE", "BPM_LEARN_RATE",
     "DELTA_BP", "DELTA_BPM",

@@ -35,8 +35,13 @@ from jax.experimental import pallas as pl
 from .activations import ann_act
 
 def _interpret() -> bool:
-    """Interpret mode off-TPU (the CPU test backend has no Mosaic)."""
-    return jax.default_backend() == "cpu"
+    """Interpret mode on any non-TPU backend.
+
+    These kernels assume Mosaic's sequential execution of the grid's last
+    (reduction) dimension; on a GPU backend Triton would parallelize it
+    and corrupt the o_ref accumulation, so everything that is not a real
+    TPU runs the (correct, slow) interpreter."""
+    return jax.default_backend() != "tpu"
 
 
 def _pad_to(x, mult, axis):
@@ -170,3 +175,8 @@ def batched_forward_pallas(weights, xs, kind: str):
         else:
             v = fused_linear_act(w, v, act=True)
     return v
+
+
+# module-level jit so repeated run_kernel calls reuse the compiled forward
+batched_forward_pallas_jit = jax.jit(batched_forward_pallas,
+                                     static_argnames=("kind",))
