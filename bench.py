@@ -71,6 +71,14 @@ def _sync(tree):
     return float(sum(jnp.sum(x.astype(jnp.float32)) for x in leaves))
 
 
+def _mxu_precision_name() -> str:
+    from jax import lax
+
+    from hpnn_tpu.ops.convergence_pallas import _precision
+
+    return "highest" if _precision() == lax.Precision.HIGHEST else "default"
+
+
 def _measure_sync_rtt():
     """One-round-trip cost of the scalar sync itself (reported in JSON)."""
     import jax.numpy as jnp
@@ -165,6 +173,12 @@ def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
         "tflops_effective": round(tflops, 4),
         "mfu_vs_bf16_peak": round(tflops / PEAK_TFLOPS_BF16, 6),
         "path": path,
+        # MXU matmul precision of the Pallas path: "default" = bf16-native
+        # passes (throughput mode; convergence fires earlier than exact-f32
+        # math, every SUCCESS still argmax-verified), "highest" = exact-f32
+        # (HPNN_PALLAS_PRECISION=highest, ~3x slower per iteration).
+        # Resolved by the same helper the kernel uses.
+        "mxu_precision": _mxu_precision_name() if path == "pallas" else None,
     }
 
 
@@ -273,7 +287,7 @@ def main() -> None:
 
     benches = {
         "mnist_ann_bp": lambda: _bench_convergence(
-            "mnist_784-300-10_ann_bp", [784, 300, 10], "ANN", False, 512,
+            "mnist_784-300-10_ann_bp", [784, 300, 10], "ANN", False, 2048,
             _mnist_corpus, "f32"),
         "xrd_ann_bpm": lambda: _bench_convergence(
             "xrd_851-230-230_ann_bpm", [851, 230, 230], "ANN", True, 128,

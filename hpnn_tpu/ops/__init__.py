@@ -1,5 +1,27 @@
 from .activations import TINY, ann_act, ann_dact, snn_softmax
 from .convergence import SampleStats, run_batch, train_epoch, train_sample
+from .steps import (
+    ANN,
+    LNN,
+    SNN,
+    BP_LEARN_RATE,
+    BPM_LEARN_RATE,
+    DELTA_BP,
+    DELTA_BPM,
+    MAX_BP_ITER,
+    MAX_BPM_ITER,
+    MIN_BP_ITER,
+    MIN_BPM_ITER,
+    SNN_LEARN_RATE,
+    batched_forward,
+    bp_learn_rate,
+    bpm_learn_rate,
+    deltas,
+    error,
+    forward,
+    train_step,
+    train_step_momentum,
+)
 
 
 def _use_pallas(dtype=None) -> bool:
@@ -47,28 +69,7 @@ def select_run_batch(dtype=None):
 
         return batched_forward_pallas_jit, "pallas"
     return run_batch, "xla"
-from .steps import (
-    ANN,
-    LNN,
-    SNN,
-    BP_LEARN_RATE,
-    BPM_LEARN_RATE,
-    DELTA_BP,
-    DELTA_BPM,
-    MAX_BP_ITER,
-    MAX_BPM_ITER,
-    MIN_BP_ITER,
-    MIN_BPM_ITER,
-    SNN_LEARN_RATE,
-    batched_forward,
-    bp_learn_rate,
-    bpm_learn_rate,
-    deltas,
-    error,
-    forward,
-    train_step,
-    train_step_momentum,
-)
+
 
 __all__ = [
     "TINY", "ann_act", "ann_dact", "snn_softmax",
