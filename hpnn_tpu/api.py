@@ -312,43 +312,79 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
                      finish) -> bool:
     """Data-parallel minibatch epoch ([batch] B conf extension).
 
-    Uses the reference's per-family learning rates and the BPM update order;
-    when more than one device is visible the batch axis is sharded over the
-    mesh's data axis so the gradient contraction all-reduces over ICI.
+    Uses the reference's per-family learning rates and the BPM update
+    order.  Every sample trains: batches are padded up to a multiple of
+    the data-axis size with masked-out rows (numerically identical to the
+    unpadded batch -- the mask zeroes deltas and the mean divides by the
+    real count), instead of silently dropping the tail or falling back to
+    one device.  Multi-process runs (HPNN_DISTRIBUTED) build global
+    arrays: every process loads the shared-filesystem corpus and
+    contributes the rows its devices own -- the reference's MPI layout
+    (``libhpnn.c:1184-1229``) without the rank-0 Bcast hub.
     """
     import jax
     import jax.numpy as jnp
 
     from . import ops
-    from .parallel import dp_train_epoch, make_mesh
+    from .parallel import dp_train_epoch_batched, global_array, make_mesh
+    from .parallel.mesh import DATA_AXIS
     from .parallel.mesh import replicated as replicated_sharding
 
     conf = nn.conf
     lr = ops.bpm_learn_rate(kind) if momentum else ops.bp_learn_rate(kind)
     s = xs.shape[0]
     bsz = min(conf.batch, s)
-    n_batches = max(1, s // bsz)
+    n_batches = -(-s // bsz)
     dtype = _dtype_of(conf)
-    jxs = jnp.asarray(xs, dtype=dtype)
-    jts = jnp.asarray(ts, dtype=dtype)
-    mesh = None
-    if jax.device_count() > 1 and bsz % jax.device_count() == 0:
-        # the per-step batch rows (not the whole corpus) must divide the
-        # data axis; otherwise run unsharded (tiny odd corpora aren't
-        # worth a padded layout)
-        mesh = make_mesh()
-        weights = tuple(
-            jax.device_put(w, replicated_sharding(mesh)) for w in weights)
-    dropped = s - n_batches * bsz
-    if dropped:
-        nn_out(f"DP: dropping {dropped} tail sample(s) "
-               f"(S={s} not divisible by batch={bsz})\n")
-        # slice here so dp_train_epoch's bsz = s // n_batches equals the
-        # configured batch size (it would otherwise absorb the tail)
-        jxs = jxs[: n_batches * bsz]
-        jts = jts[: n_batches * bsz]
-    new_weights, errs = dp_train_epoch(weights, jxs, jts, kind, momentum,
-                                       n_batches, lr, alpha=0.2, mesh=mesh)
+    ndev = jax.device_count()
+    mesh = make_mesh() if ndev > 1 else None
+    if mesh is None:
+        nn_out("DP: one device visible; minibatch training runs "
+               "unsharded\n")
+    bsz_pad = -(-bsz // ndev) * ndev if mesh is not None else bsz
+    padded_rows = n_batches * bsz_pad - s
+    if padded_rows:
+        nn_out(f"DP: padding {padded_rows} masked row(s) "
+               f"(S={s}, batch={bsz} -> {bsz_pad} over {ndev} device(s))\n")
+
+    np_dtype = np.dtype(str(jnp.dtype(dtype))) if dtype != jnp.bfloat16 \
+        else np.float32
+    xb = np.zeros((n_batches, bsz_pad, xs.shape[1]), np_dtype)
+    tb = np.zeros((n_batches, bsz_pad, ts.shape[1]), np_dtype)
+    mb = np.zeros((n_batches, bsz_pad), np_dtype)
+    for i in range(n_batches):
+        rows = slice(i * bsz, min((i + 1) * bsz, s))
+        k = rows.stop - rows.start
+        xb[i, :k] = xs[rows]
+        tb[i, :k] = ts[rows]
+        mb[i, :k] = 1.0
+
+    if jax.process_count() > 1 and mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        # host staging is f32/f64 numpy; the global arrays must carry the
+        # CONF dtype (bf16 via ml_dtypes survives the numpy round-trip)
+        def host(a):
+            return np.asarray(jnp.asarray(a, dtype=dtype))
+
+        bsh = NamedSharding(mesh, P(None, DATA_AXIS, None))
+        msh = NamedSharding(mesh, P(None, DATA_AXIS))
+        rep = replicated_sharding(mesh)
+        jxb = global_array(host(xb), bsh)
+        jtb = global_array(host(tb), bsh)
+        jmb = global_array(host(mb), msh)
+        weights = tuple(global_array(host(np.asarray(w)), rep)
+                        for w in weights)
+    else:
+        jxb = jnp.asarray(xb, dtype=dtype)
+        jtb = jnp.asarray(tb, dtype=dtype)
+        jmb = jnp.asarray(mb, dtype=dtype)
+        if mesh is not None:
+            weights = tuple(
+                jax.device_put(w, replicated_sharding(mesh))
+                for w in weights)
+    new_weights, errs = dp_train_epoch_batched(
+        weights, jxb, jtb, jmb, kind, momentum, lr, alpha=0.2, mesh=mesh)
     errs = np.asarray(errs, dtype=np.float64)
     for i in range(n_batches):
         nn_out(f"TRAINING BATCH {i:8d}\t err={errs[i]:15.10f}\n")

@@ -2,6 +2,7 @@ from .dp import (
     batched_grads,
     dp_shard,
     dp_train_epoch,
+    dp_train_epoch_batched,
     dp_train_step,
     dp_train_step_momentum,
 )
@@ -9,6 +10,7 @@ from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
+    global_array,
     make_mesh,
     replicated,
     row_sharding,
@@ -23,10 +25,10 @@ from .tp import (
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS",
-    "make_mesh", "batch_sharding", "replicated", "row_sharding",
-    "shard_weights",
+    "make_mesh", "batch_sharding", "global_array", "replicated",
+    "row_sharding", "shard_weights",
     "tp_forward", "tp_forward_colsharded", "tp_forward_explicit",
     "tp_train_sample",
-    "batched_grads", "dp_shard", "dp_train_epoch", "dp_train_step",
-    "dp_train_step_momentum",
+    "batched_grads", "dp_shard", "dp_train_epoch",
+    "dp_train_epoch_batched", "dp_train_step", "dp_train_step_momentum",
 ]

@@ -36,7 +36,7 @@ from ..ops import steps
 from .mesh import batch_sharding, replicated
 
 
-def batched_grads(weights, xs, ts, kind: str):
+def batched_grads(weights, xs, ts, kind: str, mask=None):
     """Mean gradient per layer via the reference's explicit deltas.
 
     The per-sample forward and delta math is vmapped from ops.steps --
@@ -46,29 +46,41 @@ def batched_grads(weights, xs, ts, kind: str):
     rank-1 updates is one matmul, grads[l] = delta_l^T @ h_{l-1} / B
     (materializing B outer products via vmap would waste HBM).
 
+    ``mask`` (B,) of 0/1 marks the REAL rows of a padded batch: masked-out
+    samples contribute nothing and the mean divides by the real count, so
+    a padded batch is numerically identical to the unpadded one (the SNN
+    softmax head makes zero-padded rows non-neutral without this).
+
     Returns (grads, mean_error).
     """
     acts = jax.vmap(lambda x: steps.forward(weights, x, kind))(xs)
-    err = jnp.mean(steps.error(acts[-1], ts, kind))
+    errs = steps.error(acts[-1], ts, kind)
     ds = jax.vmap(lambda a, t: steps.deltas(weights, a, t, kind))(acts, ts)
+    if mask is None:
+        denom = xs.shape[0]
+        err = jnp.sum(errs) / denom
+    else:
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        err = jnp.sum(errs * mask) / denom
+        ds = tuple(d * mask[:, None] for d in ds)
     hs = (xs, *acts[:-1])
-    b = xs.shape[0]
-    grads = tuple(d.T @ h / b for d, h in zip(ds, hs))
+    grads = tuple(d.T @ h / denom for d, h in zip(ds, hs))
     return grads, err
 
 
 @functools.partial(jax.jit, static_argnames=("kind",))
-def dp_train_step(weights, xs, ts, kind: str, lr):
+def dp_train_step(weights, xs, ts, kind: str, lr, mask=None):
     """One minibatch BP step; returns (weights, mean_error)."""
-    grads, err = batched_grads(weights, xs, ts, kind)
+    grads, err = batched_grads(weights, xs, ts, kind, mask)
     return tuple(w + lr * g for w, g in zip(weights, grads)), err
 
 
 @functools.partial(jax.jit, static_argnames=("kind",))
-def dp_train_step_momentum(weights, dw, xs, ts, kind: str, lr, alpha):
+def dp_train_step_momentum(weights, dw, xs, ts, kind: str, lr, alpha,
+                           mask=None):
     """One minibatch BPM step, reference order dw+=lr*g; W+=dw; dw*=alpha
     (ann.c:1996-1999); returns (weights, dw, mean_error)."""
-    grads, err = batched_grads(weights, xs, ts, kind)
+    grads, err = batched_grads(weights, xs, ts, kind, mask)
     dw = tuple(b + lr * g for b, g in zip(dw, grads))
     weights = tuple(w + b for w, b in zip(weights, dw))
     dw = tuple(alpha * b for b in dw)
@@ -76,43 +88,60 @@ def dp_train_step_momentum(weights, dw, xs, ts, kind: str, lr, alpha):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("kind", "momentum", "n_batches", "mesh"))
-def dp_train_epoch(weights, xs, ts, kind: str, momentum: bool,
-                   n_batches: int, lr, alpha=0.2, mesh=None):
-    """One epoch of minibatch training as a lax.scan over batches.
+                   static_argnames=("kind", "momentum", "mesh"))
+def dp_train_epoch_batched(weights, xb, tb, mb, kind: str, momentum: bool,
+                           lr, alpha=0.2, mesh=None):
+    """One epoch over pre-batched arrays as a lax.scan.
 
-    xs (S, n_in) with S divisible by n_batches (driver pads/truncates).
-    With ``mesh``, each scanned batch is sharded over the data axis (the
-    constraint goes on the RESHAPED (n_batches, bsz, n) array so the
-    per-step batch rows -- not the whole corpus -- split across devices).
-    Returns (weights, per-batch mean errors).
+    xb (n_batches, bsz, n_in), tb (n_batches, bsz, n_out), mb
+    (n_batches, bsz) 0/1 row mask (padded rows 0).  The driver builds
+    these -- including per-batch padding up to a multiple of the data-axis
+    size -- so the SAME function serves single-controller jnp arrays and
+    multi-process global arrays (jax.make_array_from_callback).  With
+    ``mesh``, batch rows are constrained to the data axis so the gradient
+    contraction all-reduces over ICI/DCN.  Returns (weights, per-batch
+    mean errors over REAL rows).
     """
-    s = xs.shape[0]
-    bsz = s // n_batches
-    xb = xs[: n_batches * bsz].reshape(n_batches, bsz, -1)
-    tb = ts[: n_batches * bsz].reshape(n_batches, bsz, -1)
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from .mesh import DATA_AXIS
 
-        sh = NamedSharding(mesh, P(None, DATA_AXIS, None))
-        xb = lax.with_sharding_constraint(xb, sh)
-        tb = lax.with_sharding_constraint(tb, sh)
+        xb = lax.with_sharding_constraint(
+            xb, NamedSharding(mesh, P(None, DATA_AXIS, None)))
+        tb = lax.with_sharding_constraint(
+            tb, NamedSharding(mesh, P(None, DATA_AXIS, None)))
+        mb = lax.with_sharding_constraint(
+            mb, NamedSharding(mesh, P(None, DATA_AXIS)))
     dw0 = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
 
-    def step(carry, xt):
+    def step(carry, xtm):
         w, dw = carry
-        x, t = xt
+        x, t, m = xtm
         if momentum:
             w, dw, err = dp_train_step_momentum(w, dw, x, t, kind,
-                                                lr, alpha)
+                                                lr, alpha, m)
         else:
-            w, err = dp_train_step(w, x, t, kind, lr)
+            w, err = dp_train_step(w, x, t, kind, lr, m)
         return (w, dw), err
 
-    (w, _), errs = lax.scan(step, (weights, dw0), (xb, tb))
+    (w, _), errs = lax.scan(step, (weights, dw0), (xb, tb, mb))
     return w, errs
+
+
+def dp_train_epoch(weights, xs, ts, kind: str, momentum: bool,
+                   n_batches: int, lr, alpha=0.2, mesh=None):
+    """One epoch of minibatch training; xs (S, n_in) with S divisible by
+    n_batches (tail truncated as before).  Thin wrapper over
+    ``dp_train_epoch_batched`` for single-controller callers; the api
+    driver builds padded/masked batches itself."""
+    s = xs.shape[0]
+    bsz = s // n_batches
+    xb = xs[: n_batches * bsz].reshape(n_batches, bsz, -1)
+    tb = ts[: n_batches * bsz].reshape(n_batches, bsz, -1)
+    mb = jnp.ones((n_batches, bsz), xs.dtype)
+    return dp_train_epoch_batched(weights, xb, tb, mb, kind, momentum,
+                                  lr, alpha=alpha, mesh=mesh)
 
 
 def dp_shard(weights, xs, ts, mesh):

@@ -112,6 +112,22 @@ def unpad_topology(weights, orig_dims):
     return tuple(out)
 
 
+def global_array(host_array, sharding: NamedSharding):
+    """Build a (possibly multi-process) global array from a full host copy.
+
+    Every process holds the complete numpy array -- the shared-filesystem
+    corpus assumption the reference's MPI driver makes
+    (``/root/reference/src/libhpnn.c:1184-1229`` lists the same sample dir
+    on every rank) -- and contributes only the shards its addressable
+    devices own.  This replaces the reference's rank-0-parse +
+    ``MPI_Bcast`` staging (``ann.c:558-614``): there is no hub, each
+    process materializes its slice directly.  Works identically in a
+    single process (then it is just a device_put with a sharding).
+    """
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx])
+
+
 def layer_sharding(w, mesh: Mesh) -> NamedSharding:
     """Row sharding when the row count divides the model axis, else
     replicated (the unpadded output layer, typically)."""

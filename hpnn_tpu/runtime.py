@@ -104,7 +104,29 @@ def init_all(init_verbose: int = 0) -> int:
         apply_env_platforms()
         jax.config.update("jax_enable_x64", True)
         if os.environ.get("HPNN_DISTRIBUTED"):  # multi-host opt-in
-            jax.distributed.initialize()
+            # the TPU analog of _NN(init,MPI) (libhpnn.c:182-200): join
+            # the multi-process coordination service.  Cluster launchers
+            # (GKE/SLURM) are auto-detected by jax; manual topologies --
+            # like the reference's `mpirun -n N` -- give the coordinator
+            # explicitly via HPNN_COORDINATOR / HPNN_NUM_PROCESSES /
+            # HPNN_PROCESS_ID.
+            kwargs = {}
+            if os.environ.get("HPNN_COORDINATOR"):
+                missing = [v for v in
+                           ("HPNN_NUM_PROCESSES", "HPNN_PROCESS_ID")
+                           if v not in os.environ]
+                if missing:
+                    raise RuntimeError(
+                        "HPNN_COORDINATOR requires "
+                        + " and ".join(missing)
+                        + " to be set (coordinator host:port, total "
+                        "process count, this process's 0-based id)")
+                kwargs = dict(
+                    coordinator_address=os.environ["HPNN_COORDINATOR"],
+                    num_processes=int(os.environ["HPNN_NUM_PROCESSES"]),
+                    process_id=int(os.environ["HPNN_PROCESS_ID"]),
+                )
+            jax.distributed.initialize(**kwargs)
         devs = jax.devices()
         lib_runtime.n_devices = len(devs)
         lib_runtime.nn_num_tasks = jax.process_count()
