@@ -19,13 +19,13 @@ resident weight refs; per-sample x/t blocks are streamed in by Pallas'
 automatic double-buffering.  Net HBM traffic for an epoch drops from
 O(iterations x weights) to O(weights + samples).
 
-Padding: every layer dimension is zero-padded to a multiple of 128 (lane
-width).  Zero padding is exactly neutral for the ANN math: padded rows of
-W produce z=0 => act(0)=0 activations, padded columns multiply zero
-inputs, and every padded delta is identically zero (the (t-o) factor and
-the W^T contraction both vanish), so padded weights stay zero through any
-number of updates.  The SNN softmax and the argmax stop criterion mask the
-padded lanes explicitly.
+Shapes are EXACT -- no host-side padding.  Mosaic exempts blocks that
+span the whole array from the (8, 128) block-alignment rule and lays VMEM
+out in (8, 128) tiles internally, so explicit zero-padding of the layer
+dims would only inflate traffic (measured: padding the 300-wide hidden
+layer to 384 lanes cost ~12% per iteration).  The lane masks below
+(out_mask et al.) keep the math correct for any dims and would also cover
+a padded layout.
 
 This is the f32/bf16 throughput path; the fp64 parity path stays on the
 XLA ``ops.convergence.train_epoch`` (BASELINE.md precision split).
@@ -56,15 +56,7 @@ from .steps import (
     bpm_learn_rate,
 )
 
-LANE = 128
-
-
-def _pad128(n: int) -> int:
-    return -(-n // LANE) * LANE
-
-
-def _pad2(x, rows, cols):
-    return jnp.pad(x, ((0, rows - x.shape[0]), (0, cols - x.shape[1])))
+LANE = 128  # stats-row width (one (1, LANE) f32 row per sample)
 
 
 # MXU precision for the f32 path.  The v5e MXU is bf16-native: with the
@@ -233,9 +225,9 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
     jax.jit,
     static_argnames=("kind", "momentum", "alpha", "delta", "lr", "interpret",
                      "precision"))
-def _train_epoch_padded(weights, xs, ts, kind: str, momentum: bool,
-                        alpha, delta, lr, interpret, precision):
-    """Jitted core: returns the PADDED weight arrays + raw stats rows.
+def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
+                      alpha, delta, lr, interpret, precision):
+    """Jitted core: returns the final weight arrays + raw stats rows.
 
     ``precision`` is a required static argument here -- the env-var
     default is resolved by the public wrapper BEFORE the jit boundary, so
@@ -253,21 +245,18 @@ def _train_epoch_padded(weights, xs, ts, kind: str, momentum: bool,
             delta = DELTA_BP
 
     n_layers = len(weights)
-    dims = [weights[0].shape[1]] + [w.shape[0] for w in weights]
-    pdims = [_pad128(d) for d in dims]
     dtype = xs.dtype
     s = xs.shape[0]
 
-    wp = tuple(_pad2(w.astype(dtype), pdims[l + 1], pdims[l])
-               for l, w in enumerate(weights))
+    wp = tuple(w.astype(dtype) for w in weights)
     # per-sample rows as (S, 1, width): Mosaic requires the last two block
-    # dims to be (8k, 128k) or the full array dims, so a (1, 1, width)
+    # dims to be (8k, 128k) OR the full array dims, so a (1, 1, width)
     # block over a 3D array is the shape a one-sample stream must take
-    xp = jnp.pad(xs, ((0, 0), (0, pdims[0] - dims[0])))[:, None, :]
-    tp = jnp.pad(ts, ((0, 0), (0, pdims[-1] - dims[-1])))[:, None, :]
+    xp = xs[:, None, :]
+    tp = ts[:, None, :]
 
     kernel = functools.partial(
-        _kernel, n_layers=n_layers, n_out=dims[-1], kind=kind,
+        _kernel, n_layers=n_layers, n_out=ts.shape[1], kind=kind,
         momentum=momentum, lr=float(lr), alpha=float(alpha),
         min_iter=min_iter, max_iter=max_iter, delta=float(delta),
         precision=precision)
@@ -283,7 +272,7 @@ def _train_epoch_padded(weights, xs, ts, kind: str, momentum: bool,
     out = pl.pallas_call(
         kernel,
         grid=(s,),
-        in_specs=[per_s(pdims[0]), per_s(pdims[-1])]
+        in_specs=[per_s(xs.shape[1]), per_s(ts.shape[1])]
         + [const(w.shape) for w in wp],
         out_specs=[const(w.shape) for w in wp] + [per_s(LANE)],
         out_shape=[jax.ShapeDtypeStruct(w.shape, dtype) for w in wp]
@@ -311,12 +300,9 @@ def train_epoch_pallas(weights, xs, ts, kind: str, momentum: bool,
     """
     if precision is None:
         precision = _precision()
-    padded_w, st = _train_epoch_padded(
+    new_w, st = _train_epoch_core(
         weights, xs, ts, kind, momentum, alpha=alpha, delta=delta, lr=lr,
         interpret=interpret, precision=precision)
-    dims = [weights[0].shape[1]] + [w.shape[0] for w in weights]
-    new_w = tuple(o[: dims[l + 1], : dims[l]]
-                  for l, o in enumerate(padded_w))
     stats = SampleStats(
         init_err=st[:, 0],
         first_ok=st[:, 1] > 0.5,

@@ -138,6 +138,14 @@ def main(argv: list[str] | None = None) -> int:
         f"processing   test database into {test_wd} directory.\n")
     idx = convert_set("./train_labels", "./train_images", sample_wd, 0,
                       "samples", quirk)
+    if not quirk:
+        # loud one-liner: the default test-set pairing FIXES the
+        # reference's off-by-one label bug, so files will not be
+        # byte-identical to a reference-generated corpus (ADVICE r1)
+        sys.stdout.write(
+            "note: test-set labels use the CORRECTED pairing; pass "
+            "--reference-quirks to reproduce the reference's off-by-one "
+            "byte-exactly.\n")
     convert_set("./test_labels", "./test_images", test_wd, idx,
                 "tests", quirk)
     return 0

@@ -47,8 +47,52 @@ cat > mnist_ann.conf <<!
 !
 N_TRAIN=$(ls samples | wc -l)
 N_TEST=$(ls tests | wc -l)
+# prepare live monitor (reference tutorial.bash:144-175): a `watch` loop
+# renders the PASS%/OPT% history from ./raw plus a progress bar of the
+# round in flight; dumb-terminal gnuplot when available, ASCII fallback
+# otherwise
+cat > tmp.gnuplot <<!
+#!/usr/bin/env gnuplot
+set term dumb size 80,30 aspect 1
+set tics out
+set y2tics
+set key below
+plot "raw" u 1:2 w lp t "PASS" axis x1y1, "raw" u 1:3 w lp t "OPT" axis x1y2
+!
+chmod +x ./tmp.gnuplot
+cat > tmp.mon <<!
+#!/bin/bash
+IDX=\$(wc -l < raw)
+if [ "\$IDX" -gt 1 ]; then
+  if command -v gnuplot >/dev/null 2>&1; then
+    gnuplot ./tmp.gnuplot
+  else
+    # ASCII fallback: PASS% as a 50-col bar per finished round
+    awk '{n=int(\$2/2); b=""; for(i=0;i<n;i++) b=b"#";
+          printf "ITER[%3d] PASS %5.1f%% |%-50s|\n", \$1, \$2, b}' raw
+  fi
+fi
+tail -20 raw | sed -e 's/\([0-9]\+\) *\([0-9]*\.[0-9]\) *\([0-9]*\.[0-9]\)\$/ITER[\1] PASS = \2% OPT = \3%/g'
+NTR=\$(grep -c TRAINING ./log 2>/dev/null || echo 0)
+XTR=\$(awk "BEGIN{printf \"%.1f\", 100*\$NTR/$N_TRAIN}")
+XOP=\$(awk "BEGIN{printf \"%d\", -1 + 10*\$NTR/$N_TRAIN}")
+if [ "\$XOP" -lt 0 ]; then
+  MOP=".........."
+else
+  MOP=\$(seq 0 9 | sed -e "s/[0-\$XOP]/#/g" -e 's/[0-9]/./g' | tr -d '\n')
+fi
+echo "ITER[\$IDX] [\$MOP](\$XTR%)"
+!
+chmod +x ./tmp.mon
 rm -f raw log results
-touch raw
+touch raw log
+WPID=""
+if [ -t 1 ] && [ "${MONITOR:-1}" = "1" ] && command -v watch >/dev/null 2>&1; then
+  watch -t -n5 ./tmp.mon &
+  WPID=$!
+  # every exit path (Ctrl-C, crash, normal end) reaps the monitor
+  trap '[ -n "$WPID" ] && kill $WPID 2>/dev/null' EXIT INT TERM
+fi
 # first pass
 eval $TRAIN $FIRST_TRAIN_ARG &> log
 sed -e 's/^\[init\].*/[init] kernel.opt/g' -e 's/^\[seed\].*/[seed] 0/g' mnist_ann.conf > cont_mnist_ann.conf
@@ -69,4 +113,7 @@ for IDX in $(seq 1 $ROUNDS); do
   echo "$IDX $XRS $XOK" >> raw
   echo "ITER[$IDX] PASS = $XRS% OPT = $XOK%"
 done
+if [ -n "$WPID" ]; then
+  sleep 6  # let the monitor render the final round before the EXIT trap kills it
+fi
 echo "All DONE!"
