@@ -56,16 +56,22 @@ def batched_grads(weights, xs, ts, kind: str, mask=None):
     acts = jax.vmap(lambda x: steps.forward(weights, x, kind))(xs)
     errs = steps.error(acts[-1], ts, kind)
     ds = jax.vmap(lambda a, t: steps.deltas(weights, a, t, kind))(acts, ts)
+    # Row count and mean error accumulate in at-least-f32: under [dtype]
+    # bf16, sums of >256 ones are not representable and the mean-gradient
+    # scale would silently drift.  Never downcast (f64 parity paths keep
+    # their precision).
+    acc = jnp.promote_types(errs.dtype, jnp.float32)
     if mask is None:
-        denom = xs.shape[0]
-        err = jnp.sum(errs) / denom
+        denom = jnp.asarray(xs.shape[0], acc)
+        err = jnp.sum(errs.astype(acc)) / denom
     else:
-        denom = jnp.maximum(jnp.sum(mask), 1.0)
-        err = jnp.sum(errs * mask) / denom
-        ds = tuple(d * mask[:, None] for d in ds)
+        denom = jnp.maximum(jnp.sum(mask.astype(acc)), 1.0)
+        err = jnp.sum(errs.astype(acc) * mask.astype(acc)) / denom
+        ds = tuple(d * mask[:, None].astype(d.dtype) for d in ds)
     hs = (xs, *acts[:-1])
-    grads = tuple(d.T @ h / denom for d, h in zip(ds, hs))
-    return grads, err
+    grads = tuple(((d.T @ h).astype(acc) / denom).astype(d.dtype)
+                  for d, h in zip(ds, hs))
+    return grads, err.astype(errs.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("kind",))

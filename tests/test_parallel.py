@@ -225,3 +225,36 @@ def test_dp_api_pads_odd_batch(tmp_path, capsys):
     assert "TRAINING BATCH" in out
     assert out.count("TRAINING BATCH") == 3  # ceil(13/5): tail trains too
     assert "padding" in out  # 5 % 8 != 0 -> masked rows, loud notice
+
+
+def test_dp_bf16_large_batch_denominator():
+    """ADVICE r2 (medium): with [dtype] bf16 and >256 real rows, the mean
+    denominator must count rows exactly (bf16 integers saturate at 256).
+    A saturated denominator scales the mean gradient by real/256 -- here
+    1.5x -- so comparing against an f32 run at loose tolerance catches it."""
+    from hpnn_tpu.parallel.dp import batched_grads
+
+    b, pad = 384, 128
+    rng = np.random.default_rng(31)
+    ws32 = _net([8, 6, 4], seed=29)
+    xs = rng.uniform(-1, 1, (b + pad, 8))
+    ts_np = -np.ones((b + pad, 4))
+    ts_np[np.arange(b + pad), rng.integers(0, 4, b + pad)] = 1.0
+    mask_np = np.concatenate([np.ones(b), np.zeros(pad)])
+
+    g32, e32 = batched_grads(
+        tuple(w.astype(jnp.float32) for w in ws32),
+        jnp.asarray(xs, jnp.float32), jnp.asarray(ts_np, jnp.float32),
+        "ANN", jnp.asarray(mask_np, jnp.float32))
+    g16, e16 = batched_grads(
+        tuple(w.astype(jnp.bfloat16) for w in ws32),
+        jnp.asarray(xs, jnp.bfloat16), jnp.asarray(ts_np, jnp.bfloat16),
+        "ANN", jnp.asarray(mask_np, jnp.bfloat16))
+    # bf16 carries ~3 decimal digits; a 1.5x denominator error is far
+    # outside this band while healthy rounding noise is inside it
+    np.testing.assert_allclose(float(e16), float(e32), rtol=0.1)
+    for a, c in zip(g16, g32):
+        ref = np.asarray(c, np.float32)
+        got = np.asarray(a, np.float32)
+        scale = np.abs(ref).max()
+        assert np.abs(got - ref).max() < 0.1 * scale

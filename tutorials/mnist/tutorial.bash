@@ -90,8 +90,10 @@ WPID=""
 if [ -t 1 ] && [ "${MONITOR:-1}" = "1" ] && command -v watch >/dev/null 2>&1; then
   watch -t -n5 ./tmp.mon &
   WPID=$!
-  # every exit path (Ctrl-C, crash, normal end) reaps the monitor
-  trap '[ -n "$WPID" ] && kill $WPID 2>/dev/null' EXIT INT TERM
+  # every exit path reaps the monitor; Ctrl-C must also abort the round
+  # loop (a bare INT trap would swallow bash's default exit-on-SIGINT)
+  trap '[ -n "$WPID" ] && kill $WPID 2>/dev/null' EXIT
+  trap '[ -n "$WPID" ] && kill $WPID 2>/dev/null; exit 130' INT TERM
 fi
 # first pass
 eval $TRAIN $FIRST_TRAIN_ARG &> log
