@@ -1,0 +1,143 @@
+"""Python entry points for the native C shim (native/hpnn_shim.c).
+
+The C library serves the reference's FULL ``_NN(a,b)`` surface
+(``/root/reference/include/libhpnn.h:123-228``); every call lands here or
+in :mod:`hpnn_tpu.api` / :mod:`hpnn_tpu.runtime`.  These helpers exist so
+the C side stays a dumb dispatcher: enum<->string mapping, lazy handle
+creation, and the varargs-unpacked kernel lifecycle all live in Python.
+
+Enum values mirror the reference header exactly (nn_type, nn_train --
+``libhpnn.h:51-67``); the C shim passes the raw ints.
+"""
+
+from __future__ import annotations
+
+from .api import NNDef, dump_kernel_def
+from .io.conf import (
+    NN_TRAIN_BP,
+    NN_TRAIN_BPM,
+    NN_TRAIN_CG,
+    NN_TRAIN_SPLX,
+    NN_TRAIN_UKN,
+    NN_TYPE_ANN,
+    NN_TYPE_LNN,
+    NN_TYPE_SNN,
+    NN_TYPE_UKN,
+    NNConf,
+    dump_conf,
+)
+from .io.kernel_io import load_kernel
+from .io.samples import read_sample
+from .models.kernel import generate_kernel
+from .utils.nn_log import nn_out
+
+_TYPE_TO_INT = {NN_TYPE_ANN: 0, NN_TYPE_LNN: 1, NN_TYPE_SNN: 2,
+                NN_TYPE_UKN: -1}
+_INT_TO_TYPE = {v: k for k, v in _TYPE_TO_INT.items()}
+_TRAIN_TO_INT = {NN_TRAIN_BP: 0, NN_TRAIN_BPM: 1, NN_TRAIN_CG: 2,
+                 NN_TRAIN_SPLX: 3, NN_TRAIN_UKN: -1}
+_INT_TO_TRAIN = {v: k for k, v in _TRAIN_TO_INT.items()}
+
+
+def new_nndef() -> NNDef:
+    """A blank handle for _NN(init,conf)-style construction."""
+    return NNDef(conf=NNConf())
+
+
+def conf_as_tuple(nn: NNDef):
+    """Mirror-sync pull: (name, type, need_init, seed, f_kernel, train,
+    samples, tests) with enums as reference ints."""
+    c = nn.conf
+    return (c.name, _TYPE_TO_INT.get(c.type, -1), int(bool(c.need_init)),
+            int(c.seed), c.f_kernel, _TRAIN_TO_INT.get(c.train, -1),
+            c.samples, c.tests)
+
+
+def conf_set(nn: NNDef, key: str, value) -> None:
+    """Mirror-sync push from the C accessors; enum ints map to strings."""
+    c = nn.conf
+    if key == "type":
+        c.type = _INT_TO_TYPE.get(int(value), NN_TYPE_UKN)
+    elif key == "train":
+        c.train = _INT_TO_TRAIN.get(int(value), NN_TRAIN_UKN)
+    elif key == "need_init":
+        c.need_init = bool(value)
+    elif key == "seed":
+        c.seed = int(value)
+    elif key in ("name", "f_kernel", "samples", "tests"):
+        setattr(c, key, value)
+    else:  # pragma: no cover - C side only passes the keys above
+        raise KeyError(key)
+
+
+def generate_kernel_dims(nn: NNDef, n_inputs: int, n_outputs: int,
+                         hiddens) -> bool:
+    """_NN(generate,kernel) (libhpnn.c:954-980): build from explicit dims,
+    honoring conf.seed and writing the effective seed back (the reference
+    passes &_CONF.seed into ann_generate)."""
+    if nn.conf.type not in (NN_TYPE_ANN, NN_TYPE_SNN):
+        return False
+    if n_inputs <= 0 or n_outputs <= 0 or not hiddens:
+        return False
+    kernel, eff_seed = generate_kernel(
+        nn.conf.seed, int(n_inputs), [int(h) for h in hiddens],
+        int(n_outputs), name="(null)")
+    nn.conf.seed = eff_seed
+    nn.kernel = kernel
+    nn_out(f"[CPU] ANN total allocation: {kernel.allocation_bytes} "
+           "(bytes)\n")
+    return True
+
+
+def load_kernel_file(nn: NNDef) -> bool:
+    """_NN(load,kernel) (libhpnn.c:981-996)."""
+    if nn.conf.f_kernel is None:
+        return False
+    if nn.conf.type not in (NN_TYPE_ANN, NN_TYPE_SNN):
+        return False
+    kernel = load_kernel(nn.conf.f_kernel)
+    if kernel is None:
+        return False
+    nn.kernel = kernel
+    nn_out(f"[CPU] ANN total allocation: {kernel.allocation_bytes} "
+           "(bytes)\n")
+    return True
+
+
+def free_kernel(nn: NNDef) -> None:
+    """_NN(free,kernel) (libhpnn.c:941-953)."""
+    nn.kernel = None
+
+
+def dump_kernel_to(nn: NNDef, pyfile) -> bool:
+    if nn.kernel is None:
+        return False
+    return dump_kernel_def(nn, pyfile)
+
+
+def dump_conf_to(nn: NNDef, pyfile) -> None:
+    """_NN(dump,conf) (libhpnn.c:885-937)."""
+    dump_conf(nn.conf, pyfile, kernel=nn.kernel)
+
+
+def get_n_hiddens(nn: NNDef) -> int:
+    return nn.kernel.n_hiddens if nn.kernel else 0
+
+
+def get_h_neurons(nn: NNDef, layer: int) -> int:
+    """_NN(get,h_neurons): neuron count of hidden layer `layer`
+    (0-based index into the hidden stack, libhpnn.c:1040-1053)."""
+    if nn.kernel is None:
+        return 0
+    hid = nn.kernel.hiddens
+    if layer >= len(hid):
+        return 0
+    return int(hid[int(layer)])
+
+
+def read_sample_lists(path: str):
+    """_NN(read,sample): (list_in, list_out) or None on failure."""
+    vec_in, vec_out = read_sample(path)
+    if vec_in is None or vec_out is None:
+        return None
+    return [float(v) for v in vec_in], [float(v) for v in vec_out]

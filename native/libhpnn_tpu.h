@@ -1,58 +1,20 @@
-/* libhpnn_tpu -- C API of the TPU-native libhpnn rebuild.
+/* libhpnn_tpu.h -- alias for the full public header.
  *
- * Mirrors the reference's public surface (/root/reference/include/libhpnn.h):
- * the `_NN(a,b)` token-pasting convention and the subset of entry points the
- * in-tree programs (train_nn.c, run_nn.c) use.  A C program written against
- * the reference header compiles against this one unchanged; the calls are
- * served by the JAX/XLA engine through an embedded CPython interpreter
- * (see hpnn_shim.c).
+ * Earlier rounds exposed a subset API under this name; the complete
+ * reference-compatible surface now lives in include/libhpnn.h (every
+ * _NN(a,b) entry point of /root/reference/include/libhpnn.h:123-228).
  *
- * The Python package root defaults to the compile-time HPNN_PYROOT and can
- * be overridden with the HPNN_PYROOT environment variable.
+ * BREAKING vs the round-2 subset header (prototypes now match the
+ * REFERENCE exactly):
+ *   UINT nn_get_mpi_tasks(void)      -> BOOL nn_get_mpi_tasks(UINT *)
+ *   UINT nn_get_curr_mpi_task(void)  -> BOOL nn_get_curr_mpi_task(UINT *)
+ *   BOOL nn_dump_kernel(...)         -> void nn_dump_kernel(...)
+ *   UINT nn_return_verbose(void)     -> SHORT nn_return_verbose(void)
+ * Recompile programs that used those; nn_free_conf is kept.
  */
 #ifndef LIBHPNN_TPU_H
 #define LIBHPNN_TPU_H
 
-#include <stdio.h>
+#include <libhpnn.h>
 
-#ifdef __cplusplus
-extern "C" {
-#endif
-
-#define _NN(a,b) nn_##a##_##b
-
-typedef unsigned int UINT;
-typedef double DOUBLE;
-typedef int BOOL;
-
-/* opaque handle equivalent to the reference's nn_def */
-typedef struct nn_def_ nn_def;
-
-/* runtime (libhpnn.c:58-539) */
-int  nn_init_all(UINT init_verbose);
-int  nn_deinit_all(void);
-void nn_inc_verbose(void);
-void nn_dec_verbose(void);
-UINT nn_return_verbose(void);
-void nn_toggle_dry(void);          /* no-op, as the reference (libhpnn.c:88) */
-BOOL nn_set_omp_threads(UINT n);
-BOOL nn_set_omp_blas(UINT n);
-BOOL nn_set_cuda_streams(UINT n);  /* shard-count alias on TPU */
-UINT nn_get_mpi_tasks(void);
-UINT nn_get_curr_mpi_task(void);
-
-/* configuration / kernel lifecycle (libhpnn.c:540-1008) */
-nn_def *nn_load_conf(const char *filename);
-void    nn_free_conf(nn_def *neural);
-BOOL    nn_dump_kernel(nn_def *neural, FILE *out);
-UINT    nn_get_n_inputs(nn_def *neural);
-UINT    nn_get_n_outputs(nn_def *neural);
-
-/* drivers (libhpnn.c:1149-1536) */
-BOOL nn_train_kernel(nn_def *neural);
-void nn_run_kernel(nn_def *neural);
-
-#ifdef __cplusplus
-}
-#endif
 #endif /* LIBHPNN_TPU_H */
