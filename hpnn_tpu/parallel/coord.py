@@ -1,0 +1,67 @@
+"""Multi-process load-failure coordination.
+
+The reference's only distributed-failure protocol is the kernel-load
+bailout handshake: rank 0 parses the kernel file and, on error, sends a
+bailout flag to every slave before any collective runs, so slaves exit
+cleanly instead of blocking in MPI_Bcast
+(``/root/reference/src/ann.c:242-248,549-556``).
+
+This framework has no rank-0 parse hub -- every process reads the
+shared-filesystem conf/kernel/samples itself -- so the failure mode is
+rank-DIVERGENT: one process fails to parse (missing file, corrupt line)
+while the others proceed into a collective and block forever.  The
+TPU-native handshake is a status all-gather: before any driver
+collective, every process contributes (ok, fingerprint) and everyone
+agrees to proceed only if ALL processes loaded successfully AND loaded
+the SAME shapes.  One extra tiny collective per driver call, zero cost
+single-process.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..utils.nn_log import nn_error
+
+
+def agree_all(ok: bool, fingerprint=()) -> bool:
+    """All-process agreement gate (the ann.c:242-248 bailout analog).
+
+    Every process MUST call this at the same point in the driver (it is a
+    collective), and ``fingerprint`` must have the SAME length on every
+    process (it is all-gathered as one fixed-width vector).  Returns True
+    iff every process reports ``ok`` and all fingerprints (shape/count
+    tuples) are identical.  Single-process (no HPNN_DISTRIBUTED -- the
+    same opt-in signal init_all uses): returns ``ok`` untouched without
+    importing jax.
+    """
+    import os
+
+    if not os.environ.get("HPNN_DISTRIBUTED"):
+        return bool(ok)
+    import jax
+
+    if jax.process_count() == 1:
+        return bool(ok)
+    from jax.experimental import multihost_utils
+
+    # int64: counts (samples, weights) must compare exactly -- float32
+    # would collapse values past 2**24
+    vec = np.asarray([1 if ok else 0, *map(int, fingerprint)], np.int64)
+    try:
+        gathered = multihost_utils.process_allgather(vec)
+    except Exception as exc:  # pragma: no cover - coordination failure
+        nn_error(f"process agreement failed: {exc}\n")
+        return False
+    gathered = np.asarray(gathered).reshape(jax.process_count(), -1)
+    if not (gathered[:, 0] == 1).all():
+        bad = np.nonzero(gathered[:, 0] != 1)[0].tolist()
+        if ok:  # this process was fine; a peer failed
+            nn_error("aborting: load failed on process(es) "
+                     f"{bad} (coordinated bailout)\n")
+        return False
+    if not (gathered == gathered[0]).all():
+        nn_error("aborting: processes loaded DIFFERENT data "
+                 f"(fingerprints {gathered.tolist()})\n")
+        return False
+    return True

@@ -7,6 +7,17 @@ is XLA's host-platform device multiplier.  Must be set before jax import.
 
 import os
 
+# Snapshot the AMBIENT chip signal before any jax import: the TPU plugin
+# itself injects TPU_* env vars at import time, so test_tpu.py's
+# "should a probe failure be loud?" question must be answered from the
+# pre-import environment.
+_ambient = os.environ.get("JAX_PLATFORMS", "")
+os.environ.setdefault(
+    "HPNN_TPU_EXPECTED",
+    "1" if (any(p in _ambient for p in ("tpu", "axon"))
+            or any(k.startswith(("TPU_", "PALLAS_AXON"))
+                   for k in os.environ)) else "0")
+
 # Force CPU for tests even when the environment selects a TPU platform
 # (bench.py and the graft entry use the ambient platform instead).  The env
 # var alone is not enough here: the image's sitecustomize registers the TPU

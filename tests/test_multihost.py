@@ -43,10 +43,14 @@ assert jax.process_count() == {nprocs}, jax.process_count()
 assert jax.device_count() == {nprocs} * jax.local_device_count()
 nn_log.set_verbosity(2)
 os.chdir({workdir!r})
-nn = configure("nn.conf")
-assert nn is not None
+nn = configure(os.environ.get("HPNN_TEST_CONF", "nn.conf"))
+if nn is None:
+    print("WORKER_BAILOUT", jax.process_index(), flush=True)
+    sys.exit(7)
 ok = train_kernel(nn)
-assert ok
+if not ok:
+    print("WORKER_TRAINFAIL", jax.process_index(), flush=True)
+    sys.exit(8)
 out = "kernel.opt.rank%d" % jax.process_index()
 with open(out, "w") as fp:
     dump_kernel_def(nn, fp)
@@ -90,7 +94,7 @@ def _make_corpus(root, n=16, n_in=10, n_out=4, seed=3):
         """))
 
 
-def _run_procs(workdir, nprocs):
+def _run_procs(workdir, nprocs, rank_env=None):
     port = _free_port()
     code = WORKER.format(repo=REPO, nprocs=nprocs, workdir=workdir)
     procs = []
@@ -104,6 +108,8 @@ def _run_procs(workdir, nprocs):
             "HPNN_NUM_PROCESSES": str(nprocs),
             "HPNN_PROCESS_ID": str(rank),
         })
+        if rank_env is not None:
+            env.update(rank_env[rank])
         procs.append(subprocess.Popen(
             [sys.executable, "-c", code], env=env, cwd=workdir,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
@@ -171,3 +177,68 @@ def test_two_process_dp_matches_single(tmp_path):
     # reduction order may differ at the last fp64 ulp per step
     for a, b in zip(w_r0, w_s):
         np.testing.assert_allclose(a, b, rtol=0, atol=1e-12)
+
+
+def test_four_process_dp_matches_single(tmp_path):
+    """Wider scale-out (VERDICT r2 next-round 6): 4 coordinated processes,
+    one device each, same weights as the single-process run."""
+    four = tmp_path / "four"
+    one = tmp_path / "one"
+    for d in (four, one):
+        d.mkdir()
+        _make_corpus(str(d))
+
+    outs = _run_procs(str(four), nprocs=4)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {rank} failed:\n{err[-3000:]}"
+        assert f"WORKER_DONE {rank}" in out
+    _run_single(str(one))
+    w_r = [_load_weights(str(four / f"kernel.opt.rank{r}"))
+           for r in range(4)]
+    w_s = _load_weights(str(one / "kernel.opt.rank0"))
+    for r in range(1, 4):
+        for a, b in zip(w_r[0], w_r[r]):
+            np.testing.assert_array_equal(a, b)
+    for a, b in zip(w_r[0], w_s):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-12)
+
+
+def test_load_failure_coordinated_bailout(tmp_path):
+    """Rank-divergent load failure: one process's conf points at a missing
+    kernel file; EVERY process must exit cleanly (the reference's MPI
+    bailout handshake, ann.c:242-248,549-556 -- VERDICT r2 missing 4)
+    instead of the healthy ranks blocking in the gradient all-reduce."""
+    wd = tmp_path / "bail"
+    wd.mkdir()
+    _make_corpus(str(wd))
+    # rank 2 loads a conf whose [init] names a nonexistent kernel file
+    bad = (wd / "nn.conf").read_text().replace("[init] generate",
+                                               "[init] missing.kernel")
+    (wd / "bad.conf").write_text(bad)
+    rank_env = [{}, {}, {"HPNN_TEST_CONF": "bad.conf"}, {}]
+    outs = _run_procs(str(wd), nprocs=4, rank_env=rank_env)
+    for rank, (rc, out, err) in enumerate(outs):
+        # nobody hangs (communicate() returned) and nobody "succeeds"
+        assert rc == 7, (rank, rc, err[-2000:])
+        assert f"WORKER_BAILOUT {rank}" in out
+    # the healthy ranks named the guilty one
+    assert any("load failed on process(es) [2]" in out + err
+               for _, out, err in outs)
+
+
+def test_train_time_failure_coordinated_bailout(tmp_path):
+    """Rank-divergent SAMPLE DIRECTORY: conf parses everywhere but one
+    rank's sample_dir is missing.  train_kernel's agreement gate must pull
+    every rank out before the gradient all-reduce (the review-caught
+    deadlock: early returns skipping the gate)."""
+    wd = tmp_path / "tbail"
+    wd.mkdir()
+    _make_corpus(str(wd))
+    bad = (wd / "nn.conf").read_text().replace(
+        "[sample_dir] ./samples", "[sample_dir] ./no_such_dir")
+    (wd / "bad.conf").write_text(bad)
+    rank_env = [{}, {"HPNN_TEST_CONF": "bad.conf"}, {}, {}]
+    outs = _run_procs(str(wd), nprocs=4, rank_env=rank_env)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 8, (rank, rc, err[-2000:])
+        assert f"WORKER_TRAINFAIL {rank}" in out

@@ -258,3 +258,29 @@ def test_dp_bf16_large_batch_denominator():
         got = np.asarray(a, np.float32)
         scale = np.abs(ref).max()
         assert np.abs(got - ref).max() < 0.1 * scale
+
+
+def test_dp_train_epoch_pads_tail():
+    """dp_train_epoch with S not divisible by n_batches trains EVERY
+    sample: 13 samples / 4 batches pads to 4x4 with 3 masked rows, and the
+    result equals training the same 13 samples explicitly padded."""
+    from hpnn_tpu.parallel import dp_train_epoch
+    from hpnn_tpu.parallel.dp import dp_train_epoch_batched
+
+    rng = np.random.default_rng(37)
+    ws = _net([6, 5, 3], seed=41)
+    xs = jnp.asarray(rng.uniform(-1, 1, (13, 6)))
+    ts_np = -np.ones((13, 3))
+    ts_np[np.arange(13), rng.integers(0, 3, 13)] = 1.0
+    ts = jnp.asarray(ts_np)
+
+    w_got, _ = dp_train_epoch(ws, xs, ts, "ANN", False, n_batches=4,
+                              lr=0.01)
+    xp = jnp.concatenate([xs, jnp.zeros((3, 6), xs.dtype)])
+    tp = jnp.concatenate([ts, jnp.zeros((3, 3), ts.dtype)])
+    mp = jnp.concatenate([jnp.ones(13, xs.dtype), jnp.zeros(3, xs.dtype)])
+    w_want, _ = dp_train_epoch_batched(
+        ws, xp.reshape(4, 4, -1), tp.reshape(4, 4, -1), mp.reshape(4, 4),
+        "ANN", False, 0.01)
+    for a, b in zip(w_got, w_want):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
