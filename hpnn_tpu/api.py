@@ -64,7 +64,7 @@ from .io.kernel_io import dump_kernel, load_kernel
 from .io.samples import list_sample_dir, read_sample
 from .models.kernel import Kernel, generate_kernel
 from .utils.glibc_random import GlibcRandom, shuffled_indices
-from .utils.nn_log import nn_cout, nn_dbg, nn_error, nn_out
+from .utils.nn_log import nn_cout, nn_dbg, nn_error, nn_out, nn_warn
 
 
 @dataclasses.dataclass
@@ -268,12 +268,14 @@ def train_kernel(nn: NNDef) -> bool:
     # (sample count, dims): all ranks must have loaded the SAME corpus.
     from .parallel.coord import agree_all
 
+    if names is None:
+        # the failing rank names its own cause BEFORE the collective gate
+        nn_error(f"can't open sample directory: {conf.samples}\n")
     if not agree_all(names is not None,
                      (0 if xs is None else xs.shape[0],
                       nn.kernel.n_inputs, nn.kernel.n_outputs)):
         return False
     if names is None:
-        nn_error(f"can't open sample directory: {conf.samples}\n")
         return False
     def finish() -> bool:
         # the tail the reference always runs (libhpnn.c:1291-1301):
@@ -300,12 +302,26 @@ def train_kernel(nn: NNDef) -> bool:
     # LNN trains through the SNN fallthrough (libhpnn.c:1260-1261)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
 
+    model_shards = _model_shards(conf)
     if conf.batch > 0:
         # [batch] B extension: data-parallel minibatch training (new
         # capability, BASELINE.json config 5) -- batches split over the
         # mesh's data axis, gradient all-reduce compiled by XLA.  The
         # per-sample convergence grammar does not apply; one line per batch.
+        # Interaction with [model]: DP wins -- minibatch training has no
+        # per-sample convergence loop to row-shard, and hybrid
+        # (data x model) meshes are a dryrun-only configuration for now.
+        if model_shards > 1:
+            nn_warn("[model] ignored: [batch] selects data-parallel "
+                    "training\n")
         return _train_kernel_dp(nn, weights, xs, ts, kind, momentum, finish)
+
+    if model_shards > 1:
+        # [model] N / -S N: the reference's intra-layer row sharding
+        # (its ONLY distributed strategy, ann.c:913-936 dispatched from
+        # libhpnn.c:1243-1283), reachable from the production driver.
+        return _train_kernel_tp(nn, weights, xs, ts, kind, momentum,
+                                events, finish, model_shards)
 
     # the Pallas VMEM-persistent kernel serves f32/bf16 on TPU, the XLA
     # path serves fp64 parity and other backends (ops.select_train_epoch)
@@ -314,7 +330,25 @@ def train_kernel(nn: NNDef) -> bool:
         weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
         kind, momentum, alpha=0.2)  # alpha=.2 from the driver (libhpnn.c:1248)
 
-    # reconstruct the per-sample console stream
+    _emit_training_lines(events, stats, kind, momentum)
+    nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
+    return finish()
+
+
+def _model_shards(conf: NNConf) -> int:
+    """Row-sharding degree: [model] N wins; else the -S knob (the
+    reference's streams-per-GPU row split, train_nn.c -S -> stream count
+    feeding red=N/total_s, cuda_ann.cu:536-537)."""
+    if conf.model > 0:
+        return conf.model
+    from . import runtime
+
+    return runtime.lib_runtime.n_streams
+
+
+def _emit_training_lines(events, stats, kind: str, momentum: bool) -> None:
+    """Reconstruct the reference's per-sample console stream from scanned
+    statistics (grammar: ann.c:2322-2366, snn.c:1496-1499)."""
     init_err = np.asarray(stats.init_err, dtype=np.float64)
     first_ok = np.asarray(stats.first_ok)
     n_iter = np.asarray(stats.n_iter)
@@ -337,7 +371,52 @@ def train_kernel(nn: NNDef) -> bool:
         if final_dep[i] > 0.1:
             nn_dbg("bad optimization!\n")
 
-    nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
+
+def _clamped_model_mesh(shards: int):
+    """(mesh, shards) for an N-way model axis, clamped to visible devices
+    with a warning -- shared by the TP train and eval routes."""
+    import jax
+
+    from .parallel import make_mesh
+
+    ndev = jax.device_count()
+    if shards > ndev:
+        nn_warn(f"[model] {shards} > {ndev} visible device(s); "
+                f"using {ndev}\n")
+        shards = ndev
+    return make_mesh(n_data=1, n_model=shards), shards
+
+
+def _train_kernel_tp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
+                     events, finish, shards: int) -> bool:
+    """Tensor-parallel per-sample training ([model] N / -S N).
+
+    Builds a model-axis mesh and runs the whole epoch through
+    ``tp_train_epoch``: every sample's convergence while-loop runs SPMD
+    with the weight rows sharded ``P('model', None)`` and XLA-inserted
+    all-gathers per layer -- the reference's strategy (``ann.c:913-936``),
+    with zero-padding replacing its redundant remainder rows.  Weights
+    stay resident on the mesh across samples.  Sequential sample order
+    and every update rule are identical to the single-device path, so
+    logs and final weights match it (ulp-level: sharded compilation may
+    fuse differently).
+    """
+    import jax.numpy as jnp
+
+    from .ops.convergence import SampleStats
+    from .parallel import tp_train_epoch
+
+    mesh, shards = _clamped_model_mesh(shards)
+    dtype = weights[0].dtype
+    w, per_sample = tp_train_epoch(
+        weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
+        kind, momentum, mesh, alpha=0.2)
+    stats = SampleStats(*[np.asarray([getattr(s, f) for s in per_sample])
+                          for f in SampleStats._fields])
+    # events' row index i is assigned in load order, so the i-th loaded
+    # row is the i-th stats entry
+    _emit_training_lines(events, stats, kind, momentum)
+    nn.kernel.weights = [np.asarray(v, dtype=np.float64) for v in w]
     return finish()
 
 
@@ -455,10 +534,22 @@ def run_kernel(nn: NNDef) -> None:
     weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
     # LNN evaluates through the SNN branch (libhpnn.c:1455-1456)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
-    run_batch_fn, _ = ops.select_run_batch(dtype)
-    outs = np.asarray(
-        run_batch_fn(weights, jnp.asarray(xs, dtype=dtype), kind),
-        dtype=np.float64)
+    model_shards = _model_shards(conf)
+    if model_shards > 1:
+        # [model] N / -S N: row-sharded evaluation -- the reference's
+        # run path splits the same rows across ranks/streams
+        # (libhpnn.c:1426 -> ann.c:913-936)
+        from .parallel import tp_run_batch
+
+        mesh, _ = _clamped_model_mesh(model_shards)
+        outs = np.asarray(
+            tp_run_batch(weights, jnp.asarray(xs, dtype=dtype), kind, mesh),
+            dtype=np.float64)
+    else:
+        run_batch_fn, _ = ops.select_run_batch(dtype)
+        outs = np.asarray(
+            run_batch_fn(weights, jnp.asarray(xs, dtype=dtype), kind),
+            dtype=np.float64)
 
     n_out = nn.kernel.n_outputs
     for line, i in events:

@@ -56,6 +56,9 @@ class NNConf:
     # extensions beyond the reference (absent keywords leave defaults):
     batch: int = 0        # [batch] N  -> batched data-parallel training (new)
     dtype: str = "f64"    # [dtype] f64|f32|bf16 -> compute precision (new)
+    model: int = 0        # [model] N -> N-way tensor (row) sharding -- the
+    #                       reference's MPI/stream strategy (ann.c:913-936),
+    #                       reachable from the conf; 0 = -S knob / off
 
 
 def _clean(value: str) -> str:
@@ -171,6 +174,13 @@ def parse_conf(fp: IO[str]) -> NNConf | None:
             conf.batch = v
         if "[dtype" in line:
             conf.dtype = _clean(_after(line, "[dtype")) or "f64"
+        if "[model" in line:
+            v = _get_uint(_after(line, "[model"))
+            if v is None:
+                nn_error("Malformed NN configuration file!\n")
+                nn_error(f"[model] value: {_after(line, '[model').strip()}\n")
+                return None
+            conf.model = v
     if conf.type == NN_TYPE_UKN:
         nn_error("Malformed NN configuration file!\n")
         nn_error("[type] unknown or missing...\n")

@@ -20,6 +20,8 @@ from .tp import (
     tp_forward,
     tp_forward_colsharded,
     tp_forward_explicit,
+    tp_run_batch,
+    tp_train_epoch,
     tp_train_sample,
 )
 
@@ -28,7 +30,7 @@ __all__ = [
     "make_mesh", "batch_sharding", "global_array", "replicated",
     "row_sharding", "shard_weights",
     "tp_forward", "tp_forward_colsharded", "tp_forward_explicit",
-    "tp_train_sample",
+    "tp_run_batch", "tp_train_epoch", "tp_train_sample",
     "batched_grads", "dp_shard", "dp_train_epoch",
     "dp_train_epoch_batched", "dp_train_step", "dp_train_step_momentum",
 ]

@@ -100,6 +100,46 @@ def tp_train_sample(weights, x, t, kind: str, momentum: bool, mesh, **kw):
     return unpad_topology(new_w, orig), stats
 
 
+def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
+    """Sequential per-sample convergence training, weights RESIDENT on the
+    mesh: pad+shard once, train every sample through the cached SPMD
+    convergence program (weights stay sharded between samples -- no
+    per-sample host or reshard round-trip), unpad once at the end.
+
+    The production [model]-driver path.  Returns (weights, [SampleStats]).
+    """
+    sharded, orig = _shard_padded(weights, mesh)
+    shardings = tuple(layer_sharding(w, mesh) for w in sharded)
+    fn = _tp_train_fn(kind, momentum, shardings, tuple(sorted(kw.items())))
+    rep = replicated(mesh)
+    stats = []
+    for x, t in zip(xs, ts):
+        sharded, st = fn(sharded, jax.device_put(x, rep),
+                         jax.device_put(t, rep))
+        stats.append(st)
+    return unpad_topology(sharded, orig), stats
+
+
+@functools.lru_cache(maxsize=64)
+def _tp_run_batch_fn(kind: str, out_sharding):
+    from ..ops import steps
+
+    return jax.jit(functools.partial(steps.batched_forward, kind=kind),
+                   out_shardings=out_sharding)
+
+
+def tp_run_batch(weights, xs, kind: str, mesh):
+    """Row-sharded batched evaluation: the same GEMM chain as the
+    replicated eval path with weights placed ``P('model', None)`` (padded
+    to divide evenly), XLA inserting the per-layer gathers the reference
+    issued by hand (``ann.c:925`` from ``libhpnn.c:1426``).  The output
+    layer is never padded (mesh.pad_topology), so no slicing is needed."""
+    sharded, _orig = _shard_padded(weights, mesh)
+    rep = replicated(mesh)
+    fn = _tp_run_batch_fn(kind, rep)
+    return fn(sharded, jax.device_put(jnp.asarray(xs), rep))
+
+
 def _pad_rows(w, k: int):
     n = w.shape[0]
     pad = (-n) % k
