@@ -61,7 +61,7 @@ from .io.conf import (
     load_conf,
 )
 from .io.kernel_io import dump_kernel, load_kernel
-from .io.samples import list_sample_dir, read_sample
+from .io.samples import list_sample_dir, read_sample_fast
 from .models.kernel import Kernel, generate_kernel
 from .utils.glibc_random import GlibcRandom, shuffled_indices
 from .utils.nn_log import nn_cout, nn_dbg, nn_error, nn_out, nn_warn
@@ -211,7 +211,8 @@ def _load_ordered(dirpath: str, names: list[str], order: list[int],
         name = names[idx]
         # NN_OUT(stdout,"%s FILE: %16.16s\t") -- printed before the read
         line = f"{header} FILE: {name[:16]:>16}\t"
-        vec_in, vec_out = read_sample(os.path.join(dirpath, name))
+        vec_in, vec_out = read_sample_fast(
+            os.path.join(dirpath, name), n_in, n_out)
         if vec_in is None or vec_out is None:
             events.append((line, None))
             continue

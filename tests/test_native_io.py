@@ -1,0 +1,125 @@
+"""Native sample-loader parity: the C fast path must be indistinguishable
+from the Python parser -- same values on clean files, transparent decline
+(identical results and diagnostics) on every edge case."""
+
+import os
+import shutil
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+from hpnn_tpu.io import samples
+from hpnn_tpu.io.samples import read_sample, read_sample_fast
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE = os.path.join(REPO, "native")
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("gcc") is None or shutil.which("make") is None,
+    reason="needs gcc/make")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def io_lib():
+    r = subprocess.run(["make", "-C", NATIVE, "libhpnn_io.so"],
+                       capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip(f"native build failed: {r.stderr[-300:]}")
+    # reset the module cache so this test run picks up the fresh lib
+    samples._native_lib = None
+    yield
+    samples._native_lib = None
+
+
+def _write(path, text):
+    with open(path, "w") as f:
+        f.write(text)
+    return str(path)
+
+
+CASES = [
+    # (name, content, n_in, n_out)
+    ("clean", "[input] 4\n1.0 2.5 -3 4e-2\n[output] 2\n1.0 -1.0\n", 4, 2),
+    ("multiline", "[input] 4\n1.0 2.5\n-3 4e-2\n[output] 2\n1.0\n-1.0\n",
+     4, 2),
+    ("bracketless", "[input 4\n1 2 3 4\n[output 2\n1 -1\n", 4, 2),
+    ("leading-junk", "# hdr\n\n[input] 2\n5 6\n[output] 1\n1\n", 2, 1),
+    ("exponents", "[input] 3\n1e5 -2.5E-3 0.0\n[output] 1\n-1\n", 3, 1),
+    ("larger-than-hint", "[input] 8\n1 2 3 4 5 6 7 8\n[output] 2\n1 -1\n",
+     4, 2),
+    ("smaller-than-hint", "[input] 2\n1 2\n[output] 1\n1\n", 4, 2),
+    ("zero-count", "[input] 0\n\n[output] 2\n1 -1\n", 4, 2),
+    ("bad-token", "[input] 2\n1 x2\n[output] 2\n1 -1\n", 4, 2),
+    ("short-data", "[input] 4\n1 2\n[output] 2\n1 -1\n", 4, 2),
+    ("no-output", "[input] 2\n1 2\n", 4, 2),
+    ("empty", "", 4, 2),
+    # review-caught divergences: strtol/strtod accept these, Python must win
+    ("float-count", "[input] 4.5\n1 2 3 4\n[output] 2\n1 -1\n", 4, 2),
+    ("junk-count", "[input] 2abc\n1 2\n[output] 2\n1 -1\n", 4, 2),
+    ("hex-token", "[input] 2\n0x1A 2\n[output] 2\n1 -1\n", 4, 2),
+    ("nan-paren", "[input] 2\nnan(123) 2\n[output] 2\n1 -1\n", 4, 2),
+]
+
+
+@pytest.mark.parametrize("name,content,n_in,n_out",
+                         CASES, ids=[c[0] for c in CASES])
+def test_fast_matches_python(tmp_path, capsys, name, content, n_in, n_out):
+    path = _write(tmp_path / "s.txt", content)
+    py_in, py_out = read_sample(path)
+    py_err = capsys.readouterr().err
+    fast_in, fast_out = read_sample_fast(path, n_in, n_out)
+    fast_err = capsys.readouterr().err
+    assert (py_in is None) == (fast_in is None)
+    assert (py_out is None) == (fast_out is None)
+    if py_in is not None:
+        np.testing.assert_array_equal(np.asarray(py_in),
+                                      np.asarray(fast_in))
+    if py_out is not None:
+        np.testing.assert_array_equal(np.asarray(py_out),
+                                      np.asarray(fast_out))
+    # a decline re-reads through Python, so the diagnostics match too
+    assert py_err == fast_err
+
+
+def test_missing_file(tmp_path):
+    py = read_sample(str(tmp_path / "nope"))
+    fast = read_sample_fast(str(tmp_path / "nope"), 4, 2)
+    assert py == (None, None) and fast == (None, None)
+
+
+def test_opt_out_env(tmp_path, monkeypatch):
+    path = _write(tmp_path / "s.txt", "[input] 1\n7\n[output] 1\n1\n")
+    monkeypatch.setenv("HPNN_NO_NATIVE_IO", "1")
+    samples._native_lib = None
+    try:
+        a, b = read_sample_fast(path, 1, 1)
+        assert float(a[0]) == 7.0
+    finally:
+        samples._native_lib = None
+
+
+def test_bulk_speed_and_equality(tmp_path):
+    """The point of the loader: bulk loads are faster AND identical.
+    (Speed asserted loosely -- shared CI boxes jitter.)"""
+    rng = np.random.default_rng(5)
+    n = 150
+    for k in range(n):
+        x = rng.uniform(0, 255, 784)
+        t = -np.ones(10)
+        t[k % 10] = 1.0
+        _write(tmp_path / f"s{k:04d}",
+               "[input] 784\n" + " ".join(f"{v:7.5f}" for v in x)
+               + "\n[output] 10\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    names = sorted(os.listdir(tmp_path))
+    t0 = time.perf_counter()
+    fast = [read_sample_fast(str(tmp_path / nm), 784, 10) for nm in names]
+    t_fast = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    py = [read_sample(str(tmp_path / nm)) for nm in names]
+    t_py = time.perf_counter() - t0
+    for (fi, fo), (pi, po) in zip(fast, py):
+        np.testing.assert_array_equal(fi, pi)
+        np.testing.assert_array_equal(fo, po)
+    assert t_fast < t_py, (t_fast, t_py)
