@@ -349,3 +349,51 @@ def test_model_conf_keyword_cli_parity(tmp_path, capsys):
     compiled = fn.lower(sharded, x, t).compile()
     hlo = compiled.as_text()
     assert "all-gather" in hlo or "all-reduce" in hlo, "no collective in HLO"
+
+
+def test_dash_s_knob_enables_tp(tmp_path, capsys):
+    """-S N (the reference's stream-count row-split knob) now reaches the
+    TP path when no [model] keyword is present: same result as [model] N."""
+    import os
+
+    from hpnn_tpu import runtime
+    from hpnn_tpu.api import configure, train_kernel
+    from hpnn_tpu.utils import nn_log
+
+    rng = np.random.default_rng(23)
+    os.makedirs(tmp_path / "samples")
+    for k in range(4):
+        x = rng.uniform(-1, 1, 10)
+        t = -np.ones(3)
+        t[k % 3] = 1.0
+        with open(tmp_path / "samples" / f"s{k}.txt", "w") as f:
+            f.write("[input] 10\n" + " ".join(f"{v:.6f}" for v in x) + "\n")
+            f.write("[output] 3\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    conf = ("[name] sknob\n[type] ANN\n[init] generate\n[seed] 4\n"
+            "[input] 10\n[hidden] 8\n[output] 3\n[train] BP\n"
+            f"[sample_dir] {tmp_path}/samples\n"
+            f"[test_dir] {tmp_path}/samples\n")
+    (tmp_path / "nn.conf").write_text(conf)
+
+    nn_log.set_verbosity(2)
+    try:
+        runtime.set_cuda_streams(2)  # what train_nn -S 2 calls
+        nn_s = configure(str(tmp_path / "nn.conf"))
+        assert train_kernel(nn_s)
+        out_s = capsys.readouterr().out
+    finally:
+        runtime.set_cuda_streams(1)
+        nn_log.set_verbosity(0)
+    nn_log.set_verbosity(2)
+    try:
+        (tmp_path / "m.conf").write_text(conf + "[model] 2\n")
+        nn_m = configure(str(tmp_path / "m.conf"))
+        assert train_kernel(nn_m)
+        out_m = capsys.readouterr().out
+    finally:
+        nn_log.set_verbosity(0)
+    tr_s = [l for l in out_s.splitlines() if "TRAINING" in l]
+    tr_m = [l for l in out_m.splitlines() if "TRAINING" in l]
+    assert tr_s == tr_m and tr_s
+    for a, b in zip(nn_s.kernel.weights, nn_m.kernel.weights):
+        np.testing.assert_array_equal(a, b)
