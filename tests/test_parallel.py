@@ -379,6 +379,11 @@ def test_dash_s_knob_enables_tp(tmp_path, capsys):
     try:
         runtime.set_cuda_streams(2)  # what train_nn -S 2 calls
         nn_s = configure(str(tmp_path / "nn.conf"))
+        # the routing itself: the knob must reach _model_shards (a dead
+        # knob would still produce identical weights, row sharding being
+        # bitwise -- so assert the dispatch, not just the outcome)
+        from hpnn_tpu.api import _model_shards
+        assert _model_shards(nn_s.conf) == 2
         assert train_kernel(nn_s)
         out_s = capsys.readouterr().out
     finally:
