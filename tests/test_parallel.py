@@ -402,3 +402,43 @@ def test_dash_s_knob_enables_tp(tmp_path, capsys):
     assert tr_s == tr_m and tr_s
     for a, b in zip(nn_s.kernel.weights, nn_m.kernel.weights):
         np.testing.assert_array_equal(a, b)
+
+
+def test_model_conf_deep_net_parity(tmp_path, capsys):
+    """[model] with TWO hidden layers through the production driver: the
+    pad-chain (padded rows feeding padded columns) must stay training-
+    invariant end-to-end, logs byte-identical to the serial run."""
+    import os
+
+    from hpnn_tpu.api import configure, train_kernel
+    from hpnn_tpu.utils import nn_log
+
+    rng = np.random.default_rng(71)
+    os.makedirs(tmp_path / "samples")
+    for k in range(4):
+        x = rng.uniform(-1, 1, 11)
+        t = -np.ones(3)
+        t[k % 3] = 1.0
+        with open(tmp_path / "samples" / f"s{k}.txt", "w") as f:
+            f.write("[input] 11\n" + " ".join(f"{v:.6f}" for v in x) + "\n")
+            f.write("[output] 3\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    base = ("[name] deep\n[type] SNN\n[init] generate\n[seed] 10958\n"
+            "[input] 11\n[hidden] 7 5\n[output] 3\n[train] BP\n"
+            f"[sample_dir] {tmp_path}/samples\n"
+            f"[test_dir] {tmp_path}/samples\n")
+    (tmp_path / "plain.conf").write_text(base)
+    (tmp_path / "tp.conf").write_text(base + "[model] 4\n")
+    logs, weights = {}, {}
+    nn_log.set_verbosity(2)
+    try:
+        for tag in ("plain", "tp"):
+            nn = configure(str(tmp_path / f"{tag}.conf"))
+            assert nn is not None and train_kernel(nn)
+            out = capsys.readouterr().out
+            logs[tag] = [l for l in out.splitlines() if "TRAINING" in l]
+            weights[tag] = [np.asarray(w) for w in nn.kernel.weights]
+    finally:
+        nn_log.set_verbosity(0)
+    assert logs["plain"] == logs["tp"] and logs["plain"]
+    for a, b in zip(weights["plain"], weights["tp"]):
+        assert np.abs(a - b).max() < 1e-12
