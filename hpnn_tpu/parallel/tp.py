@@ -35,11 +35,37 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops import steps
 from .mesh import (
     MODEL_AXIS,
+    global_array,
     layer_sharding,
     pad_topology,
     replicated,
     unpad_topology,
 )
+
+
+def _place(x, sharding, mesh):
+    """device_put single-process; global_array when the mesh spans
+    processes (device_put cannot target non-addressable devices)."""
+    import numpy as np
+
+    del mesh
+    if sharding.is_fully_addressable:
+        return jax.device_put(x, sharding)
+    return global_array(np.asarray(x), sharding)
+
+
+def _localize(tree):
+    """Host copies of (possibly multi-process) replicated arrays: every
+    process holds a full replica of a replicated output, so the local
+    shard IS the value."""
+    import numpy as np
+
+    def leaf(x):
+        if hasattr(x, "is_fully_addressable") and not x.is_fully_addressable:
+            return np.asarray(x.addressable_data(0))
+        return np.asarray(x)
+
+    return jax.tree_util.tree_map(leaf, tree)
 
 
 def _shard_padded(weights, mesh):
@@ -48,7 +74,7 @@ def _shard_padded(weights, mesh):
     k = mesh.shape[MODEL_AXIS]
     padded, orig = pad_topology(weights, k)
     sharded = tuple(
-        jax.device_put(w, layer_sharding(w, mesh)) for w in padded)
+        _place(w, layer_sharding(w, mesh), mesh) for w in padded)
     return sharded, orig
 
 
@@ -75,9 +101,10 @@ def tp_forward(weights, x, kind: str, mesh):
     """Row-sharded forward via GSPMD: same math as ops.forward, hidden
     rows placed ``P('model', None)``; XLA compiles the per-layer gathers.
     Returns all activations, sliced back to the unpadded widths."""
+    rep = replicated(mesh)
     sharded, orig = _shard_padded(weights, mesh)
-    x = jax.device_put(x, replicated(mesh))
-    acts = _tp_forward_fn(kind, replicated(mesh))(sharded, x)
+    x = _place(x, rep, mesh)
+    acts = _localize(_tp_forward_fn(kind, rep)(sharded, x))
     return tuple(a[:n] for a, n in zip(acts, orig))
 
 
@@ -91,13 +118,15 @@ def tp_train_sample(weights, x, t, kind: str, momentum: bool, mesh, **kw):
     Zero padding is training-invariant (see mesh.pad_topology), so the
     returned weights slice back to the exact unpadded result.
     """
+    rep = replicated(mesh)
     sharded, orig = _shard_padded(weights, mesh)
     shardings = tuple(layer_sharding(w, mesh) for w in sharded)
     fn = _tp_train_fn(kind, momentum, shardings, tuple(sorted(kw.items())))
-    x = jax.device_put(x, replicated(mesh))
-    t = jax.device_put(t, replicated(mesh))
+    x = _place(x, rep, mesh)
+    t = _place(t, rep, mesh)
     new_w, stats = fn(sharded, x, t)
-    return unpad_topology(new_w, orig), stats
+    new_w = _localize(_replicate_fn(rep)(new_w))
+    return unpad_topology(new_w, orig), _localize(stats)
 
 
 def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
@@ -114,10 +143,24 @@ def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
     rep = replicated(mesh)
     stats = []
     for x, t in zip(xs, ts):
-        sharded, st = fn(sharded, jax.device_put(x, rep),
-                         jax.device_put(t, rep))
+        sharded, st = fn(sharded, _place(x, rep, mesh),
+                         _place(t, rep, mesh))
         stats.append(st)
-    return unpad_topology(sharded, orig), stats
+    # multi-process: the row shards live on other hosts; replicate through
+    # the cached identity (an all-gather over the model axis -- the
+    # reference's post-update weight Allgather, ann.c:1636-1642) and read
+    # the local replica
+    final = _localize(_replicate_fn(rep)(sharded))
+    stats = [_localize(st) for st in stats]
+    return unpad_topology(final, orig), stats
+
+
+@functools.lru_cache(maxsize=64)
+def _replicate_fn(out_sharding):
+    """Cached replicating identity: the post-update weight all-gather (the
+    reference's ann.c:1636-1642 Allgather) used to read sharded weights
+    back on every process."""
+    return jax.jit(lambda ws: ws, out_shardings=out_sharding)
 
 
 @functools.lru_cache(maxsize=64)
@@ -137,7 +180,7 @@ def tp_run_batch(weights, xs, kind: str, mesh):
     sharded, _orig = _shard_padded(weights, mesh)
     rep = replicated(mesh)
     fn = _tp_run_batch_fn(kind, rep)
-    return fn(sharded, jax.device_put(jnp.asarray(xs), rep))
+    return _localize(fn(sharded, _place(jnp.asarray(xs), rep, mesh)))
 
 
 def _pad_rows(w, k: int):

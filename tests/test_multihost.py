@@ -94,7 +94,7 @@ def _make_corpus(root, n=16, n_in=10, n_out=4, seed=3):
         """))
 
 
-def _run_procs(workdir, nprocs, rank_env=None):
+def _run_procs(workdir, nprocs, rank_env=None, timeout=300):
     port = _free_port()
     code = WORKER.format(repo=REPO, nprocs=nprocs, workdir=workdir)
     procs = []
@@ -116,7 +116,7 @@ def _run_procs(workdir, nprocs, rank_env=None):
     outs = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=300)
+            out, err = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -242,3 +242,42 @@ def test_train_time_failure_coordinated_bailout(tmp_path):
     for rank, (rc, out, err) in enumerate(outs):
         assert rc == 8, (rank, rc, err[-2000:])
         assert f"WORKER_TRAINFAIL {rank}" in out
+
+
+def test_two_process_model_sharding(tmp_path):
+    """The reference's ACTUAL distributed mode: intra-layer row sharding
+    across PROCESSES (MPI ranks, ann.c:913-936).  [model] 2 over a
+    2-process mesh must match the single-process serial run.
+
+    Mini corpus on purpose: every convergence iteration all-gathers
+    across processes, which rides gloo/TCP here (~5 ms/iter) but ICI on
+    real hardware -- the reference paid the same per-iteration
+    MPI_Allgather cost (ann.c:925)."""
+    wd = tmp_path / "tp2"
+    one = tmp_path / "one"
+    for d in (wd, one):
+        d.mkdir()
+        _make_corpus(str(d), n=3, n_in=6, n_out=3)
+        conf = (d / "nn.conf").read_text().replace("[batch] 6\n", "")
+        conf = conf.replace("[input] 10\n", "[input] 6\n")
+        conf = conf.replace("[hidden] 6\n", "[hidden] 4\n")
+        conf = conf.replace("[output] 4\n", "[output] 3\n")
+        (d / "nn.conf").write_text(conf)
+    (wd / "nn.conf").write_text((wd / "nn.conf").read_text()
+                                + "[model] 2\n")
+
+    outs = _run_procs(str(wd), nprocs=2, timeout=540)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {rank} failed:\n{err[-3000:]}"
+        assert f"WORKER_DONE {rank}" in out
+    assert "TRAINING FILE" in outs[0][1]
+    assert "TRAINING FILE" not in outs[1][1]
+
+    _run_single(str(one))
+    w_r0 = _load_weights(str(wd / "kernel.opt.rank0"))
+    w_r1 = _load_weights(str(wd / "kernel.opt.rank1"))
+    w_s = _load_weights(str(one / "kernel.opt.rank0"))
+    for a, b in zip(w_r0, w_r1):
+        np.testing.assert_array_equal(a, b)
+    for a, b in zip(w_r0, w_s):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-12)
