@@ -442,3 +442,36 @@ def test_model_conf_deep_net_parity(tmp_path, capsys):
     assert logs["plain"] == logs["tp"] and logs["plain"]
     for a, b in zip(weights["plain"], weights["tp"]):
         assert np.abs(a - b).max() < 1e-12
+
+
+def test_batch_wins_over_model_with_warning(tmp_path, capsys):
+    """Documented interaction: [batch] selects DP; a simultaneous [model]
+    is ignored with a loud warning (README + api._train_kernel_tp)."""
+    import os
+
+    from hpnn_tpu.api import configure, train_kernel
+    from hpnn_tpu.utils import nn_log
+
+    rng = np.random.default_rng(5)
+    os.makedirs(tmp_path / "samples")
+    for k in range(6):
+        x = rng.uniform(-1, 1, 6)
+        t = -np.ones(3)
+        t[k % 3] = 1.0
+        with open(tmp_path / "samples" / f"s{k}.txt", "w") as f:
+            f.write("[input] 6\n" + " ".join(f"{v:.6f}" for v in x) + "\n")
+            f.write("[output] 3\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    (tmp_path / "nn.conf").write_text(
+        "[name] both\n[type] ANN\n[init] generate\n[seed] 2\n[input] 6\n"
+        "[hidden] 4\n[output] 3\n[train] BP\n[batch] 3\n[model] 2\n"
+        f"[sample_dir] {tmp_path}/samples\n"
+        f"[test_dir] {tmp_path}/samples\n")
+    nn_log.set_verbosity(2)
+    try:
+        nn = configure(str(tmp_path / "nn.conf"))
+        assert nn is not None and train_kernel(nn)
+    finally:
+        nn_log.set_verbosity(0)
+    out = capsys.readouterr().out
+    assert "TRAINING BATCH" in out              # DP ran
+    assert "[model] ignored" in out             # and said why
