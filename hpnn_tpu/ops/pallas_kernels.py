@@ -158,12 +158,37 @@ def fused_bpm_update(w, dw, d, h, lr, alpha,
     return w2[:n, :m], dw2[:n, :m]
 
 
+# Measured crossover on the v5e chip (round-3 sweep, 8x4096 MLP fwd):
+# for square-ish layers >= 2048 XLA's dot_general beats the hand kernel
+# at every batch (b=16384: 162 vs 127 TFLOPS; b=4096: 118 vs 110), while
+# at the flagship 784/300/10 shapes the two are within dispatch noise
+# (~1.6 ms/call either way).  Layers at or past the crossover therefore
+# ride XLA; small layers keep the fused Mosaic kernel.
+_XLA_TAKEOVER_DIM = 2048
+
+
+def _layer_linear_act(w, v, act: bool):
+    """One layer of act(v @ w.T), routed by measured shape crossover."""
+    n, m = w.shape
+    if max(n, m) >= _XLA_TAKEOVER_DIM:
+        acc = jnp.float32 if v.dtype == jnp.bfloat16 else v.dtype
+        out = jax.lax.dot_general(
+            v, w, (((1,), (1,)), ((), ())), preferred_element_type=acc)
+        if act:
+            out = ann_act(out)
+        return out.astype(v.dtype)
+    return fused_linear_act(w, v, act=act)
+
+
 def batched_forward_pallas(weights, xs, kind: str):
     """Whole-net batched forward on the fused kernels (throughput path).
 
     Hidden layers fuse act into the matmul; the SNN output head computes
     the softmax(x-1) on the un-activated final matmul.  Matches
     ops.steps.batched_forward to fp32 accuracy (asserted in tests).
+    Layers past the measured crossover (``_XLA_TAKEOVER_DIM``) dispatch
+    to XLA's dot_general instead of the hand kernel -- see the sweep
+    numbers above.
     """
     from .activations import snn_softmax
 
@@ -171,9 +196,9 @@ def batched_forward_pallas(weights, xs, kind: str):
     last = len(weights) - 1
     for i, w in enumerate(weights):
         if kind == "SNN" and i == last:
-            v = snn_softmax(fused_linear_act(w, v, act=False))
+            v = snn_softmax(_layer_linear_act(w, v, act=False))
         else:
-            v = fused_linear_act(w, v, act=True)
+            v = _layer_linear_act(w, v, act=True)
     return v
 
 

@@ -86,6 +86,23 @@ def test_stress_8x4096_shape():
     np.testing.assert_allclose(got, want, atol=3e-5)
 
 
+def test_layer_dispatch_crossover():
+    """Layers at/past _XLA_TAKEOVER_DIM ride XLA dot_general, smaller ones
+    the Mosaic kernel; both produce the same math (measured crossover from
+    the round-3 on-chip sweep)."""
+    from hpnn_tpu.ops.pallas_kernels import (_XLA_TAKEOVER_DIM,
+                                             _layer_linear_act)
+
+    big = _XLA_TAKEOVER_DIM        # derive shapes so re-tuning the
+    for n, m in ((big, big),       # measured threshold keeps both
+                 (300, 784)):      # branches covered (small = flagship)
+        xs = jnp.asarray(RNG.uniform(-1, 1, (4, m)), dtype=jnp.float32)
+        w = _w(n, m)
+        got = np.asarray(_layer_linear_act(w, xs, act=True))
+        want = np.asarray(jnp.tanh((xs @ w.T) * 0.5))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+
 def test_fused_linear_batch_tiling():
     """Batch larger than one tile (VMEM-safe batched eval)."""
     w = _w(64, 96)
