@@ -144,35 +144,71 @@ def main():
     ap.add_argument("--per-group", type=int, default=6)
     ap.add_argument("--engines", default="ref-C,tpu-f64,tpu-f32")
     ap.add_argument("--out", default=os.path.join(REPO, "PARITY_XRD.md"))
+    ap.add_argument("--results", default=None,
+                    help="JSON cache: engine cells already present are "
+                    "reused, new ones appended (lets the TPU engine run "
+                    "while the tunnel is alive, CPU engines later)")
     args = ap.parse_args()
+
+    import json
 
     base = os.path.join(REPO, ".scratch", "parity_xrd")
     engines = args.engines.split(",")
     n = args.groups * args.per_group
 
-    # one shared conversion: generate the RRUFF tree once, run OUR pdif
-    # once, and copy the identical sample bytes into every engine dir
-    src = os.path.join(base, "src")
-    shutil.rmtree(base, ignore_errors=True)
-    os.makedirs(os.path.join(src, "samples"))
-    make_rruff(src, args.groups, args.per_group)
-    r = subprocess.run(
-        [sys.executable, "-m", "hpnn_tpu.tools.pdif", src, "-i", "850",
-         "-o", "230", "-s", os.path.join(src, "samples")],
-        capture_output=True, text=True, cwd=REPO,
-        env=dict(os.environ, PYTHONPATH=REPO))
-    assert r.returncode == 0, r.stderr[-2000:]
-    made = os.listdir(os.path.join(src, "samples"))
-    assert len(made) == n, f"pdif made {len(made)}/{n} samples"
-
     all_results = {}
+    if args.results and os.path.exists(args.results):
+        with open(args.results) as f:
+            all_results = json.load(f)
+    # cached cells are only comparable at identical corpus scale (the
+    # corpus itself is deterministic: seed 55 + deterministic pdif)
+    meta = {"groups": args.groups, "per_group": args.per_group,
+            "rounds": args.rounds}
+    if all_results.get("_meta") not in (None, meta):
+        print(f"cache scale changed ({all_results['_meta']} -> {meta}); "
+              "re-running", flush=True)
+        all_results = {}
+    all_results["_meta"] = meta
+
+    todo = [e for e in engines if not all_results.get(e)]
+    if todo:
+        # one shared conversion: generate the RRUFF tree once, run OUR pdif
+        # once, and copy the identical sample bytes into every engine dir.
+        # Guard: the --results cache may live under `base`; wiping the work
+        # tree on an all-cached rerun would destroy it for nothing.
+        src = os.path.join(base, "src")
+        shutil.rmtree(base, ignore_errors=True)
+        os.makedirs(os.path.join(src, "samples"))
+        make_rruff(src, args.groups, args.per_group)
+        r = subprocess.run(
+            [sys.executable, "-m", "hpnn_tpu.tools.pdif", src, "-i", "850",
+             "-o", "230", "-s", os.path.join(src, "samples")],
+            capture_output=True, text=True, cwd=REPO,
+            env=dict(os.environ, PYTHONPATH=REPO))
+        assert r.returncode == 0, r.stderr[-2000:]
+        made = os.listdir(os.path.join(src, "samples"))
+        assert len(made) == n, f"pdif made {len(made)}/{n} samples"
+        if args.results:  # the wipe may have taken a cache under base with it
+            os.makedirs(os.path.dirname(os.path.abspath(args.results)),
+                        exist_ok=True)
+            with open(args.results, "w") as f:
+                json.dump(all_results, f)
+
     for engine in engines:
+        if all_results.get(engine):
+            print(f"cached XRD/{engine}", flush=True)
+            continue
         workdir = os.path.join(base, engine)
         os.makedirs(workdir)
         shutil.copytree(os.path.join(src, "samples"),
                         os.path.join(workdir, "samples"))
         print(f"running XRD/{engine} ...", flush=True)
         all_results[engine] = run_engine(engine, workdir, args.rounds)
+        if args.results:  # atomic: a mid-write kill must not eat cells
+            tmp = args.results + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(all_results, f)
+            os.replace(tmp, args.results)
 
     lines = [
         "# PARITY_XRD -- the RRUFF-XRD tutorial cycle, all engines",
