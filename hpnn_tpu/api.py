@@ -254,12 +254,15 @@ def train_kernel(nn: NNDef) -> bool:
         # train with zeroed momentum instead -- documented deviation.)
         nn_error("unimplemented NN type!\n")
 
+    from .utils.trace import phase, trace_weights
+
     names = list_sample_dir(conf.samples)
     if names is not None:
         order = _shuffle_order(conf, len(names))
-        events, xs, ts = _load_ordered(conf.samples, names, order,
-                                       "TRAINING", nn.kernel.n_inputs,
-                                       nn.kernel.n_outputs)
+        with phase("load_samples"):
+            events, xs, ts = _load_ordered(conf.samples, names, order,
+                                           "TRAINING", nn.kernel.n_inputs,
+                                           nn.kernel.n_outputs)
     else:
         events, xs, ts = [], None, None
     # multi-process agreement gate BEFORE any return path: a rank whose
@@ -302,6 +305,7 @@ def train_kernel(nn: NNDef) -> bool:
     weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
     # LNN trains through the SNN fallthrough (libhpnn.c:1260-1261)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
+    trace_weights(weights, "train-in")
 
     model_shards = _model_shards(conf)
     if conf.batch > 0:
@@ -315,25 +319,32 @@ def train_kernel(nn: NNDef) -> bool:
         if model_shards > 1:
             nn_warn("[model] ignored: [batch] selects data-parallel "
                     "training\n")
-        return _train_kernel_dp(nn, weights, xs, ts, kind, momentum, finish)
-
-    if model_shards > 1:
+        with phase("train_epoch_dp"):
+            ok = _train_kernel_dp(nn, weights, xs, ts, kind, momentum,
+                                  finish)
+    elif model_shards > 1:
         # [model] N / -S N: the reference's intra-layer row sharding
         # (its ONLY distributed strategy, ann.c:913-936 dispatched from
         # libhpnn.c:1243-1283), reachable from the production driver.
-        return _train_kernel_tp(nn, weights, xs, ts, kind, momentum,
-                                events, finish, model_shards)
-
-    # the Pallas VMEM-persistent kernel serves f32/bf16 on TPU, the XLA
-    # path serves fp64 parity and other backends (ops.select_train_epoch)
-    train_epoch_fn, _ = ops.select_train_epoch(dtype)
-    new_weights, stats = train_epoch_fn(
-        weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
-        kind, momentum, alpha=0.2)  # alpha=.2 from the driver (libhpnn.c:1248)
-
-    _emit_training_lines(events, stats, kind, momentum)
-    nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
-    return finish()
+        with phase("train_epoch_tp"):
+            ok = _train_kernel_tp(nn, weights, xs, ts, kind, momentum,
+                                  events, finish, model_shards)
+    else:
+        # the Pallas VMEM-persistent kernel serves f32/bf16 on TPU, the
+        # XLA path serves fp64 parity and other backends
+        # (ops.select_train_epoch)
+        train_epoch_fn, _ = ops.select_train_epoch(dtype)
+        with phase("train_epoch"):
+            new_weights, stats = train_epoch_fn(
+                weights, jnp.asarray(xs, dtype=dtype),
+                jnp.asarray(ts, dtype=dtype),
+                kind, momentum, alpha=0.2)  # alpha=.2 (libhpnn.c:1248)
+            nn.kernel.weights = [np.asarray(w, dtype=np.float64)
+                                 for w in new_weights]
+        _emit_training_lines(events, stats, kind, momentum)
+        ok = finish()
+    trace_weights(nn.kernel.weights, "train-out")
+    return ok
 
 
 def _model_shards(conf: NNConf) -> int:
@@ -519,13 +530,17 @@ def run_kernel(nn: NNDef) -> None:
         return
     if conf.type == NN_TYPE_UKN:
         return
+    from .utils.trace import phase
+
     names = list_sample_dir(conf.tests)
     if names is None:
         nn_error(f"can't open test directory: {conf.tests}\n")
         return
     order = _shuffle_order(conf, len(names))
-    events, xs, ts = _load_ordered(conf.tests, names, order, "TESTING",
-                                   nn.kernel.n_inputs, nn.kernel.n_outputs)
+    with phase("load_tests"):
+        events, xs, ts = _load_ordered(conf.tests, names, order, "TESTING",
+                                       nn.kernel.n_inputs,
+                                       nn.kernel.n_outputs)
     if xs is None:
         for line, _ in events:
             nn_out(line)
@@ -536,21 +551,23 @@ def run_kernel(nn: NNDef) -> None:
     # LNN evaluates through the SNN branch (libhpnn.c:1455-1456)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
     model_shards = _model_shards(conf)
-    if model_shards > 1:
-        # [model] N / -S N: row-sharded evaluation -- the reference's
-        # run path splits the same rows across ranks/streams
-        # (libhpnn.c:1426 -> ann.c:913-936)
-        from .parallel import tp_run_batch
+    with phase("eval_batch"):
+        if model_shards > 1:
+            # [model] N / -S N: row-sharded evaluation -- the reference's
+            # run path splits the same rows across ranks/streams
+            # (libhpnn.c:1426 -> ann.c:913-936)
+            from .parallel import tp_run_batch
 
-        mesh, _ = _clamped_model_mesh(model_shards)
-        outs = np.asarray(
-            tp_run_batch(weights, jnp.asarray(xs, dtype=dtype), kind, mesh),
-            dtype=np.float64)
-    else:
-        run_batch_fn, _ = ops.select_run_batch(dtype)
-        outs = np.asarray(
-            run_batch_fn(weights, jnp.asarray(xs, dtype=dtype), kind),
-            dtype=np.float64)
+            mesh, _ = _clamped_model_mesh(model_shards)
+            outs = np.asarray(
+                tp_run_batch(weights, jnp.asarray(xs, dtype=dtype), kind,
+                             mesh),
+                dtype=np.float64)
+        else:
+            run_batch_fn, _ = ops.select_run_batch(dtype)
+            outs = np.asarray(
+                run_batch_fn(weights, jnp.asarray(xs, dtype=dtype), kind),
+                dtype=np.float64)
 
     n_out = nn.kernel.n_outputs
     for line, i in events:

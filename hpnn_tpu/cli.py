@@ -115,14 +115,18 @@ def _parse_args(argv: list[str], name: str, train: bool):
 
 def train_nn_main(argv: list[str] | None = None) -> int:
     """train_nn (tests/train_nn.c:59-255)."""
+    from .utils.trace import phase
+
     argv = sys.argv[1:] if argv is None else argv
-    runtime.init_all(1)
+    with phase("init_all"):
+        runtime.init_all(1)
     parsed = _parse_args(argv, "train_nn", train=True)
     if parsed is None:
         runtime.deinit_all()
         return 0
     filename, _verbose = parsed
-    neural = configure(filename)
+    with phase("configure"):
+        neural = configure(filename)
     if neural is None:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
@@ -134,7 +138,9 @@ def train_nn_main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
         runtime.deinit_all()
         return -1
-    if not train_kernel(neural):
+    with phase("train_kernel"):
+        trained = train_kernel(neural)
+    if not trained:
         sys.stderr.write("FAILED to train kernel!\n")
         runtime.deinit_all()
         return -1
@@ -151,19 +157,24 @@ def train_nn_main(argv: list[str] | None = None) -> int:
 
 def run_nn_main(argv: list[str] | None = None) -> int:
     """run_nn (tests/run_nn.c:66-234)."""
+    from .utils.trace import phase
+
     argv = sys.argv[1:] if argv is None else argv
-    runtime.init_all(1)
+    with phase("init_all"):
+        runtime.init_all(1)
     parsed = _parse_args(argv, "run_nn", train=False)
     if parsed is None:
         runtime.deinit_all()
         return 0
     filename, _verbose = parsed
-    neural = configure(filename)
+    with phase("configure"):
+        neural = configure(filename)
     if neural is None:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
-    run_kernel(neural)
+    with phase("run_kernel"):
+        run_kernel(neural)
     runtime.deinit_all()
     return 0
 

@@ -1,0 +1,89 @@
+"""Checksum tracing and opt-in phase timers -- the tracing aux subsystem.
+
+The reference ships two developer debug aids and no timers:
+
+* ``DBG_TRACE(array,N)`` prints ``#DBG: acc=%.15f`` -- the plain sum of an
+  array (``/root/reference/include/libhpnn/ann.h:29-33``); ``CUDA_TRACE_V``
+  is the device-side analog via ``cublasDasum``
+  (``/root/reference/include/libhpnn/common.h:486-490``).  Neither has call
+  sites in the shipped sources: developers insert them by hand, and the
+  ChangeLog's cross-variant parity criterion (abs-sum 1e-14 on vectors,
+  <1e-12 on weights) is checked with them.
+* No timers exist anywhere (SURVEY section 5); the tutorials time rounds
+  with bash arithmetic around whole processes.
+
+Here both are runtime knobs instead of recompile-and-insert:
+
+* ``HPNN_DBG_TRACE=1`` makes the drivers print the reference-format
+  checksum line for every weight matrix entering and leaving training
+  (``dbg_trace`` is also importable for ad-hoc use, like the macro).
+* ``HPNN_PROFILE=1`` makes the drivers print ``#PROF: <phase> <secs>``
+  lines (sample load / epoch / eval ...), so the cold-round floor
+  measured in PARITY_MNIST.md (process startup + tunnel init + program
+  load vs actual training) can be decomposed without external tooling.
+
+Both print on the main process only, whatever the verbosity -- like the
+reference's macros, which bypass the ``_OUT`` verbosity gates.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from contextlib import contextmanager
+
+import numpy as np
+
+from . import nn_log
+
+
+def trace_enabled() -> bool:
+    return os.environ.get("HPNN_DBG_TRACE", "") not in ("", "0")
+
+
+def profile_enabled() -> bool:
+    return os.environ.get("HPNN_PROFILE", "") not in ("", "0")
+
+
+def _emit(text: str) -> None:
+    # nn_log owns the rank-0 output gate; one copy only
+    nn_log._emit(sys.stdout, text)
+
+
+def dbg_trace(array, label: str | None = None) -> None:
+    """The DBG_TRACE analog: print the array's plain f64 sum in the
+    reference's exact format (``#DBG: acc=%.15f``), optionally prefixed
+    by a label naming the traced array (the hand-inserted macro had the
+    surrounding code for context; a runtime knob needs the name)."""
+    acc = float(np.sum(np.asarray(array, dtype=np.float64)))
+    head = f"#DBG[{label}]: " if label else "#DBG: "
+    _emit(f"{head}acc={acc:.15f}\n")
+
+
+def trace_weights(weights, tag: str) -> None:
+    """Checksum every weight matrix when HPNN_DBG_TRACE=1 (no-op cost
+    otherwise); tag names the site, e.g. 'train-in' / 'train-out'."""
+    if not trace_enabled():
+        return
+    for i, w in enumerate(weights):
+        dbg_trace(w, f"{tag} W{i}")
+
+
+@contextmanager
+def phase(name: str):
+    """Time a driver phase when HPNN_PROFILE=1; prints ``#PROF:`` lines.
+
+    Device work launched inside the phase is only fully counted if the
+    phase ends in a host read (the drivers' phases all do -- weights come
+    back as np arrays); async dispatches that escape the block land in a
+    later phase, same caveat as any wall-clock timer under JAX.
+    """
+    if not profile_enabled():
+        yield
+        return
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _emit(f"#PROF: {name} {time.perf_counter() - t0:.3f}s\n")

@@ -1,0 +1,64 @@
+"""Tracing aux subsystem: DBG_TRACE checksum analog + HPNN_PROFILE timers.
+
+The reference ships DBG_TRACE (ann.h:29-33) / CUDA_TRACE_V (common.h:
+486-490) as hand-inserted debug macros and has no timers; here both are
+runtime knobs (hpnn_tpu/utils/trace.py)."""
+
+import re
+
+import numpy as np
+
+from hpnn_tpu import cli
+from hpnn_tpu.utils.trace import dbg_trace
+
+from test_cli_e2e import corpus  # noqa: F401 (fixture)
+
+
+def test_dbg_trace_reference_format(capsys):
+    """Exact reference output: '#DBG: acc=%.15f' of the plain sum."""
+    arr = np.array([[1.25, -0.25], [2.0, 0.5]])
+    dbg_trace(arr)
+    out = capsys.readouterr().out
+    assert out == "#DBG: acc=3.500000000000000\n"
+    dbg_trace(arr, "W0")
+    assert capsys.readouterr().out == "#DBG[W0]: acc=3.500000000000000\n"
+
+
+def test_profile_phases_in_train_and_run(corpus, monkeypatch, capsys):  # noqa: F811
+    monkeypatch.setenv("HPNN_PROFILE", "1")
+    assert cli.train_nn_main(["-vv", str(corpus)]) == 0
+    out = capsys.readouterr().out
+    phases = re.findall(r"#PROF: (\S+) ([0-9.]+)s", out)
+    names = [p[0] for p in phases]
+    for want in ("init_all", "configure", "load_samples", "train_epoch",
+                 "train_kernel"):
+        assert want in names, (want, names)
+    assert cli.run_nn_main(["-vv", str(corpus)]) == 0
+    out = capsys.readouterr().out
+    names = [m for m in re.findall(r"#PROF: (\S+) [0-9.]+s", out)]
+    for want in ("init_all", "configure", "load_tests", "eval_batch",
+                 "run_kernel"):
+        assert want in names, (want, names)
+
+
+def test_profile_off_by_default(corpus, monkeypatch, capsys):  # noqa: F811
+    monkeypatch.delenv("HPNN_PROFILE", raising=False)
+    monkeypatch.delenv("HPNN_DBG_TRACE", raising=False)
+    assert cli.train_nn_main(["-vv", str(corpus)]) == 0
+    out = capsys.readouterr().out
+    assert "#PROF" not in out and "#DBG" not in out
+
+
+def test_dbg_trace_weights_in_driver(corpus, monkeypatch, capsys):  # noqa: F811
+    """HPNN_DBG_TRACE=1: a checksum line per weight matrix entering and
+    leaving training -- the ChangeLog parity-criterion workflow without
+    recompiling (ChangeLog:34-44)."""
+    monkeypatch.setenv("HPNN_DBG_TRACE", "1")
+    assert cli.train_nn_main(["-vv", str(corpus)]) == 0
+    out = capsys.readouterr().out
+    tr_in = re.findall(r"#DBG\[train-in W(\d)\]: acc=(-?\d+\.\d{15})\n", out)
+    tr_out = re.findall(r"#DBG\[train-out W(\d)\]: acc=(-?\d+\.\d{15})\n",
+                        out)
+    assert len(tr_in) == 2 and len(tr_out) == 2  # one hidden + output
+    # training must have moved the weights: checksums change
+    assert [v for _, v in tr_in] != [v for _, v in tr_out]
