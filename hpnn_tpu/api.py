@@ -313,15 +313,12 @@ def train_kernel(nn: NNDef) -> bool:
         # capability, BASELINE.json config 5) -- batches split over the
         # mesh's data axis, gradient all-reduce compiled by XLA.  The
         # per-sample convergence grammar does not apply; one line per batch.
-        # Interaction with [model]: DP wins -- minibatch training has no
-        # per-sample convergence loop to row-shard, and hybrid
-        # (data x model) meshes are a dryrun-only configuration for now.
-        if model_shards > 1:
-            nn_warn("[model] ignored: [batch] selects data-parallel "
-                    "training\n")
+        # Interaction with [model]: HYBRID -- a (data x model) mesh,
+        # batch rows over "data" AND weight rows over "model" (GSPMD
+        # compiles the induced all-gathers + all-reduces together).
         with phase("train_epoch_dp"):
             ok = _train_kernel_dp(nn, weights, xs, ts, kind, momentum,
-                                  finish)
+                                  finish, model_shards)
     elif model_shards > 1:
         # [model] N / -S N: the reference's intra-layer row sharding
         # (its ONLY distributed strategy, ann.c:913-936 dispatched from
@@ -433,7 +430,7 @@ def _train_kernel_tp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
 
 
 def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
-                     finish) -> bool:
+                     finish, model_shards: int = 1) -> bool:
     """Data-parallel minibatch epoch ([batch] B conf extension).
 
     Uses the reference's per-family learning rates and the BPM update
@@ -445,13 +442,20 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     arrays: every process loads the shared-filesystem corpus and
     contributes the rows its devices own -- the reference's MPI layout
     (``libhpnn.c:1184-1229``) without the rank-0 Bcast hub.
+
+    ``model_shards > 1`` ([model] N alongside [batch]) builds a HYBRID
+    (data x model) mesh: batch rows over "data" AND weight rows over
+    "model" (the reference's row layout, ann.c:913-926).  GSPMD compiles
+    the per-layer all-gathers and the gradient all-reduce together; rows
+    that do not divide the model axis stay replicated (layer_sharding --
+    the output layer, typically).
     """
     import jax
     import jax.numpy as jnp
 
     from . import ops
     from .parallel import dp_train_epoch_batched, global_array, make_mesh
-    from .parallel.mesh import DATA_AXIS
+    from .parallel.mesh import DATA_AXIS, layer_sharding
     from .parallel.mesh import replicated as replicated_sharding
 
     conf = nn.conf
@@ -463,15 +467,38 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     n_batches = -(-s // bsz)
     dtype = _dtype_of(conf)
     ndev = jax.device_count()
-    mesh = make_mesh() if ndev > 1 else None
+    n_model = 1
+    if model_shards > 1 and ndev == 1:
+        nn_warn(f"[model] {model_shards} > 1 visible device(s); "
+                "using 1\n")
+    elif model_shards > 1:
+        # largest divisor of the device count not exceeding the request
+        # (stricter than _clamped_model_mesh's cap-at-ndev: the hybrid
+        # mesh is a FULL ndev grid, so the model axis must divide it; the
+        # TP route's 1xN mesh can use a device subset instead)
+        n_model = min(model_shards, ndev)
+        while ndev % n_model:
+            n_model -= 1
+        if n_model != model_shards:
+            nn_warn(f"[model] {model_shards} clamped to {n_model} "
+                    f"(device count {ndev})\n")
+    if ndev > 1:
+        mesh = make_mesh(n_data=ndev // n_model, n_model=n_model)
+    else:
+        mesh = None
     if mesh is None:
         nn_out("DP: one device visible; minibatch training runs "
                "unsharded\n")
-    bsz_pad = -(-bsz // ndev) * ndev if mesh is not None else bsz
+    elif n_model > 1:
+        nn_out(f"DP: hybrid mesh {ndev // n_model}x{n_model} "
+               "(batch rows over data, weight rows over model)\n")
+    n_data = mesh.shape[DATA_AXIS] if mesh is not None else 1
+    bsz_pad = -(-bsz // n_data) * n_data if mesh is not None else bsz
     padded_rows = n_batches * bsz_pad - s
     if padded_rows:
         nn_out(f"DP: padding {padded_rows} masked row(s) "
-               f"(S={s}, batch={bsz} -> {bsz_pad} over {ndev} device(s))\n")
+               f"(S={s}, batch={bsz} -> {bsz_pad} over {n_data} "
+               "data-shard(s))\n")
 
     np_dtype = np.dtype(str(jnp.dtype(dtype))) if dtype != jnp.bfloat16 \
         else np.float32
@@ -485,6 +512,12 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
         tb[i, :k] = ts[rows]
         mb[i, :k] = 1.0
 
+    def wsh(w):
+        # ONE hybrid placement rule for both process layouts: rows over
+        # "model" where they divide it, replicated otherwise
+        return (layer_sharding(w, mesh) if n_model > 1
+                else replicated_sharding(mesh))
+
     if jax.process_count() > 1 and mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -495,20 +528,17 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
 
         bsh = NamedSharding(mesh, P(None, DATA_AXIS, None))
         msh = NamedSharding(mesh, P(None, DATA_AXIS))
-        rep = replicated_sharding(mesh)
         jxb = global_array(host(xb), bsh)
         jtb = global_array(host(tb), bsh)
         jmb = global_array(host(mb), msh)
-        weights = tuple(global_array(host(np.asarray(w)), rep)
+        weights = tuple(global_array(host(np.asarray(w)), wsh(w))
                         for w in weights)
     else:
         jxb = jnp.asarray(xb, dtype=dtype)
         jtb = jnp.asarray(tb, dtype=dtype)
         jmb = jnp.asarray(mb, dtype=dtype)
         if mesh is not None:
-            weights = tuple(
-                jax.device_put(w, replicated_sharding(mesh))
-                for w in weights)
+            weights = tuple(jax.device_put(w, wsh(w)) for w in weights)
     new_weights, errs = dp_train_epoch_batched(
         weights, jxb, jtb, jmb, kind, momentum, lr, alpha=0.2, mesh=mesh)
     errs = np.asarray(errs, dtype=np.float64)

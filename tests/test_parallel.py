@@ -444,9 +444,11 @@ def test_model_conf_deep_net_parity(tmp_path, capsys):
         assert np.abs(a - b).max() < 1e-12
 
 
-def test_batch_wins_over_model_with_warning(tmp_path, capsys):
-    """Documented interaction: [batch] selects DP; a simultaneous [model]
-    is ignored with a loud warning (README + api._train_kernel_tp)."""
+def test_batch_plus_model_hybrid_mesh(tmp_path, capsys):
+    """[batch] + [model] = a HYBRID (data x model) mesh: batch rows over
+    the data axis AND weight rows over the model axis in ONE program
+    (round 3; previously [model] was ignored with a warning).  Weights
+    must match the pure-DP run at the f64 reduction-order bound."""
     import os
 
     from hpnn_tpu.api import configure, train_kernel
@@ -461,17 +463,30 @@ def test_batch_wins_over_model_with_warning(tmp_path, capsys):
         with open(tmp_path / "samples" / f"s{k}.txt", "w") as f:
             f.write("[input] 6\n" + " ".join(f"{v:.6f}" for v in x) + "\n")
             f.write("[output] 3\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
-    (tmp_path / "nn.conf").write_text(
+    base = (
         "[name] both\n[type] ANN\n[init] generate\n[seed] 2\n[input] 6\n"
-        "[hidden] 4\n[output] 3\n[train] BP\n[batch] 3\n[model] 2\n"
+        "[hidden] 4\n[output] 3\n[train] BP\n[batch] 3\n{extra}"
         f"[sample_dir] {tmp_path}/samples\n"
         f"[test_dir] {tmp_path}/samples\n")
+    (tmp_path / "hy.conf").write_text(base.format(extra="[model] 2\n"))
+    (tmp_path / "dp.conf").write_text(base.format(extra=""))
     nn_log.set_verbosity(2)
     try:
-        nn = configure(str(tmp_path / "nn.conf"))
-        assert nn is not None and train_kernel(nn)
+        nn_hy = configure(str(tmp_path / "hy.conf"))
+        assert nn_hy is not None and train_kernel(nn_hy)
+        out_hy = capsys.readouterr().out
+        nn_dp = configure(str(tmp_path / "dp.conf"))
+        assert nn_dp is not None and train_kernel(nn_dp)
+        out_dp = capsys.readouterr().out
     finally:
         nn_log.set_verbosity(0)
-    out = capsys.readouterr().out
-    assert "TRAINING BATCH" in out              # DP ran
-    assert "[model] ignored" in out             # and said why
+    import jax
+
+    assert "TRAINING BATCH" in out_hy           # DP grammar ran
+    ndev = jax.device_count()                   # on the hybrid mesh
+    assert f"hybrid mesh {ndev // 2}x2" in out_hy
+    assert "hybrid mesh" not in out_dp
+    assert ("TRAINING BATCH" in out_dp)
+    # same math, different collective layout: <1e-12 (ChangeLog criterion)
+    for a, b in zip(nn_hy.kernel.weights, nn_dp.kernel.weights):
+        np.testing.assert_allclose(a, b, atol=1e-12)
