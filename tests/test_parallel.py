@@ -490,3 +490,41 @@ def test_batch_plus_model_hybrid_mesh(tmp_path, capsys):
     # same math, different collective layout: <1e-12 (ChangeLog criterion)
     for a, b in zip(nn_hy.kernel.weights, nn_dp.kernel.weights):
         np.testing.assert_allclose(a, b, atol=1e-12)
+
+
+def test_batch_plus_model_single_device_warns(tmp_path, capsys,
+                                              monkeypatch):
+    """One visible device: the [model] request cannot shard anything and
+    must say so (same courtesy as _clamped_model_mesh's warning), while
+    [batch] training proceeds unsharded."""
+    import os
+
+    import jax
+
+    from hpnn_tpu.api import configure, train_kernel
+    from hpnn_tpu.utils import nn_log
+
+    rng = np.random.default_rng(6)
+    os.makedirs(tmp_path / "samples")
+    for k in range(4):
+        x = rng.uniform(-1, 1, 5)
+        t = -np.ones(3)
+        t[k % 3] = 1.0
+        with open(tmp_path / "samples" / f"s{k}.txt", "w") as f:
+            f.write("[input] 5\n" + " ".join(f"{v:.6f}" for v in x) + "\n")
+            f.write("[output] 3\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    (tmp_path / "nn.conf").write_text(
+        "[name] one\n[type] ANN\n[init] generate\n[seed] 3\n[input] 5\n"
+        "[hidden] 4\n[output] 3\n[train] BP\n[batch] 2\n[model] 4\n"
+        f"[sample_dir] {tmp_path}/samples\n"
+        f"[test_dir] {tmp_path}/samples\n")
+    monkeypatch.setattr(jax, "device_count", lambda *a, **k: 1)
+    nn_log.set_verbosity(2)
+    try:
+        nn = configure(str(tmp_path / "nn.conf"))
+        assert nn is not None and train_kernel(nn)
+    finally:
+        nn_log.set_verbosity(0)
+    out = capsys.readouterr().out
+    assert "TRAINING BATCH" in out
+    assert "[model] 4 > 1 visible device(s); using 1" in out
