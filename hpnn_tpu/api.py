@@ -541,6 +541,14 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
             weights = tuple(jax.device_put(w, wsh(w)) for w in weights)
     new_weights, errs = dp_train_epoch_batched(
         weights, jxb, jtb, jmb, kind, momentum, lr, alpha=0.2, mesh=mesh)
+    if jax.process_count() > 1 and n_model > 1:
+        # hybrid rows live as shards on other processes' devices; a host
+        # fetch must gather them first (the reference's G2C staging step,
+        # ann.c:808, in its DCN form)
+        from jax.experimental import multihost_utils
+
+        new_weights = multihost_utils.process_allgather(new_weights,
+                                                        tiled=True)
     errs = np.asarray(errs, dtype=np.float64)
     for i in range(n_batches):
         nn_out(f"TRAINING BATCH {i:8d}\t err={errs[i]:15.10f}\n")

@@ -203,6 +203,39 @@ def test_four_process_dp_matches_single(tmp_path):
         np.testing.assert_allclose(a, b, rtol=0, atol=1e-12)
 
 
+def test_four_process_hybrid_mesh(tmp_path):
+    """HYBRID across processes: 4 single-device processes, [batch] +
+    [model] 2 -> a 2x2 (data x model) mesh spanning the process slices;
+    weight rows live as global-array shards (api._train_kernel_dp wsh),
+    batch rows split over data.  Every rank agrees and the result matches
+    a single-process pure-DP run at the ChangeLog bound."""
+    four = tmp_path / "four"
+    one = tmp_path / "one"
+    for d in (four, one):
+        d.mkdir()
+        _make_corpus(str(d))
+    # same corpus/conf plus [model] 2 in the 4-proc run only
+    conf = (four / "nn.conf").read_text()
+    (four / "nn.conf").write_text(conf.replace("[batch] 6",
+                                               "[batch] 6\n[model] 2"))
+
+    outs = _run_procs(str(four), nprocs=4)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, f"rank {rank} failed:\n{err[-3000:]}"
+        assert f"WORKER_DONE {rank}" in out
+    assert "hybrid mesh 2x2" in outs[0][1]       # rank 0 announces it
+    assert "hybrid mesh" not in outs[1][1]       # others stay silent
+    _run_single(str(one))
+    w_r = [_load_weights(str(four / f"kernel.opt.rank{r}"))
+           for r in range(4)]
+    w_s = _load_weights(str(one / "kernel.opt.rank0"))
+    for r in range(1, 4):
+        for a, b in zip(w_r[0], w_r[r]):
+            np.testing.assert_array_equal(a, b)
+    for a, b in zip(w_r[0], w_s):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-12)
+
+
 def test_load_failure_coordinated_bailout(tmp_path):
     """Rank-divergent load failure: one process's conf points at a missing
     kernel file; EVERY process must exit cleanly (the reference's MPI
