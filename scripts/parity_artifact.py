@@ -82,31 +82,33 @@ PROFILES = {
 
 
 def make_corpus(root: str, n_train: int, n_test: int, seed: int = 1234,
-                profile: str = "hard"):
-    """10-class corpus with heavy intra-class style variation."""
+                profile: str = "hard", classes: int = 10):
+    """`classes`-class corpus with heavy intra-class style variation."""
     p = PROFILES[profile]
     rng = np.random.default_rng(seed)
     n_styles, train_styles = p["n_styles"], p["train_styles"]
     base = rng.uniform(0, 140, 784) * (rng.uniform(0, 1, 784) > 0.55)
-    cls = rng.uniform(-p["cls_amp"], p["cls_amp"], (10, 784)) * (
-        rng.uniform(0, 1, (10, 784)) > p["cls_keep"])
-    var = (rng.uniform(-p["var_amp"], p["var_amp"], (10, n_styles, 784))
-           * (rng.uniform(0, 1, (10, n_styles, 784)) > p["var_keep"]))
+    cls = rng.uniform(-p["cls_amp"], p["cls_amp"], (classes, 784)) * (
+        rng.uniform(0, 1, (classes, 784)) > p["cls_keep"])
+    var = (rng.uniform(-p["var_amp"], p["var_amp"],
+                   (classes, n_styles, 784))
+           * (rng.uniform(0, 1, (classes, n_styles, 784))
+              > p["var_keep"]))
     for d, n in (("samples", n_train), ("tests", n_test)):
         os.makedirs(os.path.join(root, d), exist_ok=True)
         for k in range(n):
-            c = k % 10
+            c = k % classes
             # generalization gap: tests draw from held-out styles
             v = (rng.integers(0, train_styles) if d == "samples"
                  else rng.integers(train_styles, n_styles))
             x = base + cls[c] + var[c, v] + rng.normal(0, p["noise"], 784)
             x = np.clip(x, 0, 255) * (rng.uniform(0, 1, 784) > p["drop"])
-            t = -np.ones(10)
+            t = -np.ones(classes)
             t[c] = 1.0
             with open(os.path.join(root, d, f"s{k:05d}.txt"), "w") as f:
                 f.write("[input] 784\n"
                         + " ".join(f"{q:7.5f}" for q in x) + "\n")
-                f.write("[output] 10\n"
+                f.write(f"[output] {classes}\n"
                         + " ".join(f"{q:.1f}" for q in t) + "\n")
 
 
@@ -116,7 +118,7 @@ CONF = """[name] parity
 [seed] 10958
 [input] 784
 [hidden] {hidden}
-[output] 10
+[output] {classes}
 [train] BP
 {extra}[sample_dir] ./samples
 [test_dir] ./tests
@@ -130,18 +132,20 @@ CONF = """[name] parity
 # engines' curves remain comparable.
 KIND_SCALE = {
     "ANN": dict(hidden=300, train=None, test=None, rounds=None,
-                profile="hard"),
-    "SNN": dict(hidden=100, train=30, test=20, rounds=4, profile="easy"),
+                profile="hard", classes=10),
+    "SNN": dict(hidden=100, train=30, test=20, rounds=4, profile="easy",
+                classes=10),
 }
 
 
 def write_conf(workdir: str, first: bool, dtype: str | None, kind: str):
     extra = f"[dtype] {dtype}\n" if dtype else ""
     init = "generate" if first else "kernel.opt"
-    hidden = KIND_SCALE.get(kind, KIND_SCALE["ANN"])["hidden"]
+    scale = KIND_SCALE.get(kind, KIND_SCALE["ANN"])
     with open(os.path.join(workdir, "nn.conf"), "w") as f:
         f.write(CONF.format(init=init, extra=extra, kind=kind,
-                            hidden=hidden))
+                            hidden=scale["hidden"],
+                            classes=scale["classes"]))
 
 
 def scrape(train_log: str, run_log: str):
@@ -241,7 +245,13 @@ def main():
         # scale into the cache and drop cells recorded under another one
         meta_key = f"_meta_{kind}"
         meta = {"train": n_train, "test": n_test, "rounds": rounds,
-                "profile": profile}
+                "profile": profile, "classes": scale["classes"],
+                "hidden": scale["hidden"]}
+        if isinstance(all_results.get(meta_key), dict):
+            # caches written before the classes/hidden stamping were all
+            # recorded at 10 classes and the current KIND_SCALE widths
+            all_results[meta_key].setdefault("classes", 10)
+            all_results[meta_key].setdefault("hidden", scale["hidden"])
         if all_results.get(meta_key) not in (None, meta):
             print(f"cache scale changed for {kind} "
                   f"({all_results[meta_key]} -> {meta}); re-running",
@@ -255,7 +265,8 @@ def main():
             workdir = os.path.join(base, f"{kind}-{engine}")
             shutil.rmtree(workdir, ignore_errors=True)
             os.makedirs(workdir, exist_ok=True)
-            make_corpus(workdir, n_train, n_test, profile=profile)
+            make_corpus(workdir, n_train, n_test, profile=profile,
+                        classes=scale["classes"])
             print(f"running {kind}/{engine} ...", flush=True)
             all_results[kind][engine] = run_engine(
                 engine, workdir, rounds, kind)
