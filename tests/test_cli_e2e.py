@@ -133,3 +133,23 @@ def test_shuffle_reproducible(corpus, capsys):
     assert files1 == files2
     assert files1 != sorted(files1)  # the shuffle actually permutes
     assert len(files1) == N_SAMP
+
+
+def test_dtype_bf16_cli_roundtrip(corpus, capsys):
+    """[dtype] bf16 through the full CLI: the throughput dtype drives
+    train + eval on the XLA path (same dispatch the TPU mode uses; the
+    Pallas gate only opens on a real chip), kernel.opt written as finite
+    f64 text that run_nn then consumes."""
+    text = open(str(corpus)).read()
+    with open("b.conf", "w") as fp:
+        fp.write(text + "[dtype] bf16\n")
+    rc = cli.train_nn_main(["-vv", "b.conf"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert len(re.findall(r"N_ITER=", out)) == N_SAMP
+    k = load_kernel("kernel.opt")
+    assert k is not None and all(np.isfinite(w).all() for w in k.weights)
+    rc = cli.run_nn_main(["-vv", "b.conf"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert len(re.findall(r"\[(?:PASS|FAIL)", out)) == N_SAMP

@@ -1,0 +1,63 @@
+"""gen_ann format compatibility (the gen_ann.bash rebuild, scripts/).
+
+The reference's gen_ann.bash authors a kernel file offline from
+/dev/urandom (``/root/reference/scripts/gen_ann.bash:22-73``); only the
+FORMAT is contractual -- the output must load in both implementations.
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+from hpnn_tpu.io.kernel_io import load_kernel
+
+from test_reference_parity import _oracle  # compiled-on-demand C oracle
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GEN = os.path.join(REPO, "scripts", "gen_ann.py")
+
+
+def _gen(tmp_path, dims, seed=5):
+    out = tmp_path / "gen.kernel"
+    r = subprocess.run(
+        [sys.executable, GEN, "-s", str(seed), "-n", "gen_ann",
+         *map(str, dims)],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr[-1500:]
+    out.write_text(r.stdout)
+    return out
+
+
+def test_gen_ann_loads_and_scales(tmp_path):
+    path = _gen(tmp_path, [12, 9, 5])
+    kern = load_kernel(str(path))
+    assert kern is not None
+    assert [w.shape for w in kern.weights] == [(9, 12), (5, 9)]
+    # the reference's +-1/sqrt(M) init bound (ann.c:674-677)
+    for w in kern.weights:
+        m = w.shape[1]
+        assert np.abs(w).max() <= 1.0 / np.sqrt(m) + 1e-12
+
+
+def test_gen_ann_loads_in_the_reference(tmp_path):
+    """The C reference's own loader accepts the generated file: run the
+    compiled ref train_nn with [init] <generated> over one sample."""
+    path = _gen(tmp_path, [6, 4, 3])
+    os.makedirs(tmp_path / "samples")
+    rng = np.random.default_rng(0)
+    x = rng.uniform(-1, 1, 6)
+    with open(tmp_path / "samples" / "s0", "w") as f:
+        f.write("[input] 6\n" + " ".join(f"{v:.5f}" for v in x) + "\n")
+        f.write("[output] 3\n1.0 -1.0 -1.0\n")
+    (tmp_path / "nn.conf").write_text(
+        "[name] g\n[type] ANN\n[init] gen.kernel\n[seed] 1\n[input] 6\n"
+        "[hidden] 4\n[output] 3\n[train] BP\n[sample_dir] ./samples\n"
+        "[test_dir] ./samples\n")
+    r = subprocess.run([_oracle("train_nn"), "-v", "-v", "nn.conf"],
+                       cwd=tmp_path, capture_output=True, text=True,
+                       timeout=600)
+    assert r.returncode == 0, (r.stdout[-800:], r.stderr[-800:])
+    assert "N_ITER" in r.stdout          # it loaded AND trained
+    assert os.path.exists(tmp_path / "kernel.opt")
