@@ -78,25 +78,36 @@ def _precision():
             == "highest" else lax.Precision.DEFAULT)
 
 
+def _acc(dtype):
+    """Mosaic requires 32-bit matmul accumulators ([dtype] bf16 would not
+    lower with a bf16 acc); f32 accumulation also keeps the 784-long
+    contractions from quantizing at bf16 resolution.  Results are cast
+    back to the storage dtype by the callers' consumers."""
+    return jnp.float32 if dtype == jnp.bfloat16 else dtype
+
+
 def _outer(d, h, precision):
     """(1,N) x (1,M) -> (N,M) rank-1 product on the MXU."""
     return lax.dot_general(
         d, h, dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=d.dtype, precision=precision)
+        preferred_element_type=_acc(d.dtype),
+        precision=precision).astype(d.dtype)
 
 
 def _matvec(v, w_ref, precision):
     """(1,M) @ (N,M)^T -> (1,N)."""
     return lax.dot_general(
         v, w_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
-        preferred_element_type=v.dtype, precision=precision)
+        preferred_element_type=_acc(v.dtype),
+        precision=precision).astype(v.dtype)
 
 
 def _matvec_t(d, w_ref, precision):
     """(1,N) @ (N,M) -> (1,M) (transposed matvec for hidden deltas)."""
     return lax.dot_general(
         d, w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
-        preferred_element_type=d.dtype, precision=precision)
+        preferred_element_type=_acc(d.dtype),
+        precision=precision).astype(d.dtype)
 
 
 def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
@@ -127,9 +138,13 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
     def out_head(z):
         if kind == SNN:
             # softmax(x-1) with a TINY-seeded denominator (snn.c:282-334),
-            # masked to the real output lanes
+            # masked to the real output lanes.  The denominator reduction
+            # is f32: Mosaic only scalarizes 32-bit types ([dtype] bf16
+            # would fail to lower), and a bf16 sum would quantize the
+            # normalization anyway.
             e = jnp.where(out_mask, jnp.exp(z - 1.0), 0.0).astype(dtype)
-            return e / (jnp.sum(e) + TINY)
+            dv = jnp.sum(e.astype(jnp.float32)) + TINY
+            return (e.astype(jnp.float32) / dv).astype(dtype)
         return ann_act(z)
 
     def fwd():
@@ -142,24 +157,35 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
         return tuple(acts)
 
     def err(o):
+        # error scalars live in f32 whatever the storage dtype: Mosaic
+        # refuses to scalarize sub-32-bit reductions, and the dEp<=delta
+        # stop test needs more resolution than bf16's ~3 digits
         if kind == SNN:
             # -(1/N) sum_{o>0} t*log(o+TINY) (snn.c:447-477); padded lanes
             # have o==0 so the o>0 guard already excludes them
-            terms = jnp.where(o > 0.0, t * jnp.log(o + TINY), 0.0)
+            of = o.astype(jnp.float32)
+            terms = jnp.where(of > 0.0,
+                              t.astype(jnp.float32) * jnp.log(of + TINY),
+                              0.0)
             return -jnp.sum(terms) / n_out
-        d = t - o
+        # cast BEFORE subtracting: a bf16 (t - o) would quantize each
+        # term to 8 mantissa bits before the f32 sum
+        d = t.astype(jnp.float32) - o.astype(jnp.float32)
         return 0.5 * jnp.sum(d * d)
 
     def argmax_first(o):
         """First maximal REAL lane (strict probe<ptr scan, ann.c:2341-2348)."""
-        masked = jnp.where(out_mask, o, -jnp.inf)
+        masked = jnp.where(out_mask, o, -jnp.inf).astype(jnp.float32)
         m = jnp.max(masked)
         # int32-typed fill values: a python int would promote to int64
         # under x64, which Mosaic cannot convert back (infinite recursion)
         return jnp.min(jnp.where(masked == m, col, jnp.int32(npl)))
 
-    # p_trg: LAST index with t==1.0, default 0 (ann.c:2341-2348)
-    p_trg = jnp.max(jnp.where(t == 1.0, col, jnp.int32(0)))
+    # p_trg: LAST index with t==1.0, default 0 (ann.c:2341-2348).  The
+    # compare runs in f32: Mosaic's target rejects bf16 vector cmpf, and
+    # +-1.0 one-hot targets are exact in both dtypes so the cast is free.
+    p_trg = jnp.max(jnp.where(t.astype(jnp.float32) == 1.0, col,
+                              jnp.int32(0)))
 
     acts0 = fwd()
     init_err = err(acts0[-1])
@@ -201,7 +227,7 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
         first_ok = lax.select(it == 1, is_ok_raw, first_ok)
         return (it, dep, is_ok_raw, first_ok, new_acts, new_epr)
 
-    state0 = (jnp.int32(0), jnp.zeros((), dtype), jnp.asarray(False),
+    state0 = (jnp.int32(0), jnp.zeros((), jnp.float32), jnp.asarray(False),
               jnp.asarray(False), acts0, init_err)
     it, dep, is_ok_raw, first_ok, _, _ = lax.while_loop(cond, body, state0)
     success = is_ok_raw & (it > min_iter)

@@ -22,6 +22,7 @@ Engines:
 
 * ``ref-C``    -- the serial C reference compiled from /root/reference;
 * ``tpu-f64``  -- this framework's fp64 XLA parity path (CPU backend);
+* ``tpu-bf16`` -- same kernel under [dtype] bf16 (storage-dtype mode);
 * ``tpu-f32``  -- this framework's f32 Pallas VMEM-persistent kernel on
   the TPU chip, MXU-default precision (the shipped throughput mode).
 
@@ -160,7 +161,7 @@ def scrape(train_log: str, run_log: str):
 
 def run_engine(engine: str, workdir: str, rounds: int, kind: str):
     """Train 1+rounds rounds; returns [(opt%, pass%, train_seconds)]."""
-    dtype = "f32" if engine == "tpu-f32" else None
+    dtype = {"tpu-f32": "f32", "tpu-bf16": "bf16"}.get(engine)
     env = dict(os.environ)
     if engine == "tpu-f64":
         env["JAX_PLATFORMS"] = "cpu"
@@ -217,7 +218,8 @@ def main():
     ap.add_argument("--train", type=int, default=200)
     ap.add_argument("--test", type=int, default=100)
     ap.add_argument("--out", default=os.path.join(REPO, "PARITY_MNIST.md"))
-    ap.add_argument("--engines", default="ref-C,tpu-f64,tpu-f32")
+    ap.add_argument("--engines",
+                    default="ref-C,tpu-f64,tpu-f32,tpu-bf16")
     ap.add_argument("--kinds", default="ANN,SNN")
     ap.add_argument("--results", default=None,
                     help="JSON cache: engine/kind cells already present "
@@ -295,6 +297,8 @@ def main():
         "* **tpu-f64**: this framework, fp64 XLA parity path (CPU backend)",
         "* **tpu-f32**: this framework, f32 Pallas VMEM-persistent kernel",
         "  on the TPU chip, MXU-default precision (throughput mode)",
+        "* **tpu-bf16**: the same kernel under `[dtype] bf16` (bf16",
+        "  storage; README dtype table)",
         "",
         "OPT% = first-try train accuracy, PASS% = test accuracy (the",
         "tutorial monitor's own stdout scrape).  The corpus is tuned so",

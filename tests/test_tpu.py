@@ -138,6 +138,24 @@ ON_CHIP_SUITE = """
     assert np.asarray(st_d.success).all()
     print("CHECK convergence OK", flush=True)
 
+    # --- [dtype] bf16 compiles and trains on Mosaic (round 3: bf16 used
+    # to fail three target constraints -- sub-32-bit scalarization, bf16
+    # matmul acc, bf16 vector cmpf; this guards the f32-scalar fixes) ----
+    wb = tuple(jnp.asarray(w, dtype=jnp.bfloat16) for w in weights)
+    w_b, st_b = train_epoch_pallas(wb, xs.astype(jnp.bfloat16),
+                                   ts.astype(jnp.bfloat16), "ANN", False)
+    # convergence-to-threshold is corpus-dependent under bf16 (dEp can
+    # oscillate at bf16 resolution on this tiny random corpus; the
+    # MNIST-shaped corpus converges -- PARITY_MNIST.md's bf16 column is
+    # the accuracy evidence).  Here: it must compile, train stably, and
+    # actually move the weights.
+    assert all(np.isfinite(np.asarray(w, np.float32)).all() for w in w_b)
+    assert any(not np.array_equal(np.asarray(a, np.float32),
+                                  np.asarray(b, np.float32))
+               for a, b in zip(w_b, wb))
+    assert np.asarray(st_b.n_iter).max() >= 31  # the MIN_BP_ITER floor
+    print("CHECK bf16 OK", flush=True)
+
     # --- f64 on TPU == f64 on CPU at the ChangeLog criterion ------------
     jax.config.update("jax_enable_x64", True)
     kern, _ = generate_kernel(77, 10, [7], 4)
@@ -164,7 +182,7 @@ ON_CHIP_SUITE = """
 """
 
 CHECKS = ("backend", "dispatch", "fused_kernels", "convergence",
-          "f64_parity")
+          "bf16", "f64_parity")
 
 
 def test_on_chip_suite():
