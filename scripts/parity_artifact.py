@@ -324,7 +324,12 @@ def main():
                 "784-300-10 SNN round costs ref-C >40 min; the same "
                 "pathology behind BENCH's 36k iters/sample).  The "
                 "reduced scale keeps the cycle tractable while the "
-                "engines remain directly comparable.",
+                "engines remain directly comparable.  The degenerate "
+                "fixed point the cycle settles into is dtype-sensitive "
+                "(tpu-bf16's noisier dEp stop lands on a different "
+                "attractor than the f64/f32/ref-C trio, which agree "
+                "exactly); BENCH's snn2c_bp row shows the regime where "
+                "SNN-BP convergence is real.",
                 "",
             ]
     lines += [
