@@ -153,3 +153,22 @@ def test_dtype_bf16_cli_roundtrip(corpus, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert len(re.findall(r"\[(?:PASS|FAIL)", out)) == N_SAMP
+
+
+def test_mixed_dtype_resume(corpus, capsys):
+    """kernel.opt is dtype-neutral f64 text: a round trained under
+    [dtype] f32 resumes under the default f64 parity mode (the
+    train-fast-then-verify workflow the BASELINE precision split
+    implies), and vice versa."""
+    text = open(str(corpus)).read()
+    with open("m.conf", "w") as fp:
+        fp.write(text + "[dtype] f32\n")
+    assert cli.train_nn_main(["-vv", "m.conf"]) == 0
+    capsys.readouterr()
+    with open("m.conf", "w") as fp:
+        fp.write(text.replace("[init] generate", "[init] kernel.opt"))
+    assert cli.train_nn_main(["-vv", "m.conf"]) == 0
+    out = capsys.readouterr().out
+    assert len(re.findall(r"N_ITER=", out)) == N_SAMP
+    k = load_kernel("kernel.opt")
+    assert k is not None and all(np.isfinite(w).all() for w in k.weights)
