@@ -302,7 +302,15 @@ def train_kernel(nn: NNDef) -> bool:
         return finish()
 
     dtype = _dtype_of(conf)
-    weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
+    # [dtype] bf16 keeps f32 MASTER weights on every training route
+    # (samples/activations stay bf16): pure-bf16 weight storage loses
+    # any update below a weight's bf16 ULP -- measured on the XRD BPM
+    # cycle as <1% of weights ever moving.  The Pallas kernel computes
+    # bf16 on the MXU against the f32 master; the XLA routes (DP/TP/
+    # non-TPU) promote the mixed bf16 x f32 matmuls to f32 -- mixed
+    # precision either way, never a silent training freeze.
+    wdtype = jnp.float32 if dtype == jnp.bfloat16 else dtype
+    weights = tuple(jnp.asarray(w, dtype=wdtype) for w in nn.kernel.weights)
     # LNN trains through the SNN fallthrough (libhpnn.c:1260-1261)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
     trace_weights(weights, "train-in")
@@ -531,7 +539,9 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
         jxb = global_array(host(xb), bsh)
         jtb = global_array(host(tb), bsh)
         jmb = global_array(host(mb), msh)
-        weights = tuple(global_array(host(np.asarray(w)), wsh(w))
+        # weights keep their OWN dtype (the f32 master under [dtype]
+        # bf16) -- host() would re-quantize them to the batch dtype
+        weights = tuple(global_array(np.asarray(w), wsh(w))
                         for w in weights)
     else:
         jxb = jnp.asarray(xb, dtype=dtype)

@@ -81,23 +81,29 @@ def _precision():
 def _acc(dtype):
     """Mosaic requires 32-bit matmul accumulators ([dtype] bf16 would not
     lower with a bf16 acc); f32 accumulation also keeps the 784-long
-    contractions from quantizing at bf16 resolution.  Results are cast
-    back to the storage dtype by the callers' consumers."""
+    contractions from quantizing at bf16 resolution."""
     return jnp.float32 if dtype == jnp.bfloat16 else dtype
 
 
 def _outer(d, h, precision):
-    """(1,N) x (1,M) -> (N,M) rank-1 product on the MXU."""
+    """(1,N) x (1,M) -> (N,M) rank-1 product on the MXU.
+
+    Returns the f32 ACCUMULATOR dtype, not the operand dtype: the result
+    feeds the master-weight update, and casting a bf16-mode update back
+    to bf16 re-quantizes it to zero for most weights (measured on the
+    XRD BPM cycle: under 1 percent of weights ever moved)."""
     return lax.dot_general(
         d, h, dimension_numbers=(((0,), (0,)), ((), ())),
-        preferred_element_type=_acc(d.dtype),
-        precision=precision).astype(d.dtype)
+        preferred_element_type=_acc(d.dtype), precision=precision)
 
 
 def _matvec(v, w_ref, precision):
-    """(1,M) @ (N,M)^T -> (1,N)."""
+    """(1,M) @ (N,M)^T -> (1,N) in the ACTIVATION dtype (the weight ref
+    may be an f32 master copy under bf16 mode; the operand is cast so the
+    MXU runs the bf16 path either way)."""
     return lax.dot_general(
-        v, w_ref[:], dimension_numbers=(((1,), (1,)), ((), ())),
+        v, w_ref[:].astype(v.dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
         preferred_element_type=_acc(v.dtype),
         precision=precision).astype(v.dtype)
 
@@ -105,7 +111,8 @@ def _matvec(v, w_ref, precision):
 def _matvec_t(d, w_ref, precision):
     """(1,N) @ (N,M) -> (1,M) (transposed matvec for hidden deltas)."""
     return lax.dot_general(
-        d, w_ref[:], dimension_numbers=(((1,), (0,)), ((), ())),
+        d, w_ref[:].astype(d.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=_acc(d.dtype),
         precision=precision).astype(d.dtype)
 
@@ -274,7 +281,12 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
     dtype = xs.dtype
     s = xs.shape[0]
 
-    wp = tuple(w.astype(dtype) for w in weights)
+    # bf16 mode keeps f32 MASTER weights in VMEM (activations, deltas and
+    # MXU operands run bf16): pure-bf16 storage quantizes BPM-scale
+    # updates (lr 5e-4) to zero -- the XRD cycle froze with <1% of
+    # weights ever changing.  f32/f64 modes are untouched (identity).
+    wdtype = _acc(dtype)  # same promotion rule as the accumulators
+    wp = tuple(w.astype(wdtype) for w in weights)
     # per-sample rows as (S, 1, width): Mosaic requires the last two block
     # dims to be (8k, 128k) OR the full array dims, so a (1, 1, width)
     # block over a 3D array is the shape a one-sample stream must take
@@ -301,9 +313,9 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
         in_specs=[per_s(xs.shape[1]), per_s(ts.shape[1])]
         + [const(w.shape) for w in wp],
         out_specs=[const(w.shape) for w in wp] + [per_s(LANE)],
-        out_shape=[jax.ShapeDtypeStruct(w.shape, dtype) for w in wp]
+        out_shape=[jax.ShapeDtypeStruct(w.shape, wdtype) for w in wp]
         + [jax.ShapeDtypeStruct((s, 1, LANE), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM(w.shape, dtype) for w in wp]
+        scratch_shapes=[pltpu.VMEM(w.shape, wdtype) for w in wp]
         if momentum else [],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),

@@ -22,7 +22,8 @@ Engines:
 
 * ``ref-C``    -- the serial C reference compiled from /root/reference;
 * ``tpu-f64``  -- this framework's fp64 XLA parity path (CPU backend);
-* ``tpu-bf16`` -- same kernel under [dtype] bf16 (storage-dtype mode);
+* ``tpu-bf16`` -- same kernel under [dtype] bf16 (bf16 compute over
+  f32 master weights);
 * ``tpu-f32``  -- this framework's f32 Pallas VMEM-persistent kernel on
   the TPU chip, MXU-default precision (the shipped throughput mode).
 
@@ -298,7 +299,7 @@ def main():
         "* **tpu-f32**: this framework, f32 Pallas VMEM-persistent kernel",
         "  on the TPU chip, MXU-default precision (throughput mode)",
         "* **tpu-bf16**: the same kernel under `[dtype] bf16` (bf16",
-        "  storage; README dtype table)",
+        "  compute over f32 master weights; README dtype table)",
         "",
         "OPT% = first-try train accuracy, PASS% = test accuracy (the",
         "tutorial monitor's own stdout scrape).  The corpus is tuned so",
