@@ -19,6 +19,7 @@ from .mesh import (
 from .tp import (
     tp_forward,
     tp_forward_colsharded,
+    tp_run_batch_colsharded,
     tp_forward_explicit,
     tp_run_batch,
     tp_train_epoch,
@@ -30,7 +31,8 @@ __all__ = [
     "make_mesh", "batch_sharding", "global_array", "replicated",
     "row_sharding", "shard_weights",
     "tp_forward", "tp_forward_colsharded", "tp_forward_explicit",
-    "tp_run_batch", "tp_train_epoch", "tp_train_sample",
+    "tp_run_batch", "tp_run_batch_colsharded", "tp_train_epoch",
+    "tp_train_sample",
     "batched_grads", "dp_shard", "dp_train_epoch",
     "dp_train_epoch_batched", "dp_train_step", "dp_train_step_momentum",
 ]

@@ -239,14 +239,8 @@ def tp_forward_colsharded(weights, x, kind: str, mesh):
     parallelism vs tensor parallelism in transformer stacks).  Remaining
     layers run replicated.
     """
-    k = mesh.shape[MODEL_AXIS]
-    w0 = jnp.asarray(weights[0])
-    m = w0.shape[1]
-    pad = (-m) % k
-    if pad:
-        w0 = jnp.concatenate(
-            [w0, jnp.zeros((w0.shape[0], pad), w0.dtype)], axis=1)
-        x = jnp.concatenate([jnp.asarray(x), jnp.zeros(pad, w0.dtype)])
+    w0, x = _pad_cols(jnp.asarray(weights[0]), jnp.asarray(x),
+                      mesh.shape[MODEL_AXIS])
 
     @functools.partial(
         shard_map, mesh=mesh,
@@ -256,9 +250,73 @@ def tp_forward_colsharded(weights, x, kind: str, mesh):
     def first_layer(w_blk, x_blk):
         return lax.psum(w_blk @ x_blk, MODEL_AXIS)
 
-    z0 = first_layer(w0, jnp.asarray(x))
+    z0 = first_layer(w0, x)
     from ..ops.activations import ann_act, snn_softmax
 
     if len(weights) == 1:  # single layer: z0 is the output pre-activation
         return snn_softmax(z0) if kind == steps.SNN else ann_act(z0)
     return steps.forward(tuple(weights[1:]), ann_act(z0), kind)[-1]
+
+
+def _pad_cols(w0, x, k):
+    """Zero-pad the contraction dim -- W_0's columns and the matching
+    input features (last axis of 1-D or 2-D x) -- to a multiple of k.
+    Exact: zero feature x zero column contributes nothing.  Pads carry
+    each array's OWN dtype so divisibility never changes compute
+    precision."""
+    pad = (-w0.shape[1]) % k
+    if pad:
+        w0 = jnp.concatenate(
+            [w0, jnp.zeros((w0.shape[0], pad), w0.dtype)], axis=1)
+        xpad = (pad,) if x.ndim == 1 else (x.shape[0], pad)
+        x = jnp.concatenate([x, jnp.zeros(xpad, x.dtype)], axis=-1)
+    return w0, x
+
+
+@functools.lru_cache(maxsize=None)
+def _colsharded_batch_fn(kind: str, mesh):
+    """Cached jitted batched col-sharded forward (a fresh closure per
+    call would re-trace and re-compile every invocation -- the same
+    convention as _tp_run_batch_fn)."""
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(P(None, MODEL_AXIS), P(None, MODEL_AXIS)),
+        out_specs=P(),
+        check_vma=False)  # psum output is replicated by construction
+    def first_layer(w_blk, x_blk):
+        return lax.psum(
+            lax.dot_general(x_blk, w_blk, (((1,), (1,)), ((), ()))),
+            MODEL_AXIS)
+
+    def fwd(w0, rest, xs):
+        from ..ops.activations import ann_act, snn_softmax
+
+        z0 = first_layer(w0, xs)
+        if not rest:
+            # snn_softmax works on the last axis: batch-safe as-is
+            return snn_softmax(z0) if kind == steps.SNN else ann_act(z0)
+        return steps.batched_forward(rest, ann_act(z0), kind)
+
+    return jax.jit(fwd)
+
+
+def tp_run_batch_colsharded(weights, xs, kind: str, mesh):
+    """Batched eval with the INPUT dimension sharded: the sequence-
+    parallel analog at run_kernel's batch granularity.
+
+    ``tp_forward_colsharded`` (above) carries the design note: where row
+    sharding all-gathers activations, column sharding psums partial
+    pre-activations -- the TP-vs-SP duality of transformer stacks, here
+    on the first (dominant) layer of the long-input XRD shape, whose
+    851-wide W_0 holds ~80% of the parameters.  xs (B, M) splits its
+    feature columns over the model axis; each device holds the matching
+    W_0 column block, computes a partial (B, N) product, and one
+    ``lax.psum`` over ICI reassembles it.  Remaining layers run
+    replicated (they are small).  Parity vs the replicated forward is
+    pinned by tests/test_parallel.py.
+    """
+    w0, xs = _pad_cols(jnp.asarray(weights[0]), jnp.asarray(xs),
+                       mesh.shape[MODEL_AXIS])
+    rest = tuple(jnp.asarray(w) for w in weights[1:])
+    return _colsharded_batch_fn(kind, mesh)(w0, rest, xs)

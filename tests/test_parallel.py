@@ -165,6 +165,27 @@ def test_tp_forward_colsharded_parity(kind):
 
 
 @pytest.mark.parametrize("kind", ["ANN", "SNN"])
+def test_tp_run_batch_colsharded_parity(kind):
+    """Batched input-dim sharding (run_kernel granularity): whole eval
+    batch, feature columns split over the model axis, one psum per
+    batch -- parity vs the replicated batched forward, psum in the HLO."""
+    import jax
+
+    from hpnn_tpu.parallel import tp_run_batch_colsharded
+
+    ws = _net([851, 16, 5], seed=22)
+    xs = jnp.asarray(RNG.uniform(-1, 1, (7, 851)))
+    mesh = make_mesh(n_data=1, n_model=8)
+    got = tp_run_batch_colsharded(ws, xs, kind, mesh)
+    want = ops.batched_forward(ws, xs, kind)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=1e-14)
+    txt = jax.jit(tp_run_batch_colsharded, static_argnames=(
+        "kind", "mesh")).lower(ws, xs, kind, mesh).compile().as_text()
+    assert ("all-reduce" in txt) or ("all_reduce" in txt)
+
+
+@pytest.mark.parametrize("kind", ["ANN", "SNN"])
 def test_dp_masked_padding_identity(kind):
     """A batch padded with masked-out rows must be numerically identical
     to the unpadded batch (api pads to a multiple of the data axis
