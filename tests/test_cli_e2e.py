@@ -172,3 +172,21 @@ def test_mixed_dtype_resume(corpus, capsys):
     assert len(re.findall(r"N_ITER=", out)) == N_SAMP
     k = load_kernel("kernel.opt")
     assert k is not None and all(np.isfinite(w).all() for w in k.weights)
+
+
+def test_bf16_bpm_moves_weights(corpus, capsys):
+    """The frozen-weights regression (round 3): pure-bf16 storage lost
+    BPM's lr=5e-4 updates below each weight's bf16 ULP (<1% of weights
+    ever moved on the XRD cycle).  With f32 master weights, bf16 BPM
+    training must move MOST weights."""
+    text = open(str(corpus)).read()
+    with open("bm.conf", "w") as fp:
+        fp.write(text.replace("[train] BP", "[train] BPM")
+                 + "[dtype] bf16\n")
+    assert cli.train_nn_main(["-vv", "bm.conf"]) == 0
+    capsys.readouterr()
+    k_tmp = load_kernel("kernel.tmp")
+    k_opt = load_kernel("kernel.opt")
+    for a, b in zip(k_tmp.weights, k_opt.weights):
+        frac = float(np.mean(np.asarray(a) != np.asarray(b)))
+        assert frac > 0.5, f"only {frac:.1%} of weights moved"
