@@ -334,8 +334,8 @@ def main():
                 "",
             ]
     lines += [
-        "Wall-time notes: tpu-f32 rounds include ~2s Python/JAX process",
-        "startup and ~2.5s compiled-program load through the axon tunnel",
+        "Wall-time notes: tpu-f32/bf16 rounds include ~2s Python/JAX",
+        "process startup and ~2.5s program load through the axon tunnel",
         "(persistent compilation cache enabled by the driver; a cold cache",
         "adds one-time Mosaic compilation to round 0).  The warm-process",
         "training itself is <1s/round (bench.py measures it directly).",
