@@ -183,6 +183,12 @@ def test_tp_run_batch_colsharded_parity(kind):
     txt = jax.jit(tp_run_batch_colsharded, static_argnames=(
         "kind", "mesh")).lower(ws, xs, kind, mesh).compile().as_text()
     assert ("all-reduce" in txt) or ("all_reduce" in txt)
+    # single-layer branch: z0 IS the output pre-activation
+    w1 = (ws[0],)
+    got1 = tp_run_batch_colsharded(w1, xs, kind, mesh)
+    want1 = ops.batched_forward(w1, xs, kind)
+    np.testing.assert_allclose(np.asarray(got1), np.asarray(want1),
+                               atol=1e-14)
 
 
 @pytest.mark.parametrize("kind", ["ANN", "SNN"])
