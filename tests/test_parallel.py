@@ -555,3 +555,43 @@ def test_batch_plus_model_single_device_warns(tmp_path, capsys,
     out = capsys.readouterr().out
     assert "TRAINING BATCH" in out
     assert "[model] 4 > 1 visible device(s); using 1" in out
+
+
+@pytest.mark.parametrize("extra,marker", [
+    ("[model] 2\n", "N_ITER="),               # TP per-sample grammar
+    ("[batch] 3\n", "TRAINING BATCH"),        # DP batch grammar
+    ("[batch] 3\n[model] 2\n", "TRAINING BATCH"),  # hybrid mesh
+])
+def test_bf16_composes_with_parallel_knobs(tmp_path, capsys, extra,
+                                           marker):
+    """[dtype] bf16 (f32 master weights) must compose with every
+    parallel route -- TP, DP, and the hybrid mesh (the f32-master cast
+    happens before the route dispatch, api.train_kernel)."""
+    import os
+
+    from hpnn_tpu.api import configure, train_kernel
+    from hpnn_tpu.utils import nn_log
+
+    rng = np.random.default_rng(4)
+    os.makedirs(tmp_path / "samples")
+    for k in range(6):
+        x = rng.uniform(0, 1, 8)
+        t = -np.ones(4)
+        t[k % 4] = 1.0
+        with open(tmp_path / "samples" / f"s{k}", "w") as f:
+            f.write("[input] 8\n" + " ".join(f"{v:.5f}" for v in x) + "\n")
+            f.write("[output] 4\n" + " ".join(f"{v:.1f}" for v in t) + "\n")
+    (tmp_path / "nn.conf").write_text(
+        "[name] c\n[type] ANN\n[init] generate\n[seed] 5\n[input] 8\n"
+        "[hidden] 6\n[output] 4\n[train] BP\n[dtype] bf16\n" + extra +
+        f"[sample_dir] {tmp_path}/samples\n"
+        f"[test_dir] {tmp_path}/samples\n")
+    nn_log.set_verbosity(2)
+    try:
+        nn = configure(str(tmp_path / "nn.conf"))
+        assert nn is not None and train_kernel(nn)
+    finally:
+        nn_log.set_verbosity(0)
+    out = capsys.readouterr().out
+    assert marker in out
+    assert all(np.isfinite(w).all() for w in nn.kernel.weights)
