@@ -1,0 +1,17 @@
+# Top-level convenience targets.  The reference's `make check` compiles
+# its demo programs and runs nothing (tests/Makefile.am has no TESTS
+# variable; /root/reference/README.md:71 claims otherwise); here it runs
+# the real suite -- CPU tiers on the virtual 8-device mesh, the on-chip
+# tier when a TPU is visible, and the native shim tier.
+
+check:
+	python -m pytest tests/ -q
+	$(MAKE) -C native check
+
+native:
+	$(MAKE) -C native
+
+bench:
+	python bench.py
+
+.PHONY: check native bench
