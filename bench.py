@@ -246,6 +246,12 @@ def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
         # (HPNN_PALLAS_PRECISION=highest, ~3x slower per iteration).
         # Resolved by the same helper the kernel uses.
         "mxu_precision": _mxu_precision_name() if path == "pallas" else None,
+        # When a third or more of the corpus runs to the 102399-iteration
+        # ceiling, the samples/sec value measures the MAX_ITER budget, not
+        # convergence -- the compiled reference shows the same pathology on
+        # the same corpora (PARITY_MNIST.md).  Flagged so the row cannot be
+        # read as a framework throughput claim (VERDICT r3 weak 4).
+        "bounded_by_max_iter": bool(n_max_iter * 3 >= n_samples),
     }
 
 

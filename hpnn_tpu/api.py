@@ -333,7 +333,7 @@ def train_kernel(nn: NNDef) -> bool:
         # libhpnn.c:1243-1283), reachable from the production driver.
         with phase("train_epoch_tp"):
             ok = _train_kernel_tp(nn, weights, xs, ts, kind, momentum,
-                                  events, finish, model_shards)
+                                  events, finish, model_shards, dtype)
     else:
         # the Pallas VMEM-persistent kernel serves f32/bf16 on TPU, the
         # XLA path serves fp64 parity and other backends
@@ -405,33 +405,36 @@ def _clamped_model_mesh(shards: int):
 
 
 def _train_kernel_tp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
-                     events, finish, shards: int) -> bool:
+                     events, finish, shards: int, dtype) -> bool:
     """Tensor-parallel per-sample training ([model] N / -S N).
 
     Builds a model-axis mesh and runs the whole epoch through
-    ``tp_train_epoch``: every sample's convergence while-loop runs SPMD
-    with the weight rows sharded ``P('model', None)`` and XLA-inserted
-    all-gathers per layer -- the reference's strategy (``ann.c:913-936``),
-    with zero-padding replacing its redundant remainder rows.  Weights
-    stay resident on the mesh across samples.  Sequential sample order
-    and every update rule are identical to the single-device path, so
-    logs and final weights match it (ulp-level: sharded compilation may
-    fuse differently).
+    ``tp_train_epoch`` -- ONE jitted ``lax.scan`` over the sample axis:
+    every sample's convergence while-loop runs SPMD with the weight rows
+    sharded ``P('model', None)`` and XLA-inserted all-gathers per layer --
+    the reference's strategy (``ann.c:913-936``), with zero-padding
+    replacing its redundant remainder rows.  Weights stay resident on the
+    mesh across the whole epoch.  Sequential sample order and every update
+    rule are identical to the single-device path, so logs and final
+    weights match it (ulp-level: sharded compilation may fuse
+    differently).
+
+    ``dtype`` is the CONF activation dtype: under [dtype] bf16 the
+    weights arriving here are the f32 masters while xs/ts cast to bf16,
+    so the matmuls run mixed bf16 x f32 exactly like the DP route
+    (ADVICE r3: deriving the cast from weights[0].dtype silently ran the
+    TP route in pure f32).
     """
     import jax.numpy as jnp
 
-    from .ops.convergence import SampleStats
     from .parallel import tp_train_epoch
 
     mesh, shards = _clamped_model_mesh(shards)
-    dtype = weights[0].dtype
-    w, per_sample = tp_train_epoch(
+    w, stats = tp_train_epoch(
         weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
         kind, momentum, mesh, alpha=0.2)
-    stats = SampleStats(*[np.asarray([getattr(s, f) for s in per_sample])
-                          for f in SampleStats._fields])
     # events' row index i is assigned in load order, so the i-th loaded
-    # row is the i-th stats entry
+    # row is the i-th entry of the scanned-out stats
     _emit_training_lines(events, stats, kind, momentum)
     nn.kernel.weights = [np.asarray(v, dtype=np.float64) for v in w]
     return finish()
@@ -508,6 +511,10 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
                f"(S={s}, batch={bsz} -> {bsz_pad} over {n_data} "
                "data-shard(s))\n")
 
+    # bf16 stages through f32 HOST buffers only: both device paths re-cast
+    # to the conf dtype (single-process jnp.asarray below; multi-process
+    # host() before global_array), so the compute dtype is launch-mode
+    # independent (ADVICE r3 checked exactly this)
     np_dtype = np.dtype(str(jnp.dtype(dtype))) if dtype != jnp.bfloat16 \
         else np.float32
     xb = np.zeros((n_batches, bsz_pad, xs.shape[1]), np_dtype)
