@@ -48,10 +48,17 @@ def select_train_epoch(dtype=None):
     throughput path on TPU -- the production analog of the reference's
     fused CUDA hot loop (``/root/reference/src/cuda_ann.cu:77-148``).
     """
+    from .convergence import chunked_epoch
+
     if _use_pallas(dtype):
         from .convergence_pallas import train_epoch_pallas
 
-        return train_epoch_pallas, "pallas"
+        return chunked_epoch(train_epoch_pallas), "pallas"
+    import jax
+
+    if jax.default_backend() == "tpu":
+        # the XLA scan path hits the same ~60 s launch watchdog at scale
+        return chunked_epoch(train_epoch), "xla"
     return train_epoch, "xla"
 
 

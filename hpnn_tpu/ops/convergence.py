@@ -164,3 +164,50 @@ def run_batch(weights, xs, kind: str):
     from .steps import batched_forward
 
     return batched_forward(weights, xs, kind)
+
+
+# Samples per device launch on TPU.  The axon TPU runtime kills any single
+# program that executes longer than ~60 s wall (measured round 4: a plain
+# XLA fori_loop of large matmuls dies at 60.1 s; the 60k-sample Pallas
+# epoch died the same way).  Chunking an epoch into bounded launches keeps
+# semantics EXACT -- per-sample training is sequential and the weights
+# carry from launch to launch on device -- while adding only
+# O(n_chunks x weights) HBM traffic and a handful of dispatches.  4096
+# random-corpus ANN-BP samples are ~12 s of device time (~2k iters/sample
+# at ~700k iters/s), a 5x margin under the watchdog.  Workloads whose
+# samples run to the 102399-iteration MAX (hard-corpus SNN-BP) need
+# HPNN_EPOCH_CHUNK lowered to ~256.
+EPOCH_CHUNK = 4096
+
+
+def _epoch_chunk() -> int:
+    import os
+
+    return int(os.environ.get("HPNN_EPOCH_CHUNK", EPOCH_CHUNK))
+
+
+def chunked_epoch(epoch_fn):
+    """Wrap a train-epoch callable so no single device launch exceeds the
+    TPU runtime's ~60 s execution watchdog (see EPOCH_CHUNK).
+
+    Exactness: each chunk resumes from the previous chunk's weights, so
+    the sample-sequential trajectory is identical to one launch; stats
+    are concatenated along the leading S axis.  The tail chunk compiles
+    a second program shape (cached thereafter)."""
+
+    @functools.wraps(epoch_fn)
+    def wrapped(weights, xs, ts, kind, momentum, **kw):
+        chunk = _epoch_chunk()
+        s = xs.shape[0]
+        if chunk <= 0 or s <= chunk:
+            return epoch_fn(weights, xs, ts, kind, momentum, **kw)
+        w, parts = weights, []
+        for lo in range(0, s, chunk):
+            w, st = epoch_fn(w, xs[lo:lo + chunk], ts[lo:lo + chunk],
+                             kind, momentum, **kw)
+            parts.append(st)
+        stats = SampleStats(*(jnp.concatenate([getattr(p, f) for p in parts])
+                              for f in SampleStats._fields))
+        return w, stats
+
+    return wrapped

@@ -183,14 +183,38 @@ def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
     rep = replicated(mesh)
     fn = _tp_epoch_fn(kind, momentum, shardings, rep,
                       tuple(sorted(kw.items())))
-    sharded, stats = fn(sharded, _place(jnp.asarray(xs), rep, mesh),
-                        _place(jnp.asarray(ts), rep, mesh))
+    # bounded launches on TPU (the ~60 s execution watchdog --
+    # ops.convergence.EPOCH_CHUNK); weights stay sharded-resident
+    # between chunks, so this adds only a few dispatches per epoch.
+    # Chunks are sliced from the INCOMING array (numpy or local device)
+    # and placed per chunk -- never eagerly concatenated or sliced as
+    # multi-process global arrays, which eager mode rejects; each
+    # chunk's stats are localized to host numpy immediately.
+    from ..ops.convergence import SampleStats, _epoch_chunk
+
+    import numpy as np
+
+    chunk = _epoch_chunk() if jax.default_backend() == "tpu" else 0
+    s = xs.shape[0]
+    if chunk <= 0 or s <= chunk:
+        sharded, stats = fn(sharded, _place(jnp.asarray(xs), rep, mesh),
+                            _place(jnp.asarray(ts), rep, mesh))
+        stats = _localize(stats)
+    else:
+        parts = []
+        for lo in range(0, s, chunk):
+            sharded, st = fn(
+                sharded, _place(jnp.asarray(xs[lo:lo + chunk]), rep, mesh),
+                _place(jnp.asarray(ts[lo:lo + chunk]), rep, mesh))
+            parts.append(_localize(st))
+        stats = SampleStats(*(np.concatenate([getattr(p, f) for p in parts])
+                              for f in SampleStats._fields))
     # multi-process: the row shards live on other hosts; replicate through
     # the cached identity (an all-gather over the model axis -- the
     # reference's post-update weight Allgather, ann.c:1636-1642) and read
     # the local replica
     final = _localize(_replicate_fn(rep)(sharded))
-    return unpad_topology(final, orig), _localize(stats)
+    return unpad_topology(final, orig), stats
 
 
 @functools.lru_cache(maxsize=64)

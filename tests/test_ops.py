@@ -222,3 +222,27 @@ def test_train_sample_all_variants_run(kind, momentum):
     new_ws, stats = ops.train_sample(ws, x, t, kind, momentum=momentum)
     assert np.isfinite(float(stats.final_dep))
     assert int(stats.n_iter) >= 1
+
+
+def test_chunked_epoch_matches_single_launch(monkeypatch):
+    """chunked_epoch (the TPU ~60s-watchdog guard) must be trajectory-exact:
+    chunks resume from the previous chunk's weights, so the result is
+    bitwise the single-launch epoch in f64."""
+    from hpnn_tpu.ops.convergence import chunked_epoch
+
+    kern, _ = generate_kernel(46, 6, [5], 3)
+    ws = tuple(jnp.asarray(w) for w in kern.weights)
+    n = 10
+    xs = jnp.asarray(RNG.uniform(-1, 1, (n, 6)))
+    ts_np = -np.ones((n, 3))
+    ts_np[np.arange(n), np.arange(n) % 3] = 1.0
+    ts = jnp.asarray(ts_np)
+    w_ref, st_ref = ops.train_epoch(ws, xs, ts, "ANN", False)
+    monkeypatch.setenv("HPNN_EPOCH_CHUNK", "3")  # 3+3+3+1: ragged tail
+    w_c, st_c = chunked_epoch(ops.train_epoch)(ws, xs, ts, "ANN", False)
+    for a, b in zip(w_ref, w_c):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(np.asarray(st_ref.n_iter), np.asarray(st_c.n_iter))
+    assert np.array_equal(np.asarray(st_ref.init_err),
+                          np.asarray(st_c.init_err))
+    assert st_c.n_iter.shape == (n,)
