@@ -65,6 +65,14 @@ C_REFERENCE_IPS = 2699.2
 # per-chip peak used for the MFU denominator: TPU v5e ~197 TFLOPS bf16
 # (f32 runs below this; MFU is therefore conservative for f32 configs)
 PEAK_TFLOPS_BF16 = 197.0
+# the reference CUDA backend's iteration-rate ceiling on ANY GPU, derived
+# from its per-iteration host synchronization (2 cudaMalloc + 2 cudaFree,
+# 4 blocking D2H reads incl. the host-side stop test, a CUDA_SYNC, and
+# 15-20 data-dependent launches per BP iteration -- full citation chain in
+# BASELINE.md "The >= single-V100 target").  40k/s assumes PERFECT launch
+# overlap; realistic serialization sits near 7k/s.  Compute is irrelevant
+# at 1.2 MFLOP/iter.  vs_v100_estimate = measured iters/sec / this.
+V100_CEILING_IPS = 40000.0
 
 
 def _sync(tree):
@@ -193,7 +201,7 @@ def _convergence_flops_per_iter(dims, momentum):
 
 
 def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
-                       dtype_str):
+                       dtype_str, repeats=REPEATS):
     import jax.numpy as jnp
 
     from hpnn_tpu.models.kernel import generate_kernel
@@ -213,7 +221,7 @@ def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
     _sync((w, stats.n_iter))
 
     times = []
-    for _ in range(REPEATS):
+    for _ in range(repeats):
         t0 = time.perf_counter()
         w, stats = train_epoch(weights, jxs, jts, kind, momentum)
         _sync((w,))
@@ -433,10 +441,13 @@ def main() -> None:
 
     import jax
 
-    if fallback:
+    if fallback or os.environ.get("JAX_PLATFORMS", "") == "cpu":
         from hpnn_tpu.runtime import apply_env_platforms
 
-        apply_env_platforms()  # the site hook preempts the env var
+        # the site hook preempts the env var: without this, an EXPLICIT
+        # JAX_PLATFORMS=cpu bench would silently run on the chip anyway
+        # (observed round 4) while claiming a CPU selection
+        apply_env_platforms()
     jax.config.update("jax_enable_x64", True)
 
     # under CPU fallback the Pallas stress kernels would run in interpret
@@ -447,6 +458,14 @@ def main() -> None:
         "mnist_ann_bp": lambda: _bench_convergence(
             "mnist_784-300-10_ann_bp", [784, 300, 10], "ANN", False,
             cs(2048), _mnist_corpus, "f32"),
+        # the reference-scale row (VERDICT r3 missing 1): the FULL
+        # tutorial sample count through the chunked Pallas epoch
+        # (HPNN_EPOCH_CHUNK launches under the ~60s watchdog).  One timed
+        # pass -- at ~2 min/epoch the median-of-3 protocol would triple
+        # the driver's bench budget for no extra information.
+        "mnist60k_ann_bp": lambda: _bench_convergence(
+            "mnist_784-300-10_ann_bp_60000", [784, 300, 10], "ANN", False,
+            cs(60000), _mnist_corpus, "f32", repeats=1),
         "xrd_ann_bpm": lambda: _bench_convergence(
             "xrd_851-230-230_ann_bpm", [851, 230, 230], "ANN", True,
             cs(128), _xrd_corpus, "f32"),
@@ -498,8 +517,12 @@ def main() -> None:
         except Exception as exc:  # a broken config must not hide the others
             records.append({"metric": name, "error": f"{type(exc).__name__}: {exc}"})
 
-    flagship = next((r for r in records if "mnist_784-300-10_ann_bp" in
-                     r.get("metric", "") and "error" not in r), None)
+    # EXACT metric match: the 60k row's name shares this prefix, and
+    # ratioing it against the 64-sample C baseline would inflate
+    # vs_baseline ~30% (ref-C measures 1.87 sps at 60k scale)
+    flagship = next((r for r in records
+                     if r.get("metric") == "mnist_784-300-10_ann_bp_f32"
+                     and "error" not in r), None)
     is_flagship = flagship is not None
     if flagship is None:
         flagship = next((r for r in records if "error" not in r),
@@ -517,6 +540,11 @@ def main() -> None:
         # to bf16 early-stopping inflating the samples/sec ratio
         "vs_baseline_iters": round(
             flagship.get("bp_iterations_per_sec", 0) / C_REFERENCE_IPS, 3)
+        if is_flagship else None,
+        # vs the reference CUDA backend's derived per-iteration-latency
+        # ceiling (BASELINE.md): >1 closes the ">= single-V100" target
+        "vs_v100_estimate": round(
+            flagship.get("bp_iterations_per_sec", 0) / V100_CEILING_IPS, 3)
         if is_flagship else None,
         "unit": flagship["unit"],
         "baseline": f"serial C reference {C_REFERENCE_SPS} samples/sec "
