@@ -1,0 +1,120 @@
+"""Decompose the DP epoch's time budget (VERDICT r3 weak 2: 1.2% MFU).
+
+Measures, on the ambient backend, for the flagship DP shape (784-300-10,
+16384 samples):
+
+1. the production ``dp_train_epoch_batched`` at several batch sizes
+   (per-step time = epoch time / n_batches);
+2. the bare fused step (``dp_train_step`` alone, weights fed back) at the
+   same batch sizes -- isolates lax.scan overhead;
+3. the raw forward GEMM chain at the same shapes -- the compute floor;
+4. a bf16-compute variant of the step -- isolates f32-vs-bf16 MXU rate.
+
+Prints one JSON line per measurement.  Chain >= 8 calls per sync (the
+axon tunnel RTT is ~65-80 ms; bench.py methodology).
+"""
+
+from __future__ import annotations
+
+import json
+import statistics
+import time
+
+import numpy as np
+
+REPEATS = 3
+CHAIN = 8
+
+
+def _sync(tree):
+    import jax
+    import jax.numpy as jnp
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return float(sum(jnp.sum(x.astype(jnp.float32)) for x in leaves))
+
+
+def measure(fn, state0, chain=CHAIN):
+    """Median wall of `chain` DEPENDENT calls ending in a scalar sync.
+
+    ``fn(state) -> state``: each call consumes the previous call's
+    output, so async dispatch cannot pipeline the chain away -- without
+    the data dependency, 8 identical dispatches overlap and small-batch
+    step times read far too low (round-4 review finding)."""
+    out = fn(state0)
+    _sync(out)
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        s = state0
+        for _ in range(chain):
+            s = fn(s)
+        _sync(s)
+        times.append((time.perf_counter() - t0) / chain)
+    return statistics.median(times)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models.kernel import generate_kernel
+    from hpnn_tpu.ops import bp_learn_rate
+    from hpnn_tpu.parallel.dp import dp_train_epoch, dp_train_step
+
+    jax.config.update("jax_enable_x64", True)
+    n = 16384
+    kern, _ = generate_kernel(10958, 784, [300], 10)
+    w_f32 = tuple(jnp.asarray(w, dtype=jnp.float32) for w in kern.weights)
+    rng = np.random.default_rng(42)
+    xs = rng.uniform(0, 255, (n, 784)) * (rng.uniform(0, 1, (n, 784)) > 0.8)
+    ts = -np.ones((n, 10))
+    ts[np.arange(n), rng.integers(0, 10, n)] = 1.0
+    lr = bp_learn_rate("ANN")
+    flops_sample = 6 * sum(w.shape[0] * w.shape[1] for w in w_f32)
+
+    records = []
+
+    def rec(name, bsz, seconds_per_step, n_steps=1, dtype="f32",
+            flops=None):
+        if flops is None:
+            flops = flops_sample * bsz
+        tf = flops / seconds_per_step / 1e12
+        records.append({
+            "name": name, "batch": bsz, "dtype": dtype,
+            "us_per_step": round(seconds_per_step * 1e6, 1),
+            "tflops": round(tf, 3),
+            "mfu_vs_197": round(tf / 197.0, 4)})
+        print(json.dumps(records[-1]), flush=True)
+
+    for dtype_name, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
+        w = tuple(x.astype(dtype) for x in w_f32)
+        jx = jnp.asarray(xs, dtype)
+        jt = jnp.asarray(ts, dtype)
+        for bsz in (256, 4096, 16384):
+            nb = n // bsz
+            # production epoch (scan over nb batches); weights chain
+            dt = measure(
+                lambda ww: dp_train_epoch(ww, jx, jt, "ANN", False, nb,
+                                          lr)[0], w)
+            rec("epoch_scan", bsz, dt / nb, dtype=dtype_name)
+            # bare fused step at the same batch shape (no scan)
+            xb = jx[:bsz]
+            tb = jt[:bsz]
+            dt = measure(lambda ww: dp_train_step(ww, xb, tb, "ANN",
+                                                  lr)[0], w)
+            rec("bare_step", bsz, dt, dtype=dtype_name)
+            # compute floor: fwd GEMM chain only -- chain a data
+            # dependency through the input (cheap scalar broadcast)
+            from hpnn_tpu.ops.steps import batched_forward
+
+            f = jax.jit(lambda xx: xx
+                        + 0 * jnp.sum(batched_forward(w, xx, "ANN")[-1]))
+            dt = measure(f, xb)
+            rec("fwd_only", bsz, dt, dtype=dtype_name,
+                flops=2 * bsz * sum(x.shape[0] * x.shape[1] for x in w))
+    print(json.dumps({"all": records}))
+
+
+if __name__ == "__main__":
+    main()

@@ -182,6 +182,12 @@ def run_engine(engine: str, workdir: str, rounds: int, kind: str):
                             capture_output=True, text=True, timeout=14400)
         dt = time.time() - t0
         assert tr.returncode == 0, (engine, rnd, tr.stderr[-2000:])
+        # eval loads the just-trained kernel.opt like the reference
+        # tutorial, which switches to the continuation conf before the
+        # first eval (tutorial.bash:102-104); evaluating the round-0
+        # [init] generate conf would score a FRESH kernel (round-4 fix:
+        # every engine's round-0 PASS cell used to be fresh-kernel noise)
+        write_conf(workdir, first=False, dtype=dtype, kind=kind)
         rn = subprocess.run(run_cmd, cwd=workdir, env=env,
                             capture_output=True, text=True, timeout=3600)
         assert rn.returncode == 0, (engine, rnd, rn.stderr[-2000:])
@@ -249,7 +255,11 @@ def main():
         meta_key = f"_meta_{kind}"
         meta = {"train": n_train, "test": n_test, "rounds": rounds,
                 "profile": profile, "classes": scale["classes"],
-                "hidden": scale["hidden"]}
+                "hidden": scale["hidden"],
+                # semantic stamp: round-0 eval loads kernel.opt (the
+                # round-4 fix) -- caches recorded under the old behavior
+                # scored a FRESH kernel there and must re-run
+                "eval": "kernel.opt"}
         if isinstance(all_results.get(meta_key), dict):
             # caches written before the classes/hidden stamping were all
             # recorded at 10 classes and the current KIND_SCALE widths

@@ -63,6 +63,11 @@ sys.path.insert(0, REPO)
 
 from parity_artifact import build_oracle, make_corpus, scrape  # noqa: E402
 
+# bump when the meaning of recorded cells changes (1 = round-0 eval
+# scored a fresh kernel; 2 = eval always loads the just-trained
+# kernel.opt, matching tutorial.bash:102-104)
+EVAL_SEMANTICS = 2
+
 CONF = """[name] scale60k
 [type] ANN
 [init] {init}
@@ -114,6 +119,11 @@ def run_tpu_cycle(workdir, rounds):
                             capture_output=True, text=True, timeout=14400)
         t_train = time.time() - t0
         assert tr.returncode == 0, (rnd, tr.stderr[-2000:])
+        # eval ALWAYS loads the just-trained kernel.opt: the reference
+        # tutorial switches to the continuation conf before the first
+        # eval (tutorial.bash:102-104) -- evaluating the round-0 conf
+        # as-is would re-[init] a fresh kernel
+        write_conf(workdir, first=False, dtype="f32")
         t0 = time.time()
         rn = subprocess.run(run_cmd, cwd=workdir, env=env,
                             capture_output=True, text=True, timeout=7200)
@@ -251,15 +261,6 @@ def subset_workdir(base, full_workdir, n_train, n_test):
     return sub
 
 
-def run_ref_cycle(workdir, rounds):
-    """Full ref-C rounds (small corpora only -- serial C), via
-    parity_artifact's tested engine runner (same conf shape: ANN
-    784-300-10 BP seed 10958)."""
-    from parity_artifact import run_engine
-
-    rows = run_engine("ref-C", workdir, rounds, "ANN")
-    return [{"round": i, "opt": opt, "pass": acc, "t_train": round(dt, 1)}
-            for i, (opt, acc, dt) in enumerate(rows)]
 
 
 def run_hard_sweep(base, args, res, save):
@@ -275,16 +276,27 @@ def run_hard_sweep(base, args, res, save):
             wd = subset_workdir(base, full, n, max(100, n // 10))
             sweep[key] = run_tpu_cycle(wd, 2)
             save()
-    if "ref-2000" not in sweep:
-        print("[sweep] ref-C 1+2 rounds at n=2000 ...", flush=True)
+    # cross-engine cells at the mid scale: the serial C reference (f64
+    # exact) and this framework's own f64 parity oracle, same corpus --
+    # together they separate "engine defect" from "algorithmic
+    # instability" and "dtype sensitivity"
+    for eng_key, engine in (("ref-2000", "ref-C"), ("f64-2000", "tpu-f64")):
+        if eng_key in sweep:
+            continue
+        print(f"[sweep] {engine} 1+2 rounds at n=2000 ...", flush=True)
         wd = subset_workdir(base, full, 2000, 200)
-        ref_wd = os.path.join(base, "work-hard-2000-ref")
-        if not os.path.exists(os.path.join(ref_wd, "samples")):
-            os.makedirs(ref_wd, exist_ok=True)
+        eng_wd = os.path.join(base, f"work-hard-2000-{engine}")
+        if not os.path.exists(os.path.join(eng_wd, "samples")):
+            os.makedirs(eng_wd, exist_ok=True)
             for d in ("samples", "tests"):
                 os.symlink(os.path.join(os.path.abspath(wd), d),
-                           os.path.join(ref_wd, d))
-        sweep["ref-2000"] = run_ref_cycle(ref_wd, 2)
+                           os.path.join(eng_wd, d))
+        from parity_artifact import run_engine
+
+        rows = run_engine(engine, eng_wd, 2, "ANN")
+        sweep[eng_key] = [
+            {"round": i, "opt": opt, "pass": acc, "t_train": round(dt, 1)}
+            for i, (opt, acc, dt) in enumerate(rows)]
         save()
 
 
@@ -309,6 +321,17 @@ def main():
     res = {}
     if args.results and os.path.exists(args.results):
         res = json.load(open(args.results))
+    # cells recorded before the round-0 eval-conf fix scored a FRESH
+    # kernel in round 0's PASS column; they must not be mixed with
+    # post-fix cells in one table (round-4 review finding)
+    if res and res.get("_eval_semantics") != EVAL_SEMANTICS:
+        for prof in list(res):
+            if isinstance(res[prof], dict):
+                res[prof].pop("tpu", None)
+        res.pop("hard_sweep", None)
+        print("cache predates the round-0 eval fix; cycle cells dropped",
+              flush=True)
+    res["_eval_semantics"] = EVAL_SEMANTICS
 
     def save():
         if args.results:
@@ -447,25 +470,34 @@ def render(args, res, profiles):
             "| n_train | engine | OPT% r0 | r1 | r2 | PASS% r0 | r1 | r2 |",
             "|---|---|---|---|---|---|---|---|",
         ]
-        for key in ("tpu-200", "ref-2000", "tpu-2000", "tpu-20000"):
+        names = {"tpu": "tpu-f32", "ref": "ref-C", "f64": "tpu-f64"}
+        for key in ("tpu-200", "ref-2000", "f64-2000", "tpu-2000",
+                    "tpu-20000"):
             if key not in sw:
                 continue
             eng, n = key.split("-")
             rows = sw[key]
             opts = " | ".join(f"{r['opt']:.1f}" for r in rows)
             accs = " | ".join(f"{r['pass']:.1f}" for r in rows)
-            lines.append(f"| {n} | {'tpu-f32' if eng == 'tpu' else 'ref-C'}"
-                         f" | {opts} | {accs} |")
+            lines.append(f"| {n} | {names[eng]} | {opts} | {accs} |")
         lines += [
             "",
-            "Same engine, same profile, growing corpus: the curve climbs",
-            "at 200, weakens by 2000 (where ref-C shows the same shape),",
-            "and is chance by 20000 -- online per-sample-to-convergence",
-            "training does not average gradients over a large corpus; the",
-            "end-of-epoch kernel is dominated by the last samples seen.",
-            "This is the training algorithm the reference defines, at a",
-            "scale its serial engine cannot reach on synthetic corpora",
-            "this hard.",
+            "Same profile, growing corpus: the round-0 ok_bits prefix",
+            "shows EVERY run learns the class structure within the first",
+            "~200 samples; what varies with corpus size (and with the",
+            "seeded shuffle order it implies) is whether continued online",
+            "per-sample-to-convergence training STAYS on the learned",
+            "attractor -- stable at 200 and 20000, degrading at 2000,",
+            "fully collapsed at 60000.  The ref-C (exact f64, serial C)",
+            "and tpu-f64 (this framework's parity oracle) cells at the",
+            "mid scale pin the behavior to the reference's training",
+            "algorithm, not to an engine or dtype: online training does",
+            "not average gradients over a corpus, so the end-of-epoch",
+            "kernel is dominated by the most recent samples, and corpus",
+            "hardness/order decides whether that is stabilizing or",
+            "destructive.  This is the algorithm the reference defines,",
+            "exercised at a scale its serial engine cannot reach on",
+            "corpora this hard.",
             "",
         ]
     lines += [
