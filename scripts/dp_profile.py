@@ -1,119 +1,141 @@
-"""Decompose the DP epoch's time budget (VERDICT r3 weak 2: 1.2% MFU).
+"""Decompose the DP epoch's time budget (VERDICT r3 weak 2: "1.2% MFU").
 
-Measures, on the ambient backend, for the flagship DP shape (784-300-10,
-16384 samples):
+Round-4 finding: the low MFU was a MEASUREMENT artifact, not a compute
+bound.  Any timing whose per-sync device work is below the axon tunnel's
+~66 ms round-trip reads ~(RTT / calls-per-sync) per call no matter the
+kernel -- the old bench chained 8 one-dispatch epochs per sync, so its
+"epoch time" was 66/8 + compute ms.  With an in-launch ``lax.fori_loop``
+driving hundreds of DEPENDENT epochs per dispatch (device work >> RTT),
+the flagship DP epoch measures ~0.4-0.8 ms on device -- 30-60 TFLOPS
+f32, i.e. 15-30% of bf16 peak -- and the pieces below decompose it.
 
-1. the production ``dp_train_epoch_batched`` at several batch sizes
-   (per-step time = epoch time / n_batches);
-2. the bare fused step (``dp_train_step`` alone, weights fed back) at the
-   same batch sizes -- isolates lax.scan overhead;
-3. the raw forward GEMM chain at the same shapes -- the compute floor;
-4. a bf16-compute variant of the step -- isolates f32-vs-bf16 MXU rate.
+Methodology: every workload is wrapped as ``state -> state`` with a
+scalar data dependency (``v + 0 * sum(out)``) so neither XLA nor async
+dispatch can skip or overlap iterations, then iterated ``ITERS`` times
+inside ONE jitted fori_loop, timed over one sync.  The residual RTT
+contribution is RTT/ITERS (< 1% at 200 iters).
 
-Prints one JSON line per measurement.  Chain >= 8 calls per sync (the
-axon tunnel RTT is ~65-80 ms; bench.py methodology).
+Prints one JSON line per measurement.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import statistics
+import sys
 import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+ITERS = 200
 REPEATS = 3
-CHAIN = 8
-
-
-def _sync(tree):
-    import jax
-    import jax.numpy as jnp
-
-    leaves = jax.tree_util.tree_leaves(tree)
-    return float(sum(jnp.sum(x.astype(jnp.float32)) for x in leaves))
-
-
-def measure(fn, state0, chain=CHAIN):
-    """Median wall of `chain` DEPENDENT calls ending in a scalar sync.
-
-    ``fn(state) -> state``: each call consumes the previous call's
-    output, so async dispatch cannot pipeline the chain away -- without
-    the data dependency, 8 identical dispatches overlap and small-batch
-    step times read far too low (round-4 review finding)."""
-    out = fn(state0)
-    _sync(out)
-    times = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        s = state0
-        for _ in range(chain):
-            s = fn(s)
-        _sync(s)
-        times.append((time.perf_counter() - t0) / chain)
-    return statistics.median(times)
 
 
 def main():
     import jax
     import jax.numpy as jnp
+    from jax import lax
 
     from hpnn_tpu.models.kernel import generate_kernel
-    from hpnn_tpu.ops import bp_learn_rate
-    from hpnn_tpu.parallel.dp import dp_train_epoch, dp_train_step
+    from hpnn_tpu.ops import bp_learn_rate, steps
+    from hpnn_tpu.parallel.dp import (batched_grads, dp_train_epoch_batched,
+                                      dp_train_step)
 
     jax.config.update("jax_enable_x64", True)
+
+    from bench import (PEAK_TFLOPS_BF16, _dp_flops_per_sample,
+                       _measure_sync_rtt, _sync as sync)
+
+    # one-sync cost (dispatch + tunnel round-trip), subtracted from every
+    # wall measurement below -- at 200 iters of a ~35 us workload the RTT
+    # would otherwise inflate per-iter readings ~10x (round-4 review)
+    rtt = statistics.median([_measure_sync_rtt() for _ in range(5)])
+    print(json.dumps({"name": "sync_rtt", "us": round(rtt * 1e6, 1)}),
+          flush=True)
+
+    def timeit(name, f, arg, flops, iters=ITERS):
+        """In-launch dependent iteration: state -> state via scalar dep.
+        Reports (wall - RTT) / iters; iters is scaled per workload so the
+        device work also dominates the residual."""
+        def dep(v):
+            out = f(v)
+            s = sum(jnp.sum(q.astype(jnp.float32))
+                    for q in jax.tree_util.tree_leaves(out))
+            return jax.tree_util.tree_map(
+                lambda q: q + (0 * s).astype(q.dtype), v)
+
+        g = jax.jit(lambda a: lax.fori_loop(0, iters,
+                                            lambda i, v: dep(v), a))
+        sync(g(arg))
+        times = []
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            sync(g(arg))
+            # floored like bench._bench_dp: tunnel jitter must not turn a
+            # fast workload's reading negative
+            times.append(max(time.perf_counter() - t0 - rtt, 1e-9) / iters)
+        dt = statistics.median(times)
+        tf = flops / dt / 1e12
+        print(json.dumps({"name": name, "us_per_iter": round(dt * 1e6, 1),
+                          "tflops": round(tf, 2),
+                          "mfu_vs_197": round(tf / PEAK_TFLOPS_BF16, 4),
+                          "iters_in_launch": iters}), flush=True)
+
     n = 16384
     kern, _ = generate_kernel(10958, 784, [300], 10)
-    w_f32 = tuple(jnp.asarray(w, dtype=jnp.float32) for w in kern.weights)
+    w0 = tuple(jnp.asarray(w, dtype=jnp.float32) for w in kern.weights)
     rng = np.random.default_rng(42)
     xs = rng.uniform(0, 255, (n, 784)) * (rng.uniform(0, 1, (n, 784)) > 0.8)
     ts = -np.ones((n, 10))
     ts[np.arange(n), rng.integers(0, 10, n)] = 1.0
     lr = bp_learn_rate("ANN")
-    flops_sample = 6 * sum(w.shape[0] * w.shape[1] for w in w_f32)
 
-    records = []
+    # both the BASELINE bsz=256 shape and the MXU-sized 4096 variant
+    for bsz in (256, 4096):
+        nb = n // bsz
+        xb = jnp.asarray(xs.reshape(nb, bsz, -1), jnp.float32)
+        tb = jnp.asarray(ts.reshape(nb, bsz, -1), jnp.float32)
+        mb = jnp.ones((nb, bsz), jnp.float32)
+        x1, t1, m1 = xb[0], tb[0], mb[0]
+        fl_fwd = 2 * bsz * sum(w.shape[0] * w.shape[1] for w in w0)
+        fl_step = bsz * _dp_flops_per_sample([w.shape for w in w0])
+        fl_epoch = nb * fl_step
 
-    def rec(name, bsz, seconds_per_step, n_steps=1, dtype="f32",
-            flops=None):
-        if flops is None:
-            flops = flops_sample * bsz
-        tf = flops / seconds_per_step / 1e12
-        records.append({
-            "name": name, "batch": bsz, "dtype": dtype,
-            "us_per_step": round(seconds_per_step * 1e6, 1),
-            "tflops": round(tf, 3),
-            "mfu_vs_197": round(tf / 197.0, 4)})
-        print(json.dumps(records[-1]), flush=True)
+        # iters scaled so iters x expected-per-iter >> RTT even for the
+        # ~tens-of-us pieces
+        timeit(f"fwd_batched_b{bsz}",
+               lambda x: steps.batched_forward(w0, x, "ANN"), x1, fl_fwd,
+               iters=4000)
+        timeit(f"grads_b{bsz}",
+               lambda x: batched_grads(w0, x, t1, "ANN", m1), x1, fl_step,
+               iters=2000)
+        timeit(f"step_b{bsz}",
+               lambda w: dp_train_step(w, x1, t1, "ANN", lr, m1)[0], w0,
+               fl_step, iters=2000)
+        timeit(f"epoch_scan_16384_b{bsz}",
+               lambda w: dp_train_epoch_batched(w, xb, tb, mb, "ANN",
+                                                False, lr)[0], w0,
+               fl_epoch, iters=500)
 
-    for dtype_name, dtype in (("f32", jnp.float32), ("bf16", jnp.bfloat16)):
-        w = tuple(x.astype(dtype) for x in w_f32)
-        jx = jnp.asarray(xs, dtype)
-        jt = jnp.asarray(ts, dtype)
-        for bsz in (256, 4096, 16384):
-            nb = n // bsz
-            # production epoch (scan over nb batches); weights chain
-            dt = measure(
-                lambda ww: dp_train_epoch(ww, jx, jt, "ANN", False, nb,
-                                          lr)[0], w)
-            rec("epoch_scan", bsz, dt / nb, dtype=dtype_name)
-            # bare fused step at the same batch shape (no scan)
-            xb = jx[:bsz]
-            tb = jt[:bsz]
-            dt = measure(lambda ww: dp_train_step(ww, xb, tb, "ANN",
-                                                  lr)[0], w)
-            rec("bare_step", bsz, dt, dtype=dtype_name)
-            # compute floor: fwd GEMM chain only -- chain a data
-            # dependency through the input (cheap scalar broadcast)
-            from hpnn_tpu.ops.steps import batched_forward
+        def unrolled(w):
+            for i in range(nb):
+                w, _ = dp_train_step(w, xb[i], tb[i], "ANN", lr, mb[i])
+            return w
 
-            f = jax.jit(lambda xx: xx
-                        + 0 * jnp.sum(batched_forward(w, xx, "ANN")[-1]))
-            dt = measure(f, xb)
-            rec("fwd_only", bsz, dt, dtype=dtype_name,
-                flops=2 * bsz * sum(x.shape[0] * x.shape[1] for x in w))
-    print(json.dumps({"all": records}))
+        if nb <= 8:  # unrolling 64 steps would blow compile time
+            timeit(f"epoch_unrolled_16384_b{bsz}", unrolled, w0, fl_epoch,
+                   iters=500)
+
+        # bf16 compute variant of the epoch (f32 was already MXU-default)
+        wb = tuple(w.astype(jnp.bfloat16) for w in w0)
+        timeit(f"epoch_scan_bf16_b{bsz}",
+               lambda w: dp_train_epoch_batched(
+                   w, xb.astype(jnp.bfloat16), tb.astype(jnp.bfloat16),
+                   mb.astype(jnp.bfloat16), "ANN", False, lr)[0], wb,
+               fl_epoch, iters=500)
 
 
 if __name__ == "__main__":
