@@ -96,14 +96,21 @@ def _mxu_precision_name() -> str:
 
 
 def _measure_sync_rtt():
-    """One-round-trip cost of the scalar sync itself (reported in JSON)."""
+    """One-round-trip cost of the scalar sync itself (reported in JSON).
+
+    Median of several samples: this value is SUBTRACTED from timed walls,
+    so a single tunnel latency spike would bias every repeat identically
+    and the measurement medians could not correct it."""
     import jax.numpy as jnp
 
     x = jnp.zeros((8, 128), jnp.float32)
     _sync(x)
-    t0 = time.perf_counter()
-    _sync(x)
-    return time.perf_counter() - t0
+    samples = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        _sync(x)
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples)
 
 
 def _mnist_corpus(n, rng_seed=42):
@@ -300,20 +307,23 @@ def _bench_stress():
                                  tile_m=512)
         return v
 
+    rtt = _measure_sync_rtt()
+
     def measure(fwd):
         f = jax.jit(fwd)
         _sync(f(weights, xs))
         times = []
         for _ in range(REPEATS):
             # n_in == n_out, so chain the net end-to-end `chain` times
-            # (async dispatches pipeline; ONE scalar sync at the end) --
-            # amortizes the ~65 ms tunnel round-trip over real MXU work
+            # (async dispatches pipeline; ONE scalar sync at the end);
+            # the measured one-sync cost is subtracted -- at chain=20 it
+            # would otherwise inflate the per-pass time ~13% (round 4)
             t0 = time.perf_counter()
             out = xs
             for _ in range(chain):
                 out = f(weights, out)
             _sync(out)
-            times.append(time.perf_counter() - t0)
+            times.append(max(time.perf_counter() - t0 - rtt, 1e-9))
         dt = statistics.median(times)
         return dt, flops / dt / 1e12
 
@@ -331,6 +341,9 @@ def _bench_stress():
                 f"pallas<{_XLA_TAKEOVER_DIM})",
         "tflops_all_pallas_kernel": round(tflops_pallas, 3),
         "mfu_all_pallas_kernel": round(tflops_pallas / PEAK_TFLOPS_BF16, 4),
+        # the one-sync cost subtracted from each timed wall (auditable:
+        # raw wall = seconds * chain_per_sync... + sync_rtt_s)
+        "sync_rtt_s": round(rtt, 4),
     }
 
 
