@@ -438,30 +438,67 @@ def _dp_flops_per_sample(shapes):
     return total
 
 
-def _probe_backend(timeout_s: int = 240) -> bool:
-    """Can the ambient backend actually initialize?
+def _probe_backend_once(timeout_s: int) -> tuple[bool, str]:
+    """One device-discovery attempt in a THROWAWAY subprocess.
 
     The axon tunnel can wedge so hard that jax.devices() blocks forever
     (observed: >6 h after a killed client; the lease never frees).  A
-    benchmark that hangs reports nothing, so probe device discovery in a
-    THROWAWAY subprocess first and fall back to CPU when it stalls.
+    benchmark that hangs reports nothing, so probe discovery out of
+    process; the subprocess is safe to time out because it never holds
+    a lease the parent needs (only long-LIVED killed clients wedge it).
     """
-    import os
     import subprocess
     import sys
 
-    # only an EXPLICIT cpu selection skips the probe: with the var unset
-    # the image's site hook still registers (and selects) the TPU plugin
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return True
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax; jax.devices(); print('up')"],
             capture_output=True, text=True, timeout=timeout_s)
-        return r.returncode == 0 and "up" in r.stdout
+        if r.returncode == 0 and "up" in r.stdout:
+            return True, "up"
+        return False, f"rc={r.returncode}: {r.stderr.strip()[-200:]}"
     except subprocess.TimeoutExpired:
-        return False
+        return False, f"timeout after {timeout_s}s"
+
+
+def _probe_backend(max_wait_s: int = 900, attempt_timeout_s: int = 120,
+                   backoff_s: int = 120) -> tuple[bool, list]:
+    """Probe with bounded retry: tunnel wedges are usually TRANSIENT
+    lease states (round-4 postmortem: a single 240 s probe declared the
+    chip dead while the lease freed minutes later, and the whole round's
+    driver capture silently became a CPU measurement).  Retry every
+    ``backoff_s`` for up to ``max_wait_s`` and keep the per-attempt
+    history for the output JSON.
+
+    Returns (reachable, probe_history).
+    """
+    import os
+    import time as _time
+
+    # only an EXPLICIT cpu selection skips the probe: with the var unset
+    # the image's site hook still registers (and selects) the TPU plugin
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        return True, [{"attempt": 0, "result": "skipped: JAX_PLATFORMS=cpu"}]
+    history = []
+    deadline = _time.monotonic() + max_wait_s
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = _time.monotonic()
+        ok, detail = _probe_backend_once(attempt_timeout_s)
+        history.append({"attempt": attempt, "result": detail,
+                        "seconds": round(_time.monotonic() - t0, 1)})
+        if ok:
+            return True, history
+        remaining = deadline - _time.monotonic()
+        if remaining <= 0:
+            return False, history
+        import sys
+        sys.stderr.write(
+            f"bench: device probe attempt {attempt} failed ({detail}); "
+            f"retrying in {backoff_s}s ({int(remaining)}s left)\n")
+        _time.sleep(min(backoff_s, remaining))
 
 
 def main() -> None:
@@ -473,12 +510,16 @@ def main() -> None:
     import os
     import sys
 
-    fallback = not _probe_backend()
+    explicit_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    reachable, probe_history = _probe_backend()
+    fallback = not reachable
     if fallback:
         sys.stderr.write(
-            "WARNING: device backend unreachable (tunnel wedged?); "
+            "WARNING: device backend unreachable after "
+            f"{len(probe_history)} probe attempts (tunnel wedged?); "
             "benchmarking on CPU -- throughput numbers are NOT chip "
-            "numbers (tpu_unreachable=true in the JSON)\n")
+            "numbers (tpu_unreachable=true in the JSON, exit code 3, "
+            "BENCH_FALLBACK.json marker written)\n")
         os.environ["JAX_PLATFORMS"] = "cpu"
 
     import jax
@@ -567,10 +608,12 @@ def main() -> None:
                      and "error" not in r), None)
     is_flagship = flagship is not None
     if flagship is None:
-        flagship = next((r for r in records if "error" not in r),
+        # "skipped" placeholder records carry no value -- never elect one
+        flagship = next((r for r in records
+                         if "error" not in r and "value" in r),
                         {"metric": "none", "value": 0.0,
                          "unit": "samples/sec/chip"})
-    print(json.dumps({
+    out = {
         "metric": flagship["metric"],
         "value": flagship["value"],
         # the C baseline is the flagship MNIST workload; comparing any
@@ -599,8 +642,23 @@ def main() -> None:
         # honest flag: True means the chip was unreachable and every number
         # below is a CPU measurement, comparable to nothing chip-side
         "tpu_unreachable": fallback,
+        "probe_history": probe_history,
         "configs": records,
-    }))
+    }
+    print(json.dumps(out))
+    import pathlib
+    marker = pathlib.Path(__file__).resolve().parent / "BENCH_FALLBACK.json"
+    if fallback:
+        # a CPU capture must never masquerade as the round's chip number:
+        # leave a marker file next to the driver's BENCH_rNN.json and exit
+        # non-zero so automation notices even if it ignores the flag
+        marker.write_text(json.dumps(out) + "\n")
+        sys.exit(3)
+    if not explicit_cpu:
+        # a real CHIP capture clears any stale marker from an earlier
+        # wedged run; a deliberate JAX_PLATFORMS=cpu sanity pass proves
+        # nothing about the tunnel and must leave the marker alone
+        marker.unlink(missing_ok=True)
 
 
 if __name__ == "__main__":
