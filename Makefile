@@ -1,10 +1,27 @@
 # Top-level convenience targets.  The reference's `make check` compiles
 # its demo programs and runs nothing (tests/Makefile.am has no TESTS
 # variable; /root/reference/README.md:71 claims otherwise); here it runs
-# the real suite -- CPU tiers on the virtual 8-device mesh, the on-chip
-# tier when a TPU is visible, and the native shim tier.
+# the real suite, tiered so a fresh clone can verify quickly:
+#
+#   make check      fast CPU tiers (~1-2 min on the 1-core host):
+#                   core ops/io/conf/tools + parallel/Pallas/CLI-e2e on
+#                   the virtual 8-device mesh
+#   make check-all  everything: + compiled-reference oracle byte-parity,
+#                   native C shim, tutorials, multi-process coordination,
+#                   graft entry, on-chip tier (skips without a TPU), and
+#                   the native demo build (~10-12 min total)
+
+FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
+             tests/test_samples.py tests/test_glibc_random.py \
+             tests/test_tools.py tests/test_api_quirks.py \
+             tests/test_native_io.py
+MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
+             tests/test_pallas_convergence.py tests/test_cli_e2e.py
 
 check:
+	python -m pytest $(FAST_TESTS) $(MESH_TESTS) -q
+
+check-all:
 	python -m pytest tests/ -q
 	$(MAKE) -C native check
 
@@ -14,4 +31,4 @@ native:
 bench:
 	python bench.py
 
-.PHONY: check native bench
+.PHONY: check check-all native bench

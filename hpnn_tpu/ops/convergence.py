@@ -166,46 +166,212 @@ def run_batch(weights, xs, kind: str):
     return batched_forward(weights, xs, kind)
 
 
-# Samples per device launch on TPU.  The axon TPU runtime kills any single
-# program that executes longer than ~60 s wall (measured round 4: a plain
-# XLA fori_loop of large matmuls dies at 60.1 s; the 60k-sample Pallas
-# epoch died the same way).  Chunking an epoch into bounded launches keeps
-# semantics EXACT -- per-sample training is sequential and the weights
-# carry from launch to launch on device -- while adding only
-# O(n_chunks x weights) HBM traffic and a handful of dispatches.  4096
-# random-corpus ANN-BP samples are ~12 s of device time (~2k iters/sample
-# at ~700k iters/s), a 5x margin under the watchdog.  Workloads whose
-# samples run to the 102399-iteration MAX (hard-corpus SNN-BP) need
-# HPNN_EPOCH_CHUNK lowered to ~256.
+# Max samples per device launch on TPU.  The axon TPU runtime kills any
+# single program that executes longer than ~60 s wall (measured round 4:
+# a plain XLA fori_loop of large matmuls dies at 60.1 s; the 60k-sample
+# Pallas epoch died the same way).  Chunking an epoch into bounded
+# launches keeps semantics EXACT -- per-sample training is sequential and
+# the weights carry from launch to launch on device -- while adding only
+# O(n_chunks x weights) HBM traffic and a handful of dispatches.
 EPOCH_CHUNK = 4096
 
+# Adaptive launch sizing (see AdaptiveChunker): device seconds a launch
+# may cost in the WORST case (margin under the ~60 s watchdog), the
+# pessimistic iteration rate assumed before the first measurement, and
+# the smallest launch worth dispatching.
+_WATCHDOG_SAFE_S = 40.0
+_INITIAL_IPS = 100_000.0
+_MIN_CHUNK = 8
 
-def _epoch_chunk() -> int:
+_warned_bad_chunk_env = False
+
+
+def _chunk_override() -> int | None:
+    """HPNN_EPOCH_CHUNK as a validated int, or None when unset (adaptive).
+
+    A malformed value warns ONCE and falls back to the ADAPTIVE sizing
+    (None) instead of raising a bare ValueError from deep inside a
+    training epoch -- adaptive is the watchdog-safe default, so a typo
+    must not silently re-enable a fixed-size hazard."""
     import os
 
-    return int(os.environ.get("HPNN_EPOCH_CHUNK", EPOCH_CHUNK))
+    raw = os.environ.get("HPNN_EPOCH_CHUNK")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        global _warned_bad_chunk_env
+        if not _warned_bad_chunk_env:
+            from ..utils.nn_log import nn_warn
+
+            nn_warn(f"HPNN_EPOCH_CHUNK={raw!r} is not an integer; "
+                    "using adaptive launch sizing\n")
+            _warned_bad_chunk_env = True
+        return None
+
+
+class AdaptiveChunker:
+    """WORST-CASE-SAFE launch sizing by iteration budget.
+
+    A fixed sample-count chunk conflates two regimes: 4096 converging
+    ANN-BP samples are ~12 s of device time, but 4096 MAX_ITER-saturated
+    SNN-BP samples are ~4e8 BP iterations -- minutes past the ~60 s
+    watchdog (round-4 advisor finding).  Sizing from the AVERAGE
+    iteration count is not enough either: a corpus whose hardness shifts
+    mid-epoch (converging stretch, then saturated samples) would ramp
+    the launch up and then blow the watchdog on the shift.  So every
+    launch is sized such that even if EVERY sample in it runs to the
+    kind's MAX_ITER, it stays under _WATCHDOG_SAFE_S at the measured
+    iteration rate:
+
+        size = rate * _WATCHDOG_SAFE_S / MAX_ITER
+
+    The rate estimate is conservative in the dangerous direction: it
+    starts pessimistic (first launch is tiny), slowdowns are believed
+    immediately, and speedups are capped at 2x per observation (the
+    measured rate itself is a LOWER bound on device throughput -- wall
+    dt includes dispatch and compile).  Sizes snap to a power-of-two
+    grid so the set of compiled program shapes stays bounded, capped at
+    EPOCH_CHUNK.  At the round-4 measured ~786k iters/s this settles at
+    256-sample launches; the launch loop (_adaptive_launches) queues
+    them asynchronously and syncs only every few launches, so the extra
+    dispatches pipeline instead of paying tunnel RTT each.
+
+    Residual limit (documented, not handled): a model so large that ONE
+    sample at MAX_ITER exceeds the watchdog needs a device-side
+    iteration budget, which no host-side sizing can provide.
+    """
+
+    def __init__(self, momentum: bool, cap: int = EPOCH_CHUNK):
+        self.worst = MAX_BPM_ITER if momentum else MAX_BP_ITER
+        self.cap = max(_MIN_CHUNK, cap)
+        self.rate = _INITIAL_IPS
+        self.size = self._resize()
+
+    def _resize(self) -> int:
+        n = int(min(max(self.rate * _WATCHDOG_SAFE_S / self.worst,
+                        _MIN_CHUNK), self.cap))
+        return 1 << (n.bit_length() - 1)  # power-of-two floor
+
+    def observe(self, iters: float, dt: float) -> None:
+        """Feed back a sync group: total BP iterations executed since the
+        last sync and the wall seconds they took."""
+        if dt <= 0 or iters <= 0:
+            return
+        measured = iters / dt
+        # believe slowdowns immediately; damp speedups to 2x per step
+        self.rate = measured if measured < self.rate else min(
+            measured, 2.0 * self.rate)
+        self.size = self._resize()
+
+
+# sync cadence for _adaptive_launches: host-read after each of the first
+# SYNC_WARMUP launches (rate ramp-up), then every SYNC_EVERY launches
+# (async queuing between syncs hides per-launch dispatch RTT)
+_SYNC_WARMUP = 3
+_SYNC_EVERY = 8
+
+# one chunker per compiled program identity, so the measured rate
+# survives across epochs of the SAME training run (no per-epoch warmup
+# ramp) but is NEVER shared across models -- a fast rate measured on a
+# small model would oversize launches on a big one and break the
+# worst-case invariant
+_CHUNKER_CACHE: dict = {}
+
+
+def _get_chunker(shapes, kind, momentum, route="ops") -> AdaptiveChunker:
+    # route distinguishes the single-device and TP epochs: same model,
+    # different measured rates
+    key = (tuple(map(tuple, shapes)), kind, bool(momentum), route)
+    ch = _CHUNKER_CACHE.get(key)
+    if ch is None:
+        ch = _CHUNKER_CACHE[key] = AdaptiveChunker(momentum)
+    return ch
+
+
+def _adaptive_launches(chunker, s: int, launch, read_iters, localize=None):
+    """Shared adaptive launch driver (ops and TP epochs).
+
+    ``launch(lo, hi)`` runs one chunk and returns its stats;
+    ``read_iters(parts)`` host-reads the total iteration count of a list
+    of stats (the sync point).  An optional ``localize`` converts a
+    stat to its host form at the sync point -- each stat passes through
+    exactly one sync group (the final launch always syncs), so the
+    returned list is fully localized with ONE transfer per stat."""
+    import time
+
+    parts, pending = [], []
+    lo = launches = 0
+    t_sync = time.perf_counter()
+    while lo < s:
+        st = launch(lo, lo + chunker.size)
+        parts.append(st)
+        pending.append(st)
+        lo += chunker.size
+        launches += 1
+        if (launches <= _SYNC_WARMUP or launches % _SYNC_EVERY == 0
+                or lo >= s):
+            if localize is not None:
+                pending = [localize(p) for p in pending]
+                parts[-len(pending):] = pending
+            iters = read_iters(pending)
+            now = time.perf_counter()
+            chunker.observe(iters, now - t_sync)
+            t_sync = now
+            pending = []
+    return parts
 
 
 def chunked_epoch(epoch_fn):
     """Wrap a train-epoch callable so no single device launch exceeds the
-    TPU runtime's ~60 s execution watchdog (see EPOCH_CHUNK).
+    TPU runtime's ~60 s execution watchdog.
+
+    On TPU with HPNN_EPOCH_CHUNK unset, launches are sized adaptively by
+    iteration budget (AdaptiveChunker); a set HPNN_EPOCH_CHUNK fixes the
+    sample count (<=0 disables chunking).  Off-TPU there is no watchdog,
+    so the fixed EPOCH_CHUNK behavior is kept (cheap, and it keeps the
+    ragged-tail code path exercised by the CPU suite).
 
     Exactness: each chunk resumes from the previous chunk's weights, so
     the sample-sequential trajectory is identical to one launch; stats
-    are concatenated along the leading S axis.  The tail chunk compiles
-    a second program shape (cached thereafter)."""
+    are concatenated along the leading S axis."""
 
     @functools.wraps(epoch_fn)
     def wrapped(weights, xs, ts, kind, momentum, **kw):
-        chunk = _epoch_chunk()
+        override = _chunk_override()
         s = xs.shape[0]
-        if chunk <= 0 or s <= chunk:
+        adaptive = override is None and jax.default_backend() == "tpu"
+        if s == 0:
+            # empty epoch: forward as-is (epoch_fn returns empty stats)
             return epoch_fn(weights, xs, ts, kind, momentum, **kw)
-        w, parts = weights, []
-        for lo in range(0, s, chunk):
-            w, st = epoch_fn(w, xs[lo:lo + chunk], ts[lo:lo + chunk],
-                             kind, momentum, **kw)
-            parts.append(st)
+        if not adaptive:
+            chunk = EPOCH_CHUNK if override is None else override
+            if chunk <= 0 or s <= chunk:
+                return epoch_fn(weights, xs, ts, kind, momentum, **kw)
+            w, parts = weights, []
+            for lo in range(0, s, chunk):
+                w, st = epoch_fn(w, xs[lo:lo + chunk], ts[lo:lo + chunk],
+                                 kind, momentum, **kw)
+                parts.append(st)
+        else:
+            w = weights
+
+            def launch(lo, hi):
+                nonlocal w
+                w, st = epoch_fn(w, xs[lo:hi], ts[lo:hi],
+                                 kind, momentum, **kw)
+                return st
+
+            def read_iters(pend):
+                # ONE host read syncs the whole pending queue
+                return float(sum(jnp.sum(p.n_iter) for p in pend))
+
+            chunker = _get_chunker([w.shape for w in weights],
+                                   kind, momentum)
+            parts = _adaptive_launches(chunker, s, launch, read_iters)
+        if len(parts) == 1:
+            return w, parts[0]
         stats = SampleStats(*(jnp.concatenate([getattr(p, f) for p in parts])
                               for f in SampleStats._fields))
         return w, stats

@@ -190,25 +190,54 @@ def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
     # and placed per chunk -- never eagerly concatenated or sliced as
     # multi-process global arrays, which eager mode rejects; each
     # chunk's stats are localized to host numpy immediately.
-    from ..ops.convergence import SampleStats, _epoch_chunk
+    from ..ops.convergence import (SampleStats, _adaptive_launches,
+                                   _chunk_override, _get_chunker)
 
     import numpy as np
 
-    chunk = _epoch_chunk() if jax.default_backend() == "tpu" else 0
+    override = _chunk_override()
+    on_tpu = jax.default_backend() == "tpu"
     s = xs.shape[0]
-    if chunk <= 0 or s <= chunk:
+    if not on_tpu or s == 0 or (override is not None
+                                and (override <= 0 or s <= override)):
         sharded, stats = fn(sharded, _place(jnp.asarray(xs), rep, mesh),
                             _place(jnp.asarray(ts), rep, mesh))
         stats = _localize(stats)
-    else:
+    elif override is not None:
         parts = []
-        for lo in range(0, s, chunk):
+        for lo in range(0, s, override):
             sharded, st = fn(
-                sharded, _place(jnp.asarray(xs[lo:lo + chunk]), rep, mesh),
-                _place(jnp.asarray(ts[lo:lo + chunk]), rep, mesh))
+                sharded,
+                _place(jnp.asarray(xs[lo:lo + override]), rep, mesh),
+                _place(jnp.asarray(ts[lo:lo + override]), rep, mesh))
             parts.append(_localize(st))
         stats = SampleStats(*(np.concatenate([getattr(p, f) for p in parts])
                               for f in SampleStats._fields))
+    else:
+        # adaptive worst-case-safe launches, shared driver with the
+        # single-device epoch (ops.convergence._adaptive_launches); the
+        # sync-point localization is the only host read per group
+        def launch(lo, hi):
+            nonlocal sharded
+            sharded, st = fn(
+                sharded, _place(jnp.asarray(xs[lo:hi]), rep, mesh),
+                _place(jnp.asarray(ts[lo:hi]), rep, mesh))
+            return st
+
+        def read_iters(pend):
+            # pend is already localized by the driver's localize hook
+            return float(sum(np.sum(p.n_iter) for p in pend))
+
+        parts = _adaptive_launches(
+            _get_chunker([w.shape for w in weights], kind, momentum,
+                         route="tp"),
+            s, launch, read_iters, localize=_localize)
+        if len(parts) == 1:
+            stats = parts[0]
+        else:
+            stats = SampleStats(
+                *(np.concatenate([getattr(p, f) for p in parts])
+                  for f in SampleStats._fields))
     # multi-process: the row shards live on other hosts; replicate through
     # the cached identity (an all-gather over the model axis -- the
     # reference's post-update weight Allgather, ann.c:1636-1642) and read

@@ -83,7 +83,7 @@ PROFILES = {
 }
 
 
-def make_corpus(root: str, n_train: int, n_test: int, seed: int = 1234,
+def make_corpus(root: str, n_train: int, n_test: int, seed: int | None = None,
                 profile: str = "hard", classes: int = 10):
     """`classes`-class corpus with heavy intra-class style variation.
 
@@ -97,8 +97,12 @@ def make_corpus(root: str, n_train: int, n_test: int, seed: int = 1234,
 
         # ONE generator call, split: the prototypes derive from the seed,
         # so separate seeds would make the test set a DIFFERENT 2-class
-        # problem, not held-out samples of this one (round-4 review)
-        xs, ts = _mnist_corpus_2class(n_train + n_test, rng_seed=11)
+        # problem, not held-out samples of this one (round-4 review).
+        # The caller's seed threads through (round-4 advisor: it used to
+        # be hardcoded, silently ignoring the parameter); seed=None picks
+        # each profile's historical default so cached artifacts reproduce.
+        xs, ts = _mnist_corpus_2class(
+            n_train + n_test, rng_seed=11 if seed is None else seed)
         split = {"samples": (xs[:n_train], ts[:n_train]),
                  "tests": (xs[n_train:], ts[n_train:])}
         for d, (dx, dt) in split.items():
@@ -108,7 +112,7 @@ def make_corpus(root: str, n_train: int, n_test: int, seed: int = 1234,
                               dx[k], dt[k])
         return
     p = PROFILES[profile]
-    rng = np.random.default_rng(seed)
+    rng = np.random.default_rng(1234 if seed is None else seed)
     n_styles, train_styles = p["n_styles"], p["train_styles"]
     base = rng.uniform(0, 140, 784) * (rng.uniform(0, 1, 784) > 0.55)
     cls = rng.uniform(-p["cls_amp"], p["cls_amp"], (classes, 784)) * (

@@ -246,3 +246,111 @@ def test_chunked_epoch_matches_single_launch(monkeypatch):
     assert np.array_equal(np.asarray(st_ref.init_err),
                           np.asarray(st_c.init_err))
     assert st_c.n_iter.shape == (n,)
+
+
+def test_chunked_epoch_adaptive_matches_single_launch(monkeypatch):
+    """The ADAPTIVE launch-sizing path (HPNN_EPOCH_CHUNK unset on TPU)
+    must be trajectory-exact too.  Forced on CPU by faking the backend
+    probe -- the sizing feedback runs for real, only the watchdog it
+    protects against is absent."""
+    from hpnn_tpu.ops import convergence
+
+    kern, _ = generate_kernel(46, 6, [5], 3)
+    ws = tuple(jnp.asarray(w) for w in kern.weights)
+    n = 100  # > the worst-case initial launch size => several launches
+    xs = jnp.asarray(RNG.uniform(-1, 1, (n, 6)))
+    ts_np = -np.ones((n, 3))
+    ts_np[np.arange(n), np.arange(n) % 3] = 1.0
+    ts = jnp.asarray(ts_np)
+    w_ref, st_ref = ops.train_epoch(ws, xs, ts, "ANN", False)
+    monkeypatch.delenv("HPNN_EPOCH_CHUNK", raising=False)
+    monkeypatch.setattr(convergence.jax, "default_backend", lambda: "tpu")
+    w_c, st_c = convergence.chunked_epoch(ops.train_epoch)(
+        ws, xs, ts, "ANN", False)
+    for a, b in zip(w_ref, w_c):
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+    assert np.array_equal(np.asarray(st_ref.n_iter), np.asarray(st_c.n_iter))
+    assert st_c.n_iter.shape == (n,)
+
+
+def test_adaptive_chunker_sizing():
+    """Worst-case-safe sizing (round-4 advisor + round-5 review): EVERY
+    launch must fit the watchdog budget even if all its samples run to
+    MAX_ITER at the believed rate; speedups are damped, slowdowns are
+    believed immediately; sizes stay on the power-of-two grid."""
+    from hpnn_tpu.ops.convergence import (_WATCHDOG_SAFE_S, EPOCH_CHUNK,
+                                          AdaptiveChunker)
+
+    def worst_case_safe(c):
+        return c.size * c.worst / c.rate <= _WATCHDOG_SAFE_S
+
+    c = AdaptiveChunker(momentum=False)
+    assert c.worst == 102399
+    assert worst_case_safe(c)            # pessimistic opening launch
+    assert c.size & (c.size - 1) == 0
+    # measured fast (786k iters/s): rate ramps at most 2x per observation,
+    # and the invariant holds at every step
+    for _ in range(8):
+        c.observe(c.size * 2000.0, c.size * 2000.0 / 786_000.0)
+        assert worst_case_safe(c)
+        assert c.size & (c.size - 1) == 0
+        assert c.size <= EPOCH_CHUNK
+    # at the measured round-4 rate the steady size is 256: big enough to
+    # amortize dispatch, small enough that full saturation stays ~33 s
+    assert c.size == 256
+    # a sudden slowdown is believed immediately
+    c.observe(c.size * 102399.0, c.size * 102399.0 / 50_000.0)
+    assert abs(c.rate - 50_000.0) < 1.0
+    assert worst_case_safe(c)
+    # garbage observations are ignored
+    sz = c.size
+    c.observe(0.0, 0.0)
+    assert c.size == sz
+    # a malformed HPNN_EPOCH_CHUNK falls back to ADAPTIVE (None), warning
+    # instead of raising -- and instead of a fixed-size hazard
+    import os
+    from hpnn_tpu.ops.convergence import _chunk_override
+    old = os.environ.get("HPNN_EPOCH_CHUNK")
+    try:
+        os.environ["HPNN_EPOCH_CHUNK"] = "banana"
+        assert _chunk_override() is None
+        os.environ["HPNN_EPOCH_CHUNK"] = "512"
+        assert _chunk_override() == 512
+    finally:
+        if old is None:
+            os.environ.pop("HPNN_EPOCH_CHUNK", None)
+        else:
+            os.environ["HPNN_EPOCH_CHUNK"] = old
+
+
+def test_adaptive_launches_sync_cadence():
+    """The launch driver syncs on each warmup launch, then only every
+    _SYNC_EVERY launches (async queuing between syncs), and always covers
+    every sample exactly once."""
+    from hpnn_tpu.ops import convergence as cv
+
+    class FakeChunker:
+        size = 10
+        observed = []
+
+        def observe(self, iters, dt):
+            self.observed.append(iters)
+
+    calls, reads = [], []
+
+    def launch(lo, hi):
+        calls.append((lo, hi))
+        return hi - lo  # "stats" = sample count
+
+    def read_iters(pend):
+        reads.append(list(pend))
+        return float(sum(pend))
+
+    fc = FakeChunker()
+    parts = cv._adaptive_launches(fc, 205, launch, read_iters)
+    # coverage: 21 launches of 10, the last ragged
+    assert calls == [(i * 10, i * 10 + 10) for i in range(21)]
+    assert sum(parts) == 21 * 10  # slices clamp at the array edge IRL
+    # sync points: warmup 1,2,3 then 8,16, and the final launch
+    assert [len(r) for r in reads] == [1, 1, 1, 5, 8, 5]
+    assert sum(fc.observed) == float(21 * 10)

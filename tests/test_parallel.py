@@ -595,3 +595,40 @@ def test_bf16_composes_with_parallel_knobs(tmp_path, capsys, extra,
     out = capsys.readouterr().out
     assert marker in out
     assert all(np.isfinite(w).all() for w in nn.kernel.weights)
+
+
+def test_tp_train_epoch_adaptive_chunks_parity(monkeypatch):
+    """The TP epoch's ADAPTIVE launch sizing (HPNN_EPOCH_CHUNK unset on
+    TPU) must be trajectory-exact vs the single-device epoch.  Forced on
+    CPU by patching only tp's view of the backend probe -- ops dispatch
+    (which also keys on the backend) stays untouched."""
+    import jax as real_jax
+
+    from hpnn_tpu.parallel import tp as tp_mod
+
+    class _FakeJax:
+        def __getattr__(self, name):
+            return getattr(real_jax, name)
+
+        @staticmethod
+        def default_backend():
+            return "tpu"
+
+    ws = _net([10, 8, 4], seed=13)
+    # just past the worst-case opening launch (32): two launches (the
+    # ramp-up observe() runs, the tail slices ragged) while keeping the
+    # CPU compile cost to two program shapes
+    n = 40
+    xs_np = RNG.uniform(-1, 1, (n, 10))
+    ts_np = -np.ones((n, 4))
+    ts_np[np.arange(n), np.arange(n) % 4] = 1.0
+    xs, ts = jnp.asarray(xs_np), jnp.asarray(ts_np)
+    w_ref, st_ref = ops.train_epoch(ws, xs, ts, "ANN", False)
+    monkeypatch.delenv("HPNN_EPOCH_CHUNK", raising=False)
+    monkeypatch.setattr(tp_mod, "jax", _FakeJax())
+    mesh = make_mesh(n_data=1, n_model=4)
+    w_tp, st_tp = tp_mod.tp_train_epoch(ws, xs, ts, "ANN", False, mesh)
+    assert np.array_equal(np.asarray(st_ref.n_iter), np.asarray(st_tp.n_iter))
+    for a, b in zip(w_ref, w_tp):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=0, atol=1e-12)
