@@ -48,12 +48,19 @@ def select_train_epoch(dtype=None):
     throughput path on TPU -- the production analog of the reference's
     fused CUDA hot loop (``/root/reference/src/cuda_ann.cu:77-148``).
     """
-    from .convergence import chunked_epoch
+    from .convergence import _chunk_override, chunked_epoch
 
     if _use_pallas(dtype):
-        from .convergence_pallas import train_epoch_pallas
+        from .convergence_pallas import (train_epoch_pallas,
+                                         train_epoch_pallas_watchdog)
 
-        return chunked_epoch(train_epoch_pallas), "pallas"
+        if _chunk_override() is not None:
+            # expert fixed-size chunking (HPNN_EPOCH_CHUNK)
+            return chunked_epoch(train_epoch_pallas), "pallas"
+        # the default: iteration-budgeted launches resumed in ONE
+        # compiled program per epoch shape -- device time per launch is
+        # bounded by construction, not by host-side sizing
+        return train_epoch_pallas_watchdog, "pallas"
     import jax
 
     if jax.default_backend() == "tpu":

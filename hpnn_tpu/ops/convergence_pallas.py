@@ -117,12 +117,14 @@ def _matvec_t(d, w_ref, precision):
         precision=precision).astype(d.dtype)
 
 
-def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
-            min_iter, max_iter, delta, precision):
+def _kernel(ctrl_ref, x_ref, t_ref, *refs, n_layers, n_out, kind, momentum,
+            lr, alpha, min_iter, max_iter, delta, precision):
     w_in = refs[:n_layers]
     w_out = refs[n_layers:2 * n_layers]
     stats_ref = refs[2 * n_layers]
-    dw = refs[2 * n_layers + 1:] if momentum else ()
+    rest = refs[2 * n_layers + 1:]
+    dw = rest[:n_layers] if momentum else ()
+    iters_used = rest[-1]   # SMEM (1,) i32, persists across grid steps
 
     s = pl.program_id(0)
 
@@ -130,6 +132,17 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
     def _():
         for wi, wo in zip(w_in, w_out):
             wo[:] = wi[:]
+        iters_used[0] = jnp.int32(0)
+
+    # iteration-budgeted launch with host resume (the device-side
+    # watchdog guard): ctrl = (start_idx, iter_budget).  Samples before
+    # start_idx were trained by earlier launches; once the counter
+    # crosses the budget the remaining grid steps write a sentinel stats
+    # row and do no math, so one launch executes AT MOST budget + one
+    # sample's MAX_ITER iterations -- an exact bound no host-side sizing
+    # can give.  The first eligible sample always runs (counter starts at
+    # 0 < budget), so every launch makes progress.
+    active = (s >= ctrl_ref[0]) & (iters_used[0] < ctrl_ref[1])
 
     x = x_ref[0]            # (1, Mp0) -- blocks are (1, 1, width)
     t = t_ref[0]            # (1, NpL)
@@ -138,6 +151,25 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
     col = lax.broadcasted_iota(jnp.int32, (1, npl), 1)
     out_mask = col < n_out
 
+    @pl.when(jnp.logical_not(active))
+    def _():
+        # sentinel: n_iter slot (index 2) = -1 -> "not trained here"
+        srow = jnp.zeros((1, stats_ref.shape[2]), jnp.float32)
+        scol = lax.broadcasted_iota(jnp.int32, srow.shape, 1)
+        stats_ref[0] = jnp.where(scol == 2, jnp.float32(-1.0), srow)
+
+    @pl.when(active)
+    def _():
+        _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
+                   iters_used, n_layers=n_layers, n_out=n_out, kind=kind,
+                   momentum=momentum, lr=lr, alpha=alpha,
+                   min_iter=min_iter, max_iter=max_iter, delta=delta,
+                   precision=precision)
+
+
+def _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
+               iters_used, *, n_layers, n_out, kind, momentum, lr, alpha,
+               min_iter, max_iter, delta, precision):
     if momentum:
         for b in dw:
             b[:] = jnp.zeros_like(b)
@@ -238,6 +270,7 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
               jnp.asarray(False), acts0, init_err)
     it, dep, is_ok_raw, first_ok, _, _ = lax.while_loop(cond, body, state0)
     success = is_ok_raw & (it > min_iter)
+    iters_used[0] = iters_used[0] + it
 
     # scatter the 5 scalars into the (1, LANE) stats row with vector selects
     # (elementwise VMEM stores of scalars don't lower on all Mosaic
@@ -259,12 +292,17 @@ def _kernel(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum, lr, alpha,
     static_argnames=("kind", "momentum", "alpha", "delta", "lr", "interpret",
                      "precision"))
 def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
-                      alpha, delta, lr, interpret, precision):
+                      alpha, delta, lr, interpret, precision,
+                      ctrl=None):
     """Jitted core: returns the final weight arrays + raw stats rows.
 
     ``precision`` is a required static argument here -- the env-var
     default is resolved by the public wrapper BEFORE the jit boundary, so
     the cache is keyed on the actual precision, not on ``None``.
+
+    ``ctrl`` is the (start_idx, iter_budget) int32 pair for budgeted
+    launches (a DYNAMIC operand: changing it never recompiles); None
+    means "whole epoch, unbounded" (start 0, budget INT32_MAX).
     """
     if lr is None:
         lr = bpm_learn_rate(kind) if momentum else bp_learn_rate(kind)
@@ -302,25 +340,36 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
     # index maps must return i32: a python literal 0 traces as i64 under
     # x64 (Mosaic cannot legalize the index-map func.return), and a traced
     # jnp.int32 would be an illegal captured constant -- a numpy scalar is
-    # both typed and capture-safe
+    # both typed and capture-safe.  With scalar prefetch the index maps
+    # take (i, ctrl_ref) -- the control scalars are unused for indexing.
     z = np.int32(0)
-    const = lambda shape: pl.BlockSpec(shape, lambda i: (z, z))
-    per_s = lambda width: pl.BlockSpec((1, 1, width), lambda i: (i, z, z))
+    const = lambda shape: pl.BlockSpec(shape, lambda i, c: (z, z))
+    per_s = lambda width: pl.BlockSpec((1, 1, width), lambda i, c: (i, z, z))
 
-    out = pl.pallas_call(
-        kernel,
+    if ctrl is None:
+        ctrl = jnp.asarray([0, np.iinfo(np.int32).max], jnp.int32)
+    else:
+        ctrl = jnp.asarray(ctrl, jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(s,),
         in_specs=[per_s(xs.shape[1]), per_s(ts.shape[1])]
         + [const(w.shape) for w in wp],
         out_specs=[const(w.shape) for w in wp] + [per_s(LANE)],
+        scratch_shapes=([pltpu.VMEM(w.shape, wdtype) for w in wp]
+                        if momentum else [])
+        + [pltpu.SMEM((1,), jnp.int32)],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct(w.shape, wdtype) for w in wp]
         + [jax.ShapeDtypeStruct((s, 1, LANE), jnp.float32)],
-        scratch_shapes=[pltpu.VMEM(w.shape, wdtype) for w in wp]
-        if momentum else [],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(xp, tp, *wp)
+    )(ctrl, xp, tp, *wp)
 
     return tuple(out[:n_layers]), out[n_layers][:, 0, :]
 
@@ -349,3 +398,77 @@ def train_epoch_pallas(weights, xs, ts, kind: str, momentum: bool,
         success=st[:, 4] > 0.5,
     )
     return new_w, stats
+
+
+def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
+                                alpha=0.2, delta=-1.0, lr=None,
+                                interpret=False, precision=None):
+    """The production TPU epoch: iteration-budgeted launches with host
+    resume, exact under the runtime's ~60 s single-program watchdog.
+
+    Each launch carries (start_idx, iter_budget) as scalar-prefetch
+    operands into ONE compiled program per epoch shape; the kernel stops
+    starting new samples once the in-launch iteration counter crosses the
+    budget, so device time per launch is bounded by
+    budget/rate + one sample's MAX_ITER -- regardless of how the corpus's
+    per-sample iteration counts are distributed (the failure mode
+    host-side sample-count sizing cannot bound).  The budget is set from
+    a conservatively tracked iteration rate (pessimistic start, slowdowns
+    believed immediately, speedups damped 2x per launch), reusing
+    convergence._WATCHDOG_SAFE_S.  Trajectory-exact: weights resume
+    launch to launch; stats rows merge by position.
+    """
+    import time
+
+    import numpy as np_
+
+    from .convergence import _WATCHDOG_SAFE_S, _get_chunker
+
+    if precision is None:
+        precision = _precision()
+    s = xs.shape[0]
+    if s == 0:
+        return train_epoch_pallas(weights, xs, ts, kind, momentum,
+                                  alpha=alpha, delta=delta, lr=lr,
+                                  interpret=interpret, precision=precision)
+    # the chunker serves as the persistent conservative RATE tracker
+    # (pessimistic start, slowdowns believed, speedups damped 2x); its
+    # sample-count sizing is unused here -- the budget is in iterations
+    tracker = _get_chunker([w.shape for w in weights], kind, momentum,
+                           route="pallas_budget")
+    start = 0
+    w = weights
+    rows = np_.empty((s, 5), np_.float32)
+    while start < s:
+        # reserve the last-started sample's worst-case tail (MAX_ITER)
+        # inside the safe window: worst launch = budget + MAX_ITER
+        # iterations.  Floor of 1 keeps progress guaranteed even after a
+        # pathological rate reading (one sample per launch -- the
+        # documented residual limit where a SINGLE sample at MAX_ITER
+        # exceeds the watchdog is the only case left unbounded).
+        budget = max(1, int(min(tracker.rate * _WATCHDOG_SAFE_S,
+                                2**31 - 1)) - tracker.worst)
+        t0 = time.perf_counter()
+        w, st = _train_epoch_core(
+            w, xs, ts, kind, momentum, alpha=alpha, delta=delta, lr=lr,
+            interpret=interpret, precision=precision,
+            ctrl=jnp.asarray([start, budget], jnp.int32))
+        # ONE host read syncs the launch: how many samples it finished
+        # and how many iterations they took (sentinel rows carry -1)
+        n_col = st[:, 2]
+        done = int(jnp.sum((n_col >= 0.0).astype(jnp.int32)))
+        iters = float(jnp.sum(jnp.where(n_col > 0.0, n_col, 0.0)))
+        dt = time.perf_counter() - t0
+        assert done > 0, "budgeted launch made no progress"
+        # device slice first: only the finished rows cross the tunnel
+        rows[start:start + done] = np_.asarray(st[start:start + done, :5])
+        tracker.observe(iters, dt)
+        start += done
+    stats = SampleStats(
+        init_err=jnp.asarray(rows[:, 0]),
+        first_ok=jnp.asarray(rows[:, 1] > 0.5),
+        n_iter=jnp.asarray(rows[:, 2].astype(np_.int32)),
+        final_dep=jnp.asarray(rows[:, 3]),
+        success=jnp.asarray(rows[:, 4] > 0.5),
+    )
+    return w, stats

@@ -150,16 +150,24 @@ def run_tpu_cycle(workdir, rounds):
     return records
 
 
-def run_ref_budget(workdir, budget_s):
+def run_ref_budget(workdir, budget_s, conf_writer=None):
     """Run ref-C round 0 on the same corpus under a wall budget; measure
-    its steady-state rate and first-try-OK rate from the partial log."""
-    write_conf(workdir, first=True)
+    its steady-state rate and first-try-OK rate from the partial log.
+    ``conf_writer(workdir, first)`` defaults to this workload's conf
+    (scale_xrd reuses the machinery with its own)."""
+    (conf_writer or write_conf)(workdir, first=True)
     bin_ = build_oracle("train_nn")
     log = os.path.join(workdir, "ref_round0.log")
     t0 = time.time()
     t_first = None  # when the first training line lands in the log
     with open(log, "w") as f:
-        p = subprocess.Popen([bin_, "-v", "-v", "nn.conf"], cwd=workdir,
+        # stdbuf -oL: ref-C's stdout into a file is BLOCK-buffered, so
+        # without it the first TRAINING line surfaces only on a 4 KiB
+        # flush (biasing the load clock) and the kill at budget loses the
+        # buffered tail (undercounting samples_done on slow workloads --
+        # an XRD BPM sample is ~19 s of serial C, ~50 lines per flush)
+        p = subprocess.Popen(["stdbuf", "-oL", bin_, "-v", "-v", "nn.conf"],
+                             cwd=workdir,
                              stdout=f, stderr=subprocess.STDOUT)
         deadline = t0 + budget_s
         while True:
@@ -202,16 +210,17 @@ def run_ref_budget(workdir, budget_s):
             "ok_bits": ok_bits(txt)}
 
 
-def run_ref_cross_eval(workdir, ref_workdir):
+def run_ref_cross_eval(workdir, ref_workdir, conf_writer=None,
+                       dirs=("samples", "tests")):
     """The compiled reference's run_nn evaluating OUR kernel.opt."""
     os.makedirs(ref_workdir, exist_ok=True)
-    for d in ("samples", "tests"):
+    for d in dirs:
         dst = os.path.join(ref_workdir, d)
         if not os.path.exists(dst):
             os.symlink(os.path.join(os.path.abspath(workdir), d), dst)
     shutil.copy(os.path.join(workdir, "kernel.opt"),
                 os.path.join(ref_workdir, "kernel.opt"))
-    write_conf(ref_workdir, first=False)
+    (conf_writer or write_conf)(ref_workdir, first=False)
     bin_ = build_oracle("run_nn")
     t0 = time.time()
     rn = subprocess.run([bin_, "-v", "-v", "nn.conf"], cwd=ref_workdir,

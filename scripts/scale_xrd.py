@@ -1,0 +1,342 @@
+"""Generate SCALE_XRD5K.md: the RRUFF-XRD workload at reference scale.
+
+PARITY_XRD.md answers the XRD ACCURACY question on a 60-sample mini
+corpus; this artifact answers the SCALE question (VERDICT r4 missing 2):
+the reference's ann tutorial trains ~5k RRUFF powder-XRD samples through
+an 851-230-230 BPM network
+(``/root/reference/tutorials/ann/tutorial.bash:129-157``), and that is
+the shape where W0 (851 wide, ~80% of the parameters) stresses VMEM
+layout -- the MNIST 60k artifact does not subsume it.
+
+Corpus: 230 space groups x M minerals (~5k files, ALL 230 output classes
+populated -- the reference corpus's full class range), same synthetic
+RRUFF statistics as PARITY_XRD (shared signature peaks per group, private
+peaks + noise per mineral), vectorized generation.  Converted ONCE by
+``hpnn_tpu.tools.pdif`` (-i 850 -o 230) into reference-format samples.
+
+Protocol mirrors scale_mnist.py: 1+R rounds of the production CLI
+([dtype] f32 on the ambient TPU backend), self-test eval against the
+training set (the tutorial's own metric), a ref-C wall-budget cell
+measured at steady state, and the compiled reference's run_nn
+cross-evaluating the TPU-trained kernel.opt.
+
+Usage: python scripts/scale_xrd.py [--rounds 10] [--groups 230]
+       [--per-group 22] [--ref-budget 900] [--out SCALE_XRD5K.md]
+       [--results cache.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scale_mnist import (  # noqa: E402
+    ok_bits, parse_prof, run_ref_budget, run_ref_cross_eval)
+from parity_artifact import scrape  # noqa: E402
+
+CONF = """[name] XRD5K
+[type] ANN
+[init] {init}
+[seed] 10958
+[input] 851
+[hidden] 230
+[output] 230
+[train] BPM
+{extra}[sample_dir] ./samples
+[test_dir] ./samples
+"""
+
+
+def write_conf(workdir, first, dtype=None):
+    extra = f"[dtype] {dtype}\n" if dtype else ""
+    with open(os.path.join(workdir, "nn.conf"), "w") as f:
+        f.write(CONF.format(init="generate" if first else "kernel.opt",
+                            extra=extra))
+
+
+def _sym_per_number():
+    """One Hermann-Mauguin symbol per IUCr number 1..230 (pdif's own
+    table, so every number round-trips through the converter)."""
+    from hpnn_tpu.tools.pdif import SPACE_GROUPS
+
+    out = {}
+    for sym, num in SPACE_GROUPS.items():
+        out.setdefault(num, sym)
+    assert len(out) == 230
+    return [out[n] for n in range(1, 231)]
+
+
+_TGRID = np.arange(5.0, 90.0, 0.1)
+
+
+def _write_mineral(root, name, sym, class_peaks, rng):
+    """One DIF + raw pair (formats per file_dif.c:37-379), vectorized
+    spectrum synthesis (parity_xrd's per-point loop would take ~1 h at
+    5k files)."""
+    own = rng.uniform(8, 85, 3), rng.uniform(80, 400, 3)
+    pk_t = np.concatenate([class_peaks[0], own[0]])
+    pk_i = np.concatenate([class_peaks[1], own[1]])
+    with open(os.path.join(root, "dif", name), "w") as fp:
+        fp.write(f"{name} synthetic scale mineral\n"
+                 "Sample at T = 25 C\n"
+                 "CELL PARAMETERS: 5.4 5.4 5.4 90.0 90.0 90.0\n"
+                 f"SPACE GROUP: {sym}\n"
+                 "WAVELENGTH: 1.541838\n"
+                 "2-THETA INTENSITY\n")
+        for t, inten in zip(pk_t, pk_i):
+            fp.write(f"{t:.2f} {inten:.2f}\n")
+        fp.write("END\n")
+    spec = (pk_i[:, None]
+            * np.exp(-((_TGRID[None, :] - pk_t[:, None]) ** 2) / 0.05)
+            ).sum(0) + rng.uniform(0, 3, _TGRID.size)
+    with open(os.path.join(root, "raw", name), "w") as fp:
+        fp.write("### synthetic XY spectrum\n")
+        fp.write("".join(f"{t:.3f} {v:.4f}\n"
+                         for t, v in zip(_TGRID, spec)))
+        fp.write("# end\n")
+
+
+def make_rruff(root, groups, per_group, seed=77):
+    rng = np.random.default_rng(seed)
+    os.makedirs(os.path.join(root, "dif"), exist_ok=True)
+    os.makedirs(os.path.join(root, "raw"), exist_ok=True)
+    syms = _sym_per_number()
+    k = 0
+    for g in range(groups):
+        sym = syms[g % 230]
+        class_peaks = (rng.uniform(8, 85, 5), rng.uniform(300, 900, 5))
+        for _ in range(per_group):
+            _write_mineral(root, f"R{k:06d}", sym, class_peaks, rng)
+            k += 1
+    return k
+
+
+def ensure_corpus(base, groups, per_group):
+    """Generate + pdif-convert once; idempotent across reruns."""
+    src = os.path.join(base, "src")
+    n = groups * per_group
+    sampledir = os.path.join(src, "samples")
+    try:
+        if len(os.listdir(sampledir)) == n:
+            return src
+    except FileNotFoundError:
+        pass
+    shutil.rmtree(src, ignore_errors=True)
+    os.makedirs(sampledir)
+    t0 = time.time()
+    make_rruff(src, groups, per_group)
+    print(f"  RRUFF tree ({n} minerals) in {time.time() - t0:.0f}s",
+          flush=True)
+    t0 = time.time()
+    r = subprocess.run(
+        [sys.executable, "-m", "hpnn_tpu.tools.pdif", src, "-i", "850",
+         "-o", "230", "-s", sampledir],
+        capture_output=True, text=True, cwd=REPO,
+        env=dict(os.environ, PYTHONPATH=REPO))
+    assert r.returncode == 0, r.stderr[-2000:]
+    made = len(os.listdir(sampledir))
+    assert made == n, f"pdif made {made}/{n} samples"
+    print(f"  pdif converted {made} samples in {time.time() - t0:.0f}s",
+          flush=True)
+    return src
+
+
+def run_tpu_cycle(workdir, rounds):
+    """1+rounds rounds of the production CLI on the ambient backend."""
+    env = dict(os.environ, HPNN_PROFILE="1")
+    train_cmd = [sys.executable, os.path.join(REPO, "apps/train_nn.py"),
+                 "-v", "-v", "nn.conf"]
+    run_cmd = [sys.executable, os.path.join(REPO, "apps/run_nn.py"),
+               "-v", "-v", "nn.conf"]
+    records = []
+    for rnd in range(rounds + 1):
+        write_conf(workdir, first=(rnd == 0), dtype="f32")
+        t0 = time.time()
+        tr = subprocess.run(train_cmd, cwd=workdir, env=env,
+                            capture_output=True, text=True, timeout=14400)
+        t_train = time.time() - t0
+        assert tr.returncode == 0, (rnd, tr.stderr[-2000:])
+        # eval always loads the just-trained kernel.opt
+        # (tutorial.bash:102-104 semantics; scale_mnist.py EVAL_SEMANTICS=2)
+        write_conf(workdir, first=False, dtype="f32")
+        t0 = time.time()
+        rn = subprocess.run(run_cmd, cwd=workdir, env=env,
+                            capture_output=True, text=True, timeout=7200)
+        t_eval = time.time() - t0
+        assert rn.returncode == 0, (rnd, rn.stderr[-2000:])
+        opt, acc = scrape(tr.stdout, rn.stdout)
+        import re
+
+        iters = sum(int(m) for m in
+                    re.findall(r"N_ITER=\s*(\d+)", tr.stdout))
+        rec = {"round": rnd, "opt": opt, "pass": acc,
+               "t_train": round(t_train, 1), "t_eval": round(t_eval, 1),
+               "bp_iters": iters, "ok_bits": ok_bits(tr.stdout),
+               "prof": parse_prof(tr.stdout + tr.stderr)}
+        records.append(rec)
+        print(f"  tpu-f32 round {rnd}: OPT={opt:.1f}% PASS={acc:.1f}% "
+              f"train={t_train:.0f}s (epoch "
+              f"{rec['prof'].get('train_epoch_tp', rec['prof'].get('train_epoch', -1)):.0f}s, "
+              f"{iters} iters) eval={t_eval:.0f}s", flush=True)
+    return records
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--groups", type=int, default=230)
+    ap.add_argument("--per-group", type=int, default=22)
+    ap.add_argument("--ref-budget", type=int, default=900)
+    ap.add_argument("--out", default=os.path.join(REPO, "SCALE_XRD5K.md"))
+    ap.add_argument("--results",
+                    default=os.path.join(REPO, ".scratch", "scale_xrd",
+                                         "results.json"))
+    args = ap.parse_args()
+
+    base = os.path.join(REPO, ".scratch", "scale_xrd")
+    os.makedirs(base, exist_ok=True)
+    res = {}
+    if args.results and os.path.exists(args.results):
+        res = json.load(open(args.results))
+    meta = {"groups": args.groups, "per_group": args.per_group,
+            "rounds": args.rounds}
+    if res.get("_meta") not in (None, meta):
+        print(f"cache scale changed ({res.get('_meta')} -> {meta}); "
+              "re-running", flush=True)
+        res = {}
+    res["_meta"] = meta
+
+    def save():
+        if args.results:
+            tmp = args.results + ".tmp"
+            json.dump(res, open(tmp, "w"))
+            os.replace(tmp, args.results)
+
+    src = ensure_corpus(base, args.groups, args.per_group)
+    workdir = os.path.join(base, "work")
+    if not os.path.exists(os.path.join(workdir, "samples")):
+        os.makedirs(workdir, exist_ok=True)
+        os.symlink(os.path.join(os.path.abspath(src), "samples"),
+                   os.path.join(workdir, "samples"))
+    save()
+
+    if "tpu" not in res:
+        print("tpu-f32 cycle ...", flush=True)
+        res["tpu"] = run_tpu_cycle(workdir, args.rounds)
+        save()
+    if "ref" not in res:
+        print(f"ref-C budget run ({args.ref_budget}s) ...", flush=True)
+        ref_wd = os.path.join(base, "ref_round0")
+        shutil.rmtree(ref_wd, ignore_errors=True)
+        os.makedirs(ref_wd)
+        os.symlink(os.path.join(os.path.abspath(src), "samples"),
+                   os.path.join(ref_wd, "samples"))
+        res["ref"] = run_ref_budget(ref_wd, args.ref_budget,
+                                    conf_writer=write_conf)
+        save()
+        print(f"  ref-C: {res['ref']}", flush=True)
+    if "ref_eval" not in res:
+        print("ref-C cross-eval of the TPU kernel.opt ...", flush=True)
+        res["ref_eval"] = run_ref_cross_eval(
+            workdir, os.path.join(base, "ref_eval"),
+            conf_writer=write_conf, dirs=("samples",))
+        save()
+        print(f"  ref-C eval: {res['ref_eval']}", flush=True)
+    render(args, res)
+
+
+def render(args, res):
+    n = args.groups * args.per_group
+    tpu, ref, rev = res["tpu"], res["ref"], res["ref_eval"]
+    r0 = tpu[0]
+    warm = tpu[1:] or [r0]
+    mean_train = np.mean([x["t_train"] for x in warm])
+    mean_eval = np.mean([x["t_eval"] for x in warm])
+    ref_round0_est = n / max(ref["samples_per_sec"], 1e-9)
+    lines = [
+        "# SCALE_XRD5K -- the RRUFF-XRD workload at reference scale",
+        "",
+        "Generated by `scripts/scale_xrd.py` (re-runnable).  Corpus:",
+        f"{args.groups} space groups x {args.per_group} minerals = {n}",
+        "synthetic RRUFF DIF+raw pairs (PARITY_XRD's statistics, all 230",
+        "output classes populated), converted once by",
+        "`hpnn_tpu.tools.pdif` (-i 850 -o 230).  The reference's ann",
+        "tutorial trains ~5k RRUFF samples through this exact 851-230-230",
+        "BPM shape (`/root/reference/tutorials/ann/tutorial.bash:129-157`);",
+        f"metric = self-test PASS% against the training set, 1+{args.rounds}",
+        "rounds with kernel.opt resume, seed 10958 pinned for",
+        "reproducibility (the tutorial's [seed] 0 draws time()).",
+        "",
+        "## tpu-f32 cycle (production CLI rounds on the chip)",
+        "",
+        "| round | OPT% | PASS% | BP iters | train s | epoch s | load s |"
+        " eval s |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in tpu:
+        p = r["prof"]
+        epoch_s = p.get("train_epoch", p.get("train_epoch_tp",
+                                             float("nan")))
+        lines.append(
+            f"| {r['round']} | {r['opt']:.1f} | {r['pass']:.1f} "
+            f"| {r['bp_iters']} | {r['t_train']} "
+            f"| {epoch_s:.1f} "
+            f"| {p.get('load_samples', float('nan')):.1f} "
+            f"| {r['t_eval']} |")
+    lines += [
+        "",
+        f"Round 0 trains the fresh kernel ({r0['bp_iters']} BP iterations,",
+        f"{r0['t_train']} s); warm rounds average {mean_train:.1f} s train",
+        f"+ {mean_eval:.1f} s eval wall (process start, {n}-file load,",
+        "epoch, log reconstruction, kernel dump included).  W0 is",
+        "851x231 -- the wide-input shape that stresses VMEM layout",
+        "(PARITY_XRD's 60-sample corpus never exercised it at scale).",
+        "",
+        f"**ref-C on the same corpus** ({ref['seconds']:.0f} s budget run,",
+        f"steady-state clock excluding load): {ref['samples_done']}",
+        f"samples, {ref['bp_iters']} BP iterations ->",
+        f"**{ref['samples_per_sec']} samples/s,",
+        f"{ref['iters_per_sec']:.0f} iters/s**, first-try OK",
+        f"{ref['opt_pct']}%.  At that measured rate the full {n}-sample",
+        f"round 0 is ~**{ref_round0_est / 3600:.1f} hours** (vs",
+        f"{r0['t_train']} s tpu-f32 --",
+        f"~{ref_round0_est / max(r0['t_train'], 1e-9):,.0f}x wall).",
+        "",
+        "**Checkpoint interop at scale:** the compiled reference's own",
+        f"`run_nn` loaded the TPU-trained `kernel.opt` and self-tested the",
+        f"same {n} samples: PASS = **{rev['pass']:.1f}%** in",
+        f"{rev['seconds']:.0f} s, vs {tpu[-1]['pass']:.1f}% from this",
+        "framework's batched eval on the final round.",
+        "",
+        "Same-window check: over the FIRST "
+        f"{ref['samples_done']} round-0 samples (the window the ref-C",
+        "budget run covers, identical training order), first-try OK is",
+        f"ref-C {ref['opt_pct']:.1f}% vs tpu-f32 "
+        f"{_window_opt(tpu[0], ref):.1f}%.",
+        "",
+    ]
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines))
+    print(f"wrote {args.out}")
+
+
+def _window_opt(r0, ref):
+    bits = r0.get("ok_bits", "")[:max(1, ref["samples_done"])]
+    if not bits:
+        return float("nan")
+    return 100.0 * bits.count("1") / len(bits)
+
+
+if __name__ == "__main__":
+    main()

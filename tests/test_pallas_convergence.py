@@ -123,3 +123,50 @@ def test_select_run_batch_dispatch(monkeypatch):
     assert name == "pallas"
     fn, name = select_run_batch(jnp.float64)
     assert name == "xla"
+
+
+@pytest.mark.parametrize("momentum", [False, True])
+def test_budgeted_launches_match_single_launch(momentum):
+    """The iteration-budgeted watchdog driver must be trajectory-exact vs
+    one unbounded launch: a tiny budget forces a resume roughly every
+    sample, the sentinel/merge protocol reassembles identical stats and
+    weights.  (Same kernel, same math -- only launch boundaries move.)"""
+    from hpnn_tpu.ops import convergence
+    from hpnn_tpu.ops.convergence_pallas import train_epoch_pallas_watchdog
+
+    weights, xs, ts = _problem(seed=3, s=6)
+    w1, st1 = train_epoch_pallas(weights, xs, ts, "ANN", momentum,
+                                 interpret=True)
+    # drop the persistent rate tracker to the pessimistic floor and make
+    # the budget tiny: ~1 sample per launch
+    convergence._CHUNKER_CACHE.clear()
+    tracker = convergence._get_chunker([w.shape for w in weights], "ANN",
+                                       momentum, route="pallas_budget")
+    tracker.rate = 1.0 / convergence._WATCHDOG_SAFE_S  # budget == 1 iter
+    w2, st2 = train_epoch_pallas_watchdog(weights, xs, ts, "ANN", momentum,
+                                          interpret=True)
+    for a, b in zip(w1, w2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for f in ("init_err", "n_iter", "final_dep"):
+        np.testing.assert_array_equal(np.asarray(getattr(st1, f)),
+                                      np.asarray(getattr(st2, f)))
+    np.testing.assert_array_equal(np.asarray(st1.success),
+                                  np.asarray(st2.success))
+
+
+def test_budgeted_kernel_sentinels():
+    """A mid-epoch launch trains only from start_idx and stops once the
+    budget is crossed; untouched rows carry the -1 sentinel."""
+    import jax.numpy as jnp_
+
+    from hpnn_tpu.ops.convergence_pallas import _train_epoch_core, _precision
+
+    weights, xs, ts = _problem(seed=4, s=5)
+    _, st = _train_epoch_core(weights, xs, ts, "ANN", False,
+                              alpha=0.2, delta=-1.0, lr=None,
+                              interpret=True, precision=_precision(),
+                              ctrl=jnp_.asarray([2, 1], jnp_.int32))
+    rows = np.asarray(st)
+    assert (rows[:2, 2] == -1).all()      # before start: sentinel
+    assert rows[2, 2] >= 1                # first eligible always trains
+    assert (rows[3:, 2] == -1).all()      # budget=1 crossed after one
