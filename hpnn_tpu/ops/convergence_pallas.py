@@ -120,9 +120,10 @@ def _matvec_t(d, w_ref, precision):
 def _kernel(ctrl_ref, x_ref, t_ref, *refs, n_layers, n_out, kind, momentum,
             lr, alpha, min_iter, max_iter, delta, precision):
     w_in = refs[:n_layers]
-    w_out = refs[n_layers:2 * n_layers]
-    stats_ref = refs[2 * n_layers]
-    rest = refs[2 * n_layers + 1:]
+    stats_in_ref = refs[n_layers]
+    w_out = refs[n_layers + 1:2 * n_layers + 1]
+    stats_ref = refs[2 * n_layers + 1]
+    rest = refs[2 * n_layers + 2:]
     dw = rest[:n_layers] if momentum else ()
     iters_used = rest[-1]   # SMEM (1,) i32, persists across grid steps
 
@@ -137,11 +138,12 @@ def _kernel(ctrl_ref, x_ref, t_ref, *refs, n_layers, n_out, kind, momentum,
     # iteration-budgeted launch with host resume (the device-side
     # watchdog guard): ctrl = (start_idx, iter_budget).  Samples before
     # start_idx were trained by earlier launches; once the counter
-    # crosses the budget the remaining grid steps write a sentinel stats
-    # row and do no math, so one launch executes AT MOST budget + one
-    # sample's MAX_ITER iterations -- an exact bound no host-side sizing
-    # can give.  The first eligible sample always runs (counter starts at
-    # 0 < budget), so every launch makes progress.
+    # crosses the budget the remaining grid steps copy their stats row
+    # THROUGH (so the merged record stays device-resident across
+    # launches) and do no math, so one launch executes AT MOST
+    # budget + one sample's MAX_ITER iterations -- an exact bound no
+    # host-side sizing can give.  The first eligible sample always runs
+    # (counter starts at 0 < budget), so every launch makes progress.
     active = (s >= ctrl_ref[0]) & (iters_used[0] < ctrl_ref[1])
 
     x = x_ref[0]            # (1, Mp0) -- blocks are (1, 1, width)
@@ -153,10 +155,10 @@ def _kernel(ctrl_ref, x_ref, t_ref, *refs, n_layers, n_out, kind, momentum,
 
     @pl.when(jnp.logical_not(active))
     def _():
-        # sentinel: n_iter slot (index 2) = -1 -> "not trained here"
-        srow = jnp.zeros((1, stats_ref.shape[2]), jnp.float32)
-        scol = lax.broadcasted_iota(jnp.int32, srow.shape, 1)
-        stats_ref[0] = jnp.where(scol == 2, jnp.float32(-1.0), srow)
+        # copy-through: rows trained by earlier launches keep their
+        # record; untouched rows keep the host-side -1 sentinel in the
+        # n_iter slot (index 2)
+        stats_ref[0] = stats_in_ref[0]
 
     @pl.when(active)
     def _():
@@ -293,7 +295,7 @@ def _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
                      "precision"))
 def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
                       alpha, delta, lr, interpret, precision,
-                      ctrl=None):
+                      ctrl=None, stats_prev=None):
     """Jitted core: returns the final weight arrays + raw stats rows.
 
     ``precision`` is a required static argument here -- the env-var
@@ -303,6 +305,10 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
     ``ctrl`` is the (start_idx, iter_budget) int32 pair for budgeted
     launches (a DYNAMIC operand: changing it never recompiles); None
     means "whole epoch, unbounded" (start 0, budget INT32_MAX).
+    ``stats_prev`` is the previous launch's (S, LANE) stats record,
+    carried device-resident across resumed launches (inactive grid steps
+    copy their row through); None builds the all-sentinel initial record
+    on device.
     """
     if lr is None:
         lr = bpm_learn_rate(kind) if momentum else bp_learn_rate(kind)
@@ -350,12 +356,19 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
         ctrl = jnp.asarray([0, np.iinfo(np.int32).max], jnp.int32)
     else:
         ctrl = jnp.asarray(ctrl, jnp.int32)
+    if stats_prev is None:
+        # all-sentinel initial record, built ON DEVICE (no host upload):
+        # n_iter slot (2) = -1 means "never trained"
+        scol = lax.broadcasted_iota(jnp.int32, (s, 1, LANE), 2)
+        stats_prev = jnp.where(scol == 2, jnp.float32(-1), jnp.float32(0))
+    else:
+        stats_prev = stats_prev[:, None, :]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(s,),
         in_specs=[per_s(xs.shape[1]), per_s(ts.shape[1])]
-        + [const(w.shape) for w in wp],
+        + [const(w.shape) for w in wp] + [per_s(LANE)],
         out_specs=[const(w.shape) for w in wp] + [per_s(LANE)],
         scratch_shapes=([pltpu.VMEM(w.shape, wdtype) for w in wp]
                         if momentum else [])
@@ -369,7 +382,7 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("arbitrary",)),
         interpret=interpret,
-    )(ctrl, xp, tp, *wp)
+    )(ctrl, xp, tp, *wp, stats_prev)
 
     return tuple(out[:n_layers]), out[n_layers][:, 0, :]
 
@@ -438,7 +451,8 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
                            route="pallas_budget")
     start = 0
     w = weights
-    rows = np_.empty((s, 5), np_.float32)
+    st = None    # (S, LANE) record, device-resident across launches
+    cum_iters = 0.0
     while start < s:
         # reserve the last-started sample's worst-case tail (MAX_ITER)
         # inside the safe window: worst launch = budget + MAX_ITER
@@ -452,18 +466,19 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
         w, st = _train_epoch_core(
             w, xs, ts, kind, momentum, alpha=alpha, delta=delta, lr=lr,
             interpret=interpret, precision=precision,
-            ctrl=jnp.asarray([start, budget], jnp.int32))
-        # ONE host read syncs the launch: how many samples it finished
-        # and how many iterations they took (sentinel rows carry -1)
+            ctrl=jnp.asarray([start, budget], jnp.int32), stats_prev=st)
+        # TWO scalar host reads sync the launch (fixed shapes, computed
+        # on device -- no ragged slices, no recompiles): the CUMULATIVE
+        # trained count (= next start) and iteration total
         n_col = st[:, 2]
-        done = int(jnp.sum((n_col >= 0.0).astype(jnp.int32)))
-        iters = float(jnp.sum(jnp.where(n_col > 0.0, n_col, 0.0)))
+        new_start = int(jnp.sum((n_col >= 0.0).astype(jnp.int32)))
+        new_iters = float(jnp.sum(jnp.where(n_col > 0.0, n_col, 0.0)))
         dt = time.perf_counter() - t0
-        assert done > 0, "budgeted launch made no progress"
-        # device slice first: only the finished rows cross the tunnel
-        rows[start:start + done] = np_.asarray(st[start:start + done, :5])
-        tracker.observe(iters, dt)
-        start += done
+        assert new_start > start, "budgeted launch made no progress"
+        tracker.observe(new_iters - cum_iters, dt)
+        start, cum_iters = new_start, new_iters
+    # one fixed-shape pull for the whole epoch record
+    rows = np_.asarray(st[:, :5])
     stats = SampleStats(
         init_err=jnp.asarray(rows[:, 0]),
         first_ok=jnp.asarray(rows[:, 1] > 0.5),

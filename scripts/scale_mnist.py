@@ -104,9 +104,14 @@ def parse_prof(text: str):
     return out
 
 
-def run_tpu_cycle(workdir, rounds):
-    """1+rounds rounds of the production CLI, [dtype] f32 on the ambient
-    (TPU) backend; returns per-round records."""
+def run_tpu_cycle(workdir, rounds, dtype="f32", conf_writer=None):
+    """1+rounds rounds of the production CLI on the ambient (TPU)
+    backend; returns per-round records.  dtype feeds the conf's [dtype]
+    (f32 is the throughput default; bf16 extends the dtype claim to
+    reference scale -- VERDICT r4 stretch 8).  ``conf_writer(workdir,
+    first, dtype=...)`` defaults to this workload's conf (scale_xrd
+    reuses the cycle protocol with its own)."""
+    wconf = conf_writer or write_conf
     env = dict(os.environ, HPNN_PROFILE="1")
     train_cmd = [sys.executable, os.path.join(REPO, "apps/train_nn.py"),
                  "-v", "-v", "nn.conf"]
@@ -114,7 +119,7 @@ def run_tpu_cycle(workdir, rounds):
                "-v", "-v", "nn.conf"]
     records = []
     for rnd in range(rounds + 1):
-        write_conf(workdir, first=(rnd == 0), dtype="f32")
+        wconf(workdir, first=(rnd == 0), dtype=dtype)
         t0 = time.time()
         tr = subprocess.run(train_cmd, cwd=workdir, env=env,
                             capture_output=True, text=True, timeout=14400)
@@ -124,7 +129,7 @@ def run_tpu_cycle(workdir, rounds):
         # tutorial switches to the continuation conf before the first
         # eval (tutorial.bash:102-104) -- evaluating the round-0 conf
         # as-is would re-[init] a fresh kernel
-        write_conf(workdir, first=False, dtype="f32")
+        wconf(workdir, first=False, dtype=dtype)
         t0 = time.time()
         rn = subprocess.run(run_cmd, cwd=workdir, env=env,
                             capture_output=True, text=True, timeout=7200)
@@ -143,7 +148,7 @@ def run_tpu_cycle(workdir, rounds):
                "ok_bits": ok_bits(tr.stdout),
                "prof": parse_prof(tr.stdout + tr.stderr)}
         records.append(rec)
-        print(f"  tpu-f32 round {rnd}: OPT={opt:.1f}% PASS={acc:.1f}% "
+        print(f"  tpu-{dtype} round {rnd}: OPT={opt:.1f}% PASS={acc:.1f}% "
               f"train={t_train:.0f}s (epoch "
               f"{rec['prof'].get('train_epoch', -1):.0f}s, "
               f"{iters} iters) eval={t_eval:.0f}s", flush=True)
@@ -241,6 +246,14 @@ def corpus_complete(root, n_train, n_test) -> bool:
         return False
 
 
+def _cells(dtype):
+    """Cache-cell keys for a dtype: the CYCLE and the ref cross-eval are
+    dtype-specific (the cross-eval scores the cycle's own kernel.opt);
+    the ref-C budget cell is dtype-independent (ref-C has no [dtype])."""
+    suffix = "" if dtype == "f32" else f"-{dtype}"
+    return "tpu" + suffix, "ref_eval" + suffix
+
+
 def run_profile(base, profile, args, res, save):
     workdir = os.path.join(base, f"work-{profile}")
     if not corpus_complete(workdir, args.train, args.test):
@@ -251,9 +264,13 @@ def run_profile(base, profile, args, res, save):
         make_corpus(workdir, args.train, args.test, profile=profile)
         print(f"  corpus written in {time.time() - t0:.0f}s", flush=True)
     r = res.setdefault(profile, {})
-    if "tpu" not in r:
-        print(f"[{profile}] tpu-f32 cycle ...", flush=True)
-        r["tpu"] = run_tpu_cycle(workdir, args.rounds)
+    # cycle + cross-eval cells are keyed by dtype: a bf16 run against an
+    # f32 cache must never reuse (or republish) f32 cells (round-5
+    # review -- including a cross-eval of a DIFFERENT dtype's kernel)
+    cell, eval_cell = _cells(args.dtype)
+    if cell not in r:
+        print(f"[{profile}] tpu-{args.dtype} cycle ...", flush=True)
+        r[cell] = run_tpu_cycle(workdir, args.rounds, dtype=args.dtype)
         save()
     if "ref" not in r:
         print(f"[{profile}] ref-C budget run ({args.ref_budget}s) ...",
@@ -267,13 +284,13 @@ def run_profile(base, profile, args, res, save):
         r["ref"] = run_ref_budget(ref_workdir, args.ref_budget)
         save()
         print(f"  ref-C: {r['ref']}", flush=True)
-    if "ref_eval" not in r:
+    if eval_cell not in r:
         print(f"[{profile}] ref-C cross-eval of the TPU kernel.opt ...",
               flush=True)
-        r["ref_eval"] = run_ref_cross_eval(
+        r[eval_cell] = run_ref_cross_eval(
             workdir, os.path.join(base, f"ref_eval-{profile}"))
         save()
-        print(f"  ref-C eval: {r['ref_eval']}", flush=True)
+        print(f"  ref-C eval: {r[eval_cell]}", flush=True)
 
 
 def subset_workdir(base, full_workdir, n_train, n_test):
@@ -335,6 +352,8 @@ def run_hard_sweep(base, args, res, save):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--dtype", default="f32",
+                    help="[dtype] for the cycle (f32/bf16); use a separate\n                    --results cache per dtype")
     ap.add_argument("--train", type=int, default=60000)
     ap.add_argument("--test", type=int, default=10000)
     ap.add_argument("--ref-budget", type=int, default=900)
@@ -371,6 +390,11 @@ def main():
             json.dump(res, open(tmp, "w"))
             os.replace(tmp, args.results)
 
+    # persist the semantics stamp even on a fully-cached run (round 5:
+    # a run where every cell is cached calls no save(), leaving the
+    # on-disk cache unstamped and the NEXT run dropping valid cells)
+    save()
+
     profiles = args.profiles.split(",")
     for profile in profiles:
         run_profile(base, profile, args, res, save)
@@ -387,10 +411,12 @@ def cycle_table(tpu):
     ]
     for r in tpu:
         p = r["prof"]
+        epoch_s = p.get("train_epoch",
+                        p.get("train_epoch_tp", float("nan")))
         lines.append(
             f"| {r['round']} | {r['opt']:.1f} | {r['pass']:.1f} "
             f"| {r['bp_iters']} | {r['t_train']} "
-            f"| {p.get('train_epoch', float('nan')):.1f} "
+            f"| {epoch_s:.1f} "
             f"| {p.get('load_samples', float('nan')):.1f} "
             f"| {r['t_eval']} |")
     return lines
@@ -413,22 +439,24 @@ def render(args, res, profiles):
         "Every round runs the production CLI (`apps/train_nn.py` /",
         "`apps/run_nn.py`) against the on-disk file corpus: 60k-file",
         "directory load, seeded shuffle, chunked Pallas convergence epoch",
-        "(adaptively sized worst-case-safe launches under the TPU runtime's",
+        "(iteration-budgeted launches resumed under the TPU runtime's ~60 s",
         "single-program watchdog -- measured and documented in",
         "`ops/convergence.py`), 60k-line log reconstruction, 10k-file",
         "batched eval.",
         "",
     ]
+    eng = f"tpu-{args.dtype}"
     for profile in profiles:
         r = res[profile]
-        tpu, ref, rev = r["tpu"], r["ref"], r["ref_eval"]
+        cell, eval_cell = _cells(args.dtype)
+        tpu, ref, rev = r[cell], r["ref"], r[eval_cell]
         r0 = tpu[0]
         warm = tpu[1:] or [r0]
         ref_round0_est = args.train / max(ref["samples_per_sec"], 1e-9)
         mean_train = np.mean([x["t_train"] for x in warm])
         mean_eval = np.mean([x["t_eval"] for x in warm])
         lines += [
-            f"## `{profile}` profile -- tpu-f32 cycle (full rounds on the"
+            f"## `{profile}` profile -- {eng} cycle (full rounds on the"
             " chip)",
             "",
         ]
@@ -449,7 +477,7 @@ def render(args, res, profiles):
             f"full {args.train}-sample round 0 is",
             f"~**{ref_round0_est / 3600:.1f} hours** (vs"
             f" {r0['t_train']} s",
-            f"tpu-f32 -- ~{ref_round0_est / max(r0['t_train'], 1e-9):,.0f}"
+            f"{eng} -- ~{ref_round0_est / max(r0['t_train'], 1e-9):,.0f}"
             "x wall).",
             "",
             "**Checkpoint interop at scale:** the compiled reference's",
@@ -463,7 +491,7 @@ def render(args, res, profiles):
     if "hard" in profiles and "easy" in profiles:
         h = res["hard"]
         n_w = h["ref"]["samples_done"]
-        tpu_bits = h["tpu"][0].get("ok_bits", "")
+        tpu_bits = h[_cells(args.dtype)[0]][0].get("ok_bits", "")
         window = ""
         if tpu_bits and h["ref"].get("ok_bits"):
             w_tpu = (100.0 * tpu_bits[:n_w].count("1")
@@ -472,7 +500,7 @@ def render(args, res, profiles):
                 f"Same-window check: over the FIRST {n_w} round-0 samples "
                 f"(the window ref-C's budget run covers, identical "
                 f"training order), first-try OK is ref-C "
-                f"{h['ref']['opt_pct']:.1f}% vs tpu-f32 {w_tpu:.1f}% -- "
+                f"{h['ref']['opt_pct']:.1f}% vs {eng} {w_tpu:.1f}% -- "
                 "both engines learn early in round 0 and both are ground "
                 "back to chance as the remaining tens of thousands of "
                 "hard samples interfere.")

@@ -42,8 +42,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from scale_mnist import (  # noqa: E402
-    ok_bits, parse_prof, run_ref_budget, run_ref_cross_eval)
-from parity_artifact import scrape  # noqa: E402
+    cycle_table, run_ref_budget, run_ref_cross_eval, run_tpu_cycle)
 
 CONF = """[name] XRD5K
 [type] ANN
@@ -123,8 +122,10 @@ def make_rruff(root, groups, per_group, seed=77):
 
 
 def ensure_corpus(base, groups, per_group):
-    """Generate + pdif-convert once; idempotent across reruns."""
-    src = os.path.join(base, "src")
+    """Generate + pdif-convert once; idempotent across reruns.  The dir
+    is keyed by scale so a smaller smoke run can never clobber the
+    full corpus (round-5 lesson: it did)."""
+    src = os.path.join(base, f"src-{groups}x{per_group}")
     n = groups * per_group
     sampledir = os.path.join(src, "samples")
     try:
@@ -150,46 +151,6 @@ def ensure_corpus(base, groups, per_group):
     print(f"  pdif converted {made} samples in {time.time() - t0:.0f}s",
           flush=True)
     return src
-
-
-def run_tpu_cycle(workdir, rounds):
-    """1+rounds rounds of the production CLI on the ambient backend."""
-    env = dict(os.environ, HPNN_PROFILE="1")
-    train_cmd = [sys.executable, os.path.join(REPO, "apps/train_nn.py"),
-                 "-v", "-v", "nn.conf"]
-    run_cmd = [sys.executable, os.path.join(REPO, "apps/run_nn.py"),
-               "-v", "-v", "nn.conf"]
-    records = []
-    for rnd in range(rounds + 1):
-        write_conf(workdir, first=(rnd == 0), dtype="f32")
-        t0 = time.time()
-        tr = subprocess.run(train_cmd, cwd=workdir, env=env,
-                            capture_output=True, text=True, timeout=14400)
-        t_train = time.time() - t0
-        assert tr.returncode == 0, (rnd, tr.stderr[-2000:])
-        # eval always loads the just-trained kernel.opt
-        # (tutorial.bash:102-104 semantics; scale_mnist.py EVAL_SEMANTICS=2)
-        write_conf(workdir, first=False, dtype="f32")
-        t0 = time.time()
-        rn = subprocess.run(run_cmd, cwd=workdir, env=env,
-                            capture_output=True, text=True, timeout=7200)
-        t_eval = time.time() - t0
-        assert rn.returncode == 0, (rnd, rn.stderr[-2000:])
-        opt, acc = scrape(tr.stdout, rn.stdout)
-        import re
-
-        iters = sum(int(m) for m in
-                    re.findall(r"N_ITER=\s*(\d+)", tr.stdout))
-        rec = {"round": rnd, "opt": opt, "pass": acc,
-               "t_train": round(t_train, 1), "t_eval": round(t_eval, 1),
-               "bp_iters": iters, "ok_bits": ok_bits(tr.stdout),
-               "prof": parse_prof(tr.stdout + tr.stderr)}
-        records.append(rec)
-        print(f"  tpu-f32 round {rnd}: OPT={opt:.1f}% PASS={acc:.1f}% "
-              f"train={t_train:.0f}s (epoch "
-              f"{rec['prof'].get('train_epoch_tp', rec['prof'].get('train_epoch', -1)):.0f}s, "
-              f"{iters} iters) eval={t_eval:.0f}s", flush=True)
-    return records
 
 
 def main():
@@ -224,7 +185,10 @@ def main():
             os.replace(tmp, args.results)
 
     src = ensure_corpus(base, args.groups, args.per_group)
-    workdir = os.path.join(base, "work")
+    # work/ref dirs keyed by scale too: their samples symlinks must track
+    # the matching corpus
+    tag = f"{args.groups}x{args.per_group}"
+    workdir = os.path.join(base, f"work-{tag}")
     if not os.path.exists(os.path.join(workdir, "samples")):
         os.makedirs(workdir, exist_ok=True)
         os.symlink(os.path.join(os.path.abspath(src), "samples"),
@@ -233,11 +197,12 @@ def main():
 
     if "tpu" not in res:
         print("tpu-f32 cycle ...", flush=True)
-        res["tpu"] = run_tpu_cycle(workdir, args.rounds)
+        res["tpu"] = run_tpu_cycle(workdir, args.rounds,
+                                   conf_writer=write_conf)
         save()
     if "ref" not in res:
         print(f"ref-C budget run ({args.ref_budget}s) ...", flush=True)
-        ref_wd = os.path.join(base, "ref_round0")
+        ref_wd = os.path.join(base, f"ref_round0-{tag}")
         shutil.rmtree(ref_wd, ignore_errors=True)
         os.makedirs(ref_wd)
         os.symlink(os.path.join(os.path.abspath(src), "samples"),
@@ -249,7 +214,7 @@ def main():
     if "ref_eval" not in res:
         print("ref-C cross-eval of the TPU kernel.opt ...", flush=True)
         res["ref_eval"] = run_ref_cross_eval(
-            workdir, os.path.join(base, "ref_eval"),
+            workdir, os.path.join(base, f"ref_eval-{tag}"),
             conf_writer=write_conf, dirs=("samples",))
         save()
         print(f"  ref-C eval: {res['ref_eval']}", flush=True)
@@ -280,20 +245,8 @@ def render(args, res):
         "",
         "## tpu-f32 cycle (production CLI rounds on the chip)",
         "",
-        "| round | OPT% | PASS% | BP iters | train s | epoch s | load s |"
-        " eval s |",
-        "|---|---|---|---|---|---|---|---|",
     ]
-    for r in tpu:
-        p = r["prof"]
-        epoch_s = p.get("train_epoch", p.get("train_epoch_tp",
-                                             float("nan")))
-        lines.append(
-            f"| {r['round']} | {r['opt']:.1f} | {r['pass']:.1f} "
-            f"| {r['bp_iters']} | {r['t_train']} "
-            f"| {epoch_s:.1f} "
-            f"| {p.get('load_samples', float('nan')):.1f} "
-            f"| {r['t_eval']} |")
+    lines += cycle_table(tpu)
     lines += [
         "",
         f"Round 0 trains the fresh kernel ({r0['bp_iters']} BP iterations,",
