@@ -169,6 +169,37 @@ def _kernel(ctrl_ref, x_ref, t_ref, *refs, n_layers, n_out, kind, momentum,
                    precision=precision)
 
 
+def _kernel_plain(x_ref, t_ref, *refs, n_layers, n_out, kind, momentum,
+                  lr, alpha, min_iter, max_iter, delta, precision):
+    """The unbudgeted kernel (pre-round-5 program shape): no scalar
+    prefetch, no SMEM counter, no stats carry -- kept as the proven
+    Mosaic lowering behind HPNN_EPOCH_CHUNK fixed-size chunking, and as
+    the de-risk fallback if the budgeted variant's scalar-prefetch/SMEM
+    machinery ever fails to lower on a new Mosaic version."""
+    w_in = refs[:n_layers]
+    w_out = refs[n_layers:2 * n_layers]
+    stats_ref = refs[2 * n_layers]
+    dw = refs[2 * n_layers + 1:] if momentum else ()
+
+    s = pl.program_id(0)
+
+    @pl.when(s == 0)
+    def _():
+        for wi, wo in zip(w_in, w_out):
+            wo[:] = wi[:]
+
+    x = x_ref[0]
+    t = t_ref[0]
+    dtype = x.dtype
+    npl = t.shape[1]
+    col = lax.broadcasted_iota(jnp.int32, (1, npl), 1)
+    out_mask = col < n_out
+    _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
+               None, n_layers=n_layers, n_out=n_out, kind=kind,
+               momentum=momentum, lr=lr, alpha=alpha, min_iter=min_iter,
+               max_iter=max_iter, delta=delta, precision=precision)
+
+
 def _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
                iters_used, *, n_layers, n_out, kind, momentum, lr, alpha,
                min_iter, max_iter, delta, precision):
@@ -272,7 +303,8 @@ def _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
               jnp.asarray(False), acts0, init_err)
     it, dep, is_ok_raw, first_ok, _, _ = lax.while_loop(cond, body, state0)
     success = is_ok_raw & (it > min_iter)
-    iters_used[0] = iters_used[0] + it
+    if iters_used is not None:
+        iters_used[0] = iters_used[0] + it
 
     # scatter the 5 scalars into the (1, LANE) stats row with vector selects
     # (elementwise VMEM stores of scalars don't lower on all Mosaic
@@ -292,23 +324,25 @@ def _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("kind", "momentum", "alpha", "delta", "lr", "interpret",
-                     "precision"))
+                     "precision", "budgeted"))
 def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
                       alpha, delta, lr, interpret, precision,
-                      ctrl=None, stats_prev=None):
+                      budgeted=False, ctrl=None, stats_prev=None):
     """Jitted core: returns the final weight arrays + raw stats rows.
 
     ``precision`` is a required static argument here -- the env-var
     default is resolved by the public wrapper BEFORE the jit boundary, so
     the cache is keyed on the actual precision, not on ``None``.
 
-    ``ctrl`` is the (start_idx, iter_budget) int32 pair for budgeted
-    launches (a DYNAMIC operand: changing it never recompiles); None
-    means "whole epoch, unbounded" (start 0, budget INT32_MAX).
-    ``stats_prev`` is the previous launch's (S, LANE) stats record,
-    carried device-resident across resumed launches (inactive grid steps
-    copy their row through); None builds the all-sentinel initial record
-    on device.
+    ``budgeted`` (static) selects the iteration-budgeted program
+    (_kernel: scalar prefetch + SMEM counter + stats carry) vs the plain
+    whole-epoch one (_kernel_plain, the pre-round-5 shape).  When
+    budgeted, ``ctrl`` is the (start_idx, iter_budget) int32 pair (a
+    DYNAMIC operand: changing it never recompiles; None means start 0,
+    budget INT32_MAX) and ``stats_prev`` is the previous launch's
+    (S, LANE) stats record, carried device-resident across resumed
+    launches (inactive grid steps copy their row through); None builds
+    the all-sentinel initial record on device.
     """
     if lr is None:
         lr = bpm_learn_rate(kind) if momentum else bp_learn_rate(kind)
@@ -331,24 +365,54 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
     # weights ever changing.  f32/f64 modes are untouched (identity).
     wdtype = _acc(dtype)  # same promotion rule as the accumulators
     wp = tuple(w.astype(wdtype) for w in weights)
+    if s == 0:
+        # empty epoch: a zero-size grid would never run the s==0
+        # weight-copy prologue, so the output buffers would come back
+        # uninitialized -- return the (master-dtype) inputs unchanged
+        return wp, jnp.zeros((0, LANE), jnp.float32)
     # per-sample rows as (S, 1, width): Mosaic requires the last two block
     # dims to be (8k, 128k) OR the full array dims, so a (1, 1, width)
     # block over a 3D array is the shape a one-sample stream must take
     xp = xs[:, None, :]
     tp = ts[:, None, :]
 
-    kernel = functools.partial(
-        _kernel, n_layers=n_layers, n_out=ts.shape[1], kind=kind,
-        momentum=momentum, lr=float(lr), alpha=float(alpha),
-        min_iter=min_iter, max_iter=max_iter, delta=float(delta),
-        precision=precision)
+    kargs = dict(n_layers=n_layers, n_out=ts.shape[1], kind=kind,
+                 momentum=momentum, lr=float(lr), alpha=float(alpha),
+                 min_iter=min_iter, max_iter=max_iter, delta=float(delta),
+                 precision=precision)
+    out_shape = [jax.ShapeDtypeStruct(w.shape, wdtype) for w in wp] \
+        + [jax.ShapeDtypeStruct((s, 1, LANE), jnp.float32)]
+    scratch = ([pltpu.VMEM(w.shape, wdtype) for w in wp]
+               if momentum else [])
+    params = pltpu.CompilerParams(dimension_semantics=("arbitrary",))
 
     # index maps must return i32: a python literal 0 traces as i64 under
     # x64 (Mosaic cannot legalize the index-map func.return), and a traced
     # jnp.int32 would be an illegal captured constant -- a numpy scalar is
-    # both typed and capture-safe.  With scalar prefetch the index maps
-    # take (i, ctrl_ref) -- the control scalars are unused for indexing.
+    # both typed and capture-safe.
     z = np.int32(0)
+
+    if not budgeted:
+        assert ctrl is None and stats_prev is None, \
+            "ctrl/stats_prev require budgeted=True"
+        const = lambda shape: pl.BlockSpec(shape, lambda i: (z, z))
+        per_s = lambda width: pl.BlockSpec((1, 1, width),
+                                           lambda i: (i, z, z))
+        out = pl.pallas_call(
+            functools.partial(_kernel_plain, **kargs),
+            grid=(s,),
+            in_specs=[per_s(xs.shape[1]), per_s(ts.shape[1])]
+            + [const(w.shape) for w in wp],
+            out_specs=[const(w.shape) for w in wp] + [per_s(LANE)],
+            out_shape=out_shape,
+            scratch_shapes=scratch,
+            compiler_params=params,
+            interpret=interpret,
+        )(xp, tp, *wp)
+        return tuple(out[:n_layers]), out[n_layers][:, 0, :]
+
+    # budgeted program: with scalar prefetch the index maps take
+    # (i, ctrl_ref) -- the control scalars are unused for indexing
     const = lambda shape: pl.BlockSpec(shape, lambda i, c: (z, z))
     per_s = lambda width: pl.BlockSpec((1, 1, width), lambda i, c: (i, z, z))
 
@@ -370,17 +434,13 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
         in_specs=[per_s(xs.shape[1]), per_s(ts.shape[1])]
         + [const(w.shape) for w in wp] + [per_s(LANE)],
         out_specs=[const(w.shape) for w in wp] + [per_s(LANE)],
-        scratch_shapes=([pltpu.VMEM(w.shape, wdtype) for w in wp]
-                        if momentum else [])
-        + [pltpu.SMEM((1,), jnp.int32)],
+        scratch_shapes=scratch + [pltpu.SMEM((1,), jnp.int32)],
     )
     out = pl.pallas_call(
-        kernel,
+        functools.partial(_kernel, **kargs),
         grid_spec=grid_spec,
-        out_shape=[jax.ShapeDtypeStruct(w.shape, wdtype) for w in wp]
-        + [jax.ShapeDtypeStruct((s, 1, LANE), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("arbitrary",)),
+        out_shape=out_shape,
+        compiler_params=params,
         interpret=interpret,
     )(ctrl, xp, tp, *wp, stats_prev)
 
@@ -466,6 +526,7 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
         w, st = _train_epoch_core(
             w, xs, ts, kind, momentum, alpha=alpha, delta=delta, lr=lr,
             interpret=interpret, precision=precision,
+            budgeted=True,
             ctrl=jnp.asarray([start, budget], jnp.int32), stats_prev=st)
         # TWO scalar host reads sync the launch (fixed shapes, computed
         # on device -- no ragged slices, no recompiles): the CUMULATIVE

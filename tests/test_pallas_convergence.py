@@ -165,6 +165,7 @@ def test_budgeted_kernel_sentinels():
     _, st = _train_epoch_core(weights, xs, ts, "ANN", False,
                               alpha=0.2, delta=-1.0, lr=None,
                               interpret=True, precision=_precision(),
+                              budgeted=True,
                               ctrl=jnp_.asarray([2, 1], jnp_.int32))
     rows = np.asarray(st)
     assert (rows[:2, 2] == -1).all()      # before start: sentinel
