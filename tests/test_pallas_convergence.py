@@ -125,8 +125,9 @@ def test_select_run_batch_dispatch(monkeypatch):
     assert name == "xla"
 
 
-@pytest.mark.parametrize("momentum", [False, True])
-def test_budgeted_launches_match_single_launch(momentum):
+@pytest.mark.parametrize("kind,momentum",
+                         [("ANN", False), ("ANN", True), ("SNN", False)])
+def test_budgeted_launches_match_single_launch(kind, momentum):
     """The iteration-budgeted watchdog driver must be trajectory-exact vs
     one unbounded launch: a tiny budget forces a resume roughly every
     sample, the sentinel/merge protocol reassembles identical stats and
@@ -135,15 +136,15 @@ def test_budgeted_launches_match_single_launch(momentum):
     from hpnn_tpu.ops.convergence_pallas import train_epoch_pallas_watchdog
 
     weights, xs, ts = _problem(seed=3, s=6)
-    w1, st1 = train_epoch_pallas(weights, xs, ts, "ANN", momentum,
+    w1, st1 = train_epoch_pallas(weights, xs, ts, kind, momentum,
                                  interpret=True)
     # drop the persistent rate tracker to the pessimistic floor and make
     # the budget tiny: ~1 sample per launch
     convergence._CHUNKER_CACHE.clear()
-    tracker = convergence._get_chunker([w.shape for w in weights], "ANN",
+    tracker = convergence._get_chunker([w.shape for w in weights], kind,
                                        momentum, route="pallas_budget")
     tracker.rate = 1.0 / convergence._WATCHDOG_SAFE_S  # budget == 1 iter
-    w2, st2 = train_epoch_pallas_watchdog(weights, xs, ts, "ANN", momentum,
+    w2, st2 = train_epoch_pallas_watchdog(weights, xs, ts, kind, momentum,
                                           interpret=True)
     for a, b in zip(w1, w2):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
