@@ -500,7 +500,14 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
     if precision is None:
         precision = _precision()
     s = xs.shape[0]
-    if s == 0:
+    if s == 0 or isinstance(jnp.asarray(0), jax.core.Tracer):
+        # Under jit tracing the host resume loop cannot run (the trained
+        # count is a traced value); the single-launch program is the same
+        # kernel, exact but unbudgeted -- watchdog bounding is only
+        # meaningful for an eager caller anyway (the launch boundary IS
+        # the host sync).  api.train_kernel calls this fn eagerly.
+        # asarray(0) lifts to a tracer under ANY ambient trace (including
+        # closed-over numpy corpora) at zero transfer cost.
         return train_epoch_pallas(weights, xs, ts, kind, momentum,
                                   alpha=alpha, delta=delta, lr=lr,
                                   interpret=interpret, precision=precision)

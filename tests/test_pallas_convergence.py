@@ -155,6 +155,26 @@ def test_budgeted_launches_match_single_launch(kind, momentum):
                                   np.asarray(st2.success))
 
 
+def test_watchdog_driver_jit_safe():
+    """A jit-wrapped caller of the production epoch (the on-chip dispatch
+    check does exactly this) must trace: the host resume loop cannot run
+    on tracers, so the driver delegates to the single-launch program."""
+    import jax
+
+    from hpnn_tpu.ops.convergence_pallas import train_epoch_pallas_watchdog
+
+    weights, xs, ts = _problem(seed=5, s=3)
+    w1, st1 = train_epoch_pallas(weights, xs, ts, "ANN", False,
+                                 interpret=True)
+    w2, st2 = jax.jit(
+        lambda w, x, t: train_epoch_pallas_watchdog(
+            w, x, t, "ANN", False, interpret=True))(weights, xs, ts)
+    for a, b in zip(w1, w2):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    np.testing.assert_array_equal(np.asarray(st1.n_iter),
+                                  np.asarray(st2.n_iter))
+
+
 def test_budgeted_kernel_sentinels():
     """A mid-epoch launch trains only from start_idx and stops once the
     budget is crossed; untouched rows carry the -1 sentinel."""
