@@ -327,8 +327,9 @@ def train_kernel(nn: NNDef) -> bool:
         #
         # Routing is SEMANTIC, not a performance fallback (VERDICT r3
         # missing 4, measured round 4): the XLA minibatch epoch runs ONE
-        # update per sample per epoch at 41-110 TFLOPS f32 on-chip
-        # (21-56% MFU; scripts/dp_profile.py), while the Pallas route
+        # update per sample per epoch at 51-129 TFLOPS f32 on-chip
+        # (26-65% MFU; committed artifact DP_PROFILE.md, regenerate with
+        # scripts/dp_profile.py --out DP_PROFILE.md), while the Pallas route
         # below runs the reference's per-sample train-TO-CONVERGENCE
         # loop (~500-2000 data-dependent iterations per sample at ~786k
         # iters/s).  The two are different training algorithms with

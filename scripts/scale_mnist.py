@@ -365,7 +365,16 @@ def main():
                                          "results.json"),
                     help="JSON checkpoint: finished cells are reused on "
                     "re-runs (pass an empty string to disable)")
+    ap.add_argument("--append-to", default="",
+                    help="instead of writing --out as a standalone "
+                    "document, append/replace a marked continuation "
+                    "section in this file (the 1+50 protocol lands in "
+                    "SCALE_MNIST60K.md without clobbering the 1+10 "
+                    "tables; idempotent via HTML markers)")
     args = ap.parse_args()
+    if args.append_to and len(args.profiles.split(",")) != 1:
+        ap.error("--append-to renders exactly one profile "
+                 "(e.g. --profiles easy); got " + args.profiles)
 
     base = os.path.join(REPO, ".scratch", "scale60k")
     os.makedirs(base, exist_ok=True)
@@ -400,7 +409,83 @@ def main():
         run_profile(base, profile, args, res, save)
     if "hard" in profiles:
         run_hard_sweep(base, args, res, save)
-    render(args, res, profiles)
+    if args.append_to:
+        append_section(args, res, profiles)
+    else:
+        render(args, res, profiles)
+
+
+def append_section(args, res, profiles):
+    """Render the cycle as a marked section inside an existing artifact
+    (the reference tutorial's FULL protocol is 1 seed round + 50
+    continuation rounds, tutorial.bash:185-197; the 1+10 headline tables
+    stay authoritative for per-round anatomy)."""
+    assert len(profiles) == 1, "--append-to renders exactly one profile"
+    profile = profiles[0]
+    cell, eval_cell = _cells(args.dtype)
+    tpu = res[profile][cell]
+    # the cycle cell is not keyed by --rounds: a cached cell from an
+    # earlier run may hold a different count, and the section must
+    # describe the DATA, not the flag
+    rounds = len(tpu) - 1
+    begin = f"<!-- continuation:{profile}-{args.dtype}:begin -->"
+    end = f"<!-- continuation:{profile}-{args.dtype}:end -->"
+    warm = tpu[1:] or tpu
+    total = sum(x["t_train"] + x["t_eval"] for x in tpu)
+    peak = max(x["pass"] for x in tpu)
+    intro = [
+        "The reference tutorial's complete MNIST protocol is one seed",
+        "round plus 50 kernel.opt continuation rounds",
+        "(`/root/reference/tutorials/mnist/tutorial.bash:185-197`);",
+        "same corpus and seed as the 1+10 table above:",
+    ] if rounds == 50 else [
+        f"`[dtype] {args.dtype}` at reference scale -- same corpus,",
+        "seed, and protocol as the f32 tables above:",
+    ]
+    lines = [
+        begin,
+        f"## 1+{rounds} cycle, `{profile}` profile, "
+        f"tpu-{args.dtype}",
+        "",
+        *intro,
+        "",
+    ]
+    lines += cycle_table(tpu)
+    lines += [
+        "",
+        f"{1 + rounds} rounds in {total / 60:.1f} min wall"
+        f" ({np.mean([x['t_train'] for x in warm]):.1f} s mean warm"
+        f" train + {np.mean([x['t_eval'] for x in warm]):.1f} s eval);"
+        f" peak PASS {peak:.1f}%.",
+    ]
+    if eval_cell in res[profile]:
+        rev = res[profile][eval_cell]
+        lines += [
+            "",
+            "Checkpoint interop: the compiled reference's `run_nn`",
+            f"evaluated this cycle's final `kernel.opt` at",
+            f"**{rev['pass']:.1f}%** PASS ({rev['seconds']:.0f} s on the",
+            f"same {args.test} test files).",
+        ]
+    lines.append(end)
+    text = open(args.append_to).read()
+    block = "\n".join(lines) + "\n"
+    if begin in text:
+        if end not in text:
+            raise SystemExit(
+                f"{args.append_to}: begin marker {begin!r} present but "
+                f"end marker {end!r} missing -- repair the marker pair "
+                "before re-running (results are cached; no work is lost)")
+        pre = text[:text.index(begin)]
+        post = text[text.index(end) + len(end):].lstrip("\n")
+        # keep exactly one blank line before any following section so a
+        # data-identical re-run is byte-identical
+        text = pre + block + ("\n" + post if post else "")
+    else:
+        text = text.rstrip("\n") + "\n\n" + block
+    with open(args.append_to, "w") as f:
+        f.write(text)
+    print(f"appended 1+{rounds} section to {args.append_to}")
 
 
 def cycle_table(tpu):
