@@ -216,14 +216,16 @@ def run_ref_budget(workdir, budget_s, conf_writer=None):
 
 
 def run_ref_cross_eval(workdir, ref_workdir, conf_writer=None,
-                       dirs=("samples", "tests")):
-    """The compiled reference's run_nn evaluating OUR kernel.opt."""
+                       dirs=("samples", "tests"), kernel_path=None):
+    """The compiled reference's run_nn evaluating OUR kernel.opt.
+    ``kernel_path`` names the kernel explicitly (the per-dtype stash);
+    default is the workdir's live kernel.opt."""
     os.makedirs(ref_workdir, exist_ok=True)
     for d in dirs:
         dst = os.path.join(ref_workdir, d)
         if not os.path.exists(dst):
             os.symlink(os.path.join(os.path.abspath(workdir), d), dst)
-    shutil.copy(os.path.join(workdir, "kernel.opt"),
+    shutil.copy(kernel_path or os.path.join(workdir, "kernel.opt"),
                 os.path.join(ref_workdir, "kernel.opt"))
     (conf_writer or write_conf)(ref_workdir, first=False)
     bin_ = build_oracle("run_nn")
@@ -268,9 +270,14 @@ def run_profile(base, profile, args, res, save):
     # f32 cache must never reuse (or republish) f32 cells (round-5
     # review -- including a cross-eval of a DIFFERENT dtype's kernel)
     cell, eval_cell = _cells(args.dtype)
+    # the workdir's live kernel.opt is dtype-LAST-WRITER; the cross-eval
+    # must score THIS dtype's cycle even if another dtype ran in between,
+    # so each completed cycle stashes its kernel under a dtype-keyed name
+    stash = os.path.join(workdir, f"kernel.opt-{args.dtype}")
     if cell not in r:
         print(f"[{profile}] tpu-{args.dtype} cycle ...", flush=True)
         r[cell] = run_tpu_cycle(workdir, args.rounds, dtype=args.dtype)
+        shutil.copy(os.path.join(workdir, "kernel.opt"), stash)
         save()
     if "ref" not in r:
         print(f"[{profile}] ref-C budget run ({args.ref_budget}s) ...",
@@ -285,10 +292,17 @@ def run_profile(base, profile, args, res, save):
         save()
         print(f"  ref-C: {r['ref']}", flush=True)
     if eval_cell not in r:
+        if not os.path.exists(stash):
+            raise SystemExit(
+                f"[{profile}] cycle cell {cell!r} is cached but its "
+                f"kernel stash {stash} is missing (pre-stash cache or "
+                "interrupted run) -- delete the cycle cell from "
+                f"{args.results} to re-run it")
         print(f"[{profile}] ref-C cross-eval of the TPU kernel.opt ...",
               flush=True)
         r[eval_cell] = run_ref_cross_eval(
-            workdir, os.path.join(base, f"ref_eval-{profile}"))
+            workdir, os.path.join(base, f"ref_eval-{profile}-{args.dtype}"),
+            kernel_path=stash)
         save()
         print(f"  ref-C eval: {r[eval_cell]}", flush=True)
 
@@ -375,6 +389,10 @@ def main():
     if args.append_to and len(args.profiles.split(",")) != 1:
         ap.error("--append-to renders exactly one profile "
                  "(e.g. --profiles easy); got " + args.profiles)
+    if args.append_to and not os.path.exists(args.append_to):
+        ap.error(f"--append-to target {args.append_to} does not exist; "
+                 "render the base document first (a bare section has no "
+                 "context to live in)")
 
     base = os.path.join(REPO, ".scratch", "scale60k")
     os.makedirs(base, exist_ok=True)
@@ -468,24 +486,29 @@ def append_section(args, res, profiles):
             f"same {args.test} test files).",
         ]
     lines.append(end)
-    text = open(args.append_to).read()
+    replace_marked_section(args.append_to, begin, end, lines)
+    print(f"appended 1+{rounds} section to {args.append_to}")
+
+
+def replace_marked_section(path, begin, end, lines):
+    """Append or replace a marker-delimited block; data-identical
+    re-runs are byte-identical (exactly one blank line is kept before
+    any following section)."""
+    text = open(path).read()
     block = "\n".join(lines) + "\n"
     if begin in text:
         if end not in text:
             raise SystemExit(
-                f"{args.append_to}: begin marker {begin!r} present but "
-                f"end marker {end!r} missing -- repair the marker pair "
-                "before re-running (results are cached; no work is lost)")
+                f"{path}: begin marker {begin!r} present but end marker "
+                f"{end!r} missing -- repair the marker pair before "
+                "re-running (results are cached; no work is lost)")
         pre = text[:text.index(begin)]
         post = text[text.index(end) + len(end):].lstrip("\n")
-        # keep exactly one blank line before any following section so a
-        # data-identical re-run is byte-identical
         text = pre + block + ("\n" + post if post else "")
     else:
         text = text.rstrip("\n") + "\n\n" + block
-    with open(args.append_to, "w") as f:
+    with open(path, "w") as f:
         f.write(text)
-    print(f"appended 1+{rounds} section to {args.append_to}")
 
 
 def cycle_table(tpu):

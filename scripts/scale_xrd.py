@@ -42,7 +42,8 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from scale_mnist import (  # noqa: E402
-    cycle_table, run_ref_budget, run_ref_cross_eval, run_tpu_cycle)
+    _cells, cycle_table, replace_marked_section, run_ref_budget,
+    run_ref_cross_eval, run_tpu_cycle)
 
 CONF = """[name] XRD5K
 [type] ANN
@@ -156,6 +157,11 @@ def ensure_corpus(base, groups, per_group):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--rounds", type=int, default=10)
+    ap.add_argument("--dtype", default="f32",
+                    help="[dtype] for the cycle; f32 renders the full "
+                    "document, any other dtype appends a marked section "
+                    "to --out (cells keyed per dtype, ref-C budget "
+                    "shared)")
     ap.add_argument("--groups", type=int, default=230)
     ap.add_argument("--per-group", type=int, default=22)
     ap.add_argument("--ref-budget", type=int, default=900)
@@ -164,6 +170,9 @@ def main():
                     default=os.path.join(REPO, ".scratch", "scale_xrd",
                                          "results.json"))
     args = ap.parse_args()
+    if args.dtype != "f32" and not os.path.exists(args.out):
+        ap.error(f"--dtype {args.dtype} appends a section to {args.out}, "
+                 "which does not exist -- render the f32 document first")
 
     base = os.path.join(REPO, ".scratch", "scale_xrd")
     os.makedirs(base, exist_ok=True)
@@ -195,10 +204,16 @@ def main():
                    os.path.join(workdir, "samples"))
     save()
 
-    if "tpu" not in res:
-        print("tpu-f32 cycle ...", flush=True)
-        res["tpu"] = run_tpu_cycle(workdir, args.rounds,
-                                   conf_writer=write_conf)
+    cell, eval_cell = _cells(args.dtype)
+    # dtype-keyed kernel stash: the workdir's live kernel.opt belongs to
+    # whichever dtype ran LAST; the cross-eval must score this dtype's
+    # cycle (round-5 review)
+    stash = os.path.join(workdir, f"kernel.opt-{args.dtype}")
+    if cell not in res:
+        print(f"tpu-{args.dtype} cycle ...", flush=True)
+        res[cell] = run_tpu_cycle(workdir, args.rounds, dtype=args.dtype,
+                                  conf_writer=write_conf)
+        shutil.copy(os.path.join(workdir, "kernel.opt"), stash)
         save()
     if "ref" not in res:
         print(f"ref-C budget run ({args.ref_budget}s) ...", flush=True)
@@ -211,14 +226,57 @@ def main():
                                     conf_writer=write_conf)
         save()
         print(f"  ref-C: {res['ref']}", flush=True)
-    if "ref_eval" not in res:
+    if eval_cell not in res:
+        if not os.path.exists(stash):
+            raise SystemExit(
+                f"cycle cell {cell!r} is cached but its kernel stash "
+                f"{stash} is missing (pre-stash cache or interrupted "
+                f"run) -- delete the cycle cell from {args.results} to "
+                "re-run it")
         print("ref-C cross-eval of the TPU kernel.opt ...", flush=True)
-        res["ref_eval"] = run_ref_cross_eval(
-            workdir, os.path.join(base, f"ref_eval-{tag}"),
-            conf_writer=write_conf, dirs=("samples",))
+        res[eval_cell] = run_ref_cross_eval(
+            workdir, os.path.join(base, f"ref_eval-{tag}-{args.dtype}"),
+            conf_writer=write_conf, dirs=("samples",), kernel_path=stash)
         save()
-        print(f"  ref-C eval: {res['ref_eval']}", flush=True)
-    render(args, res)
+        print(f"  ref-C eval: {res[eval_cell]}", flush=True)
+    if args.dtype == "f32":
+        render(args, res)
+    else:
+        append_dtype_section(args, res, cell, eval_cell)
+
+
+def append_dtype_section(args, res, cell, eval_cell):
+    """Non-f32 cycles land as a marked section in the f32 document."""
+    n = args.groups * args.per_group
+    tpu, rev = res[cell], res[eval_cell]
+    begin = f"<!-- xrd5k:{args.dtype}:begin -->"
+    end = f"<!-- xrd5k:{args.dtype}:end -->"
+    total = sum(x["t_train"] + x["t_eval"] for x in tpu)
+    lines = [
+        begin,
+        f"## tpu-{args.dtype} cycle at the same scale",
+        "",
+        f"`[dtype] {args.dtype}` on the identical corpus, seed, and",
+        "protocol:",
+        "",
+    ]
+    lines += cycle_table(tpu)
+    lines += [
+        "",
+        f"{len(tpu)} rounds in {total / 60:.1f} min wall.  Checkpoint",
+        "interop: the compiled reference's `run_nn` evaluated this",
+        f"cycle's final `kernel.opt` at **{rev['pass']:.1f}%** PASS",
+        f"({rev['seconds']:.0f} s, same {n} samples) vs",
+        f"{tpu[-1]['pass']:.1f}% from this framework's final-round"
+        " eval." + (
+            "  The checkpoint holds f32 master weights, which ref-C"
+            " forward-evaluates in f64 while this cycle's own eval ran"
+            " in bf16; the gap is eval precision, not checkpoint drift."
+            if args.dtype == "bf16" else ""),
+        end,
+    ]
+    replace_marked_section(args.out, begin, end, lines)
+    print(f"appended tpu-{args.dtype} section to {args.out}")
 
 
 def render(args, res):
