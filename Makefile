@@ -14,7 +14,8 @@
 FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
              tests/test_samples.py tests/test_glibc_random.py \
              tests/test_tools.py tests/test_api_quirks.py \
-             tests/test_native_io.py
+             tests/test_native_io.py tests/test_scale_scripts.py \
+             tests/test_bench_probe.py
 MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_pallas_convergence.py tests/test_cli_e2e.py
 
