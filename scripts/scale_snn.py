@@ -1,0 +1,152 @@
+"""SNN-BP at the 60k flagship scale: the budgeted-watchdog stress case.
+
+The round-4 advisor's crash scenario was precisely this workload: the
+f32 SNN route on a MAX_ITER-saturated corpus, where fixed sample-count
+chunking puts ~4096 x 102399 iterations (minutes of device time) into
+one launch and the ~60 s runtime watchdog kills the worker.  The
+round-5 fix bounds every launch by an IN-KERNEL iteration budget
+(`ops/convergence_pallas.train_epoch_pallas_watchdog`); this artifact
+runs the production CLI's SNN round over the full 60000-sample corpus
+-- billions of BP iterations, >1 h of continuous device time in ~100+
+budgeted launches -- and records that it completes with the documented
+ceiling-bound accuracy semantics (PARITY_MNIST SNN note: per-sample
+SNN-BP convergence saturates at MAX_ITER on non-separable corpora for
+EVERY engine, including ref-C).
+
+Appends a marked section to SCALE_MNIST60K.md.  Usage:
+    python scripts/scale_snn.py [--train 60000] [--rounds 0]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from parity_artifact import make_corpus  # noqa: E402
+from scale_mnist import (  # noqa: E402
+    corpus_complete, replace_marked_section, run_tpu_cycle)
+
+CONF = """[name] scale60k-snn
+[type] SNN
+[init] {init}
+[seed] 10958
+[input] 784
+[hidden] 300
+[output] 10
+[train] BP
+{extra}[sample_dir] ./samples
+[test_dir] ./tests
+"""
+
+MAX_SNN_ITER = 102399  # reference MAX_SNN_ITER (snn.c), mirrored in ops
+
+
+def write_conf(workdir, first, dtype="f32"):
+    extra = f"[dtype] {dtype}\n" if dtype else ""
+    with open(os.path.join(workdir, "nn.conf"), "w") as f:
+        f.write(CONF.format(init="generate" if first else "kernel.opt",
+                            extra=extra))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--train", type=int, default=60000)
+    ap.add_argument("--test", type=int, default=10000)
+    ap.add_argument("--rounds", type=int, default=0,
+                    help="continuation rounds beyond round 0 (each is "
+                    ">1 h of device time at 60k scale)")
+    ap.add_argument("--out", default=os.path.join(REPO,
+                                                  "SCALE_MNIST60K.md"))
+    ap.add_argument("--results",
+                    default=os.path.join(REPO, ".scratch", "scale60k",
+                                         "results_snn.json"))
+    args = ap.parse_args()
+    if not os.path.exists(args.out):
+        ap.error(f"{args.out} does not exist -- render the ANN document "
+                 "first (this section appends to it)")
+
+    base = os.path.join(REPO, ".scratch", "scale60k")
+    # the SNN cycle shares the ANN easy-profile corpus (same files; SNN
+    # reads the same -1/1 one-hot targets, argmax class semantics)
+    workdir = os.path.join(base, "work-easy")
+    if not corpus_complete(workdir, args.train, args.test):
+        print(f"generating {args.train}+{args.test} easy corpus ...",
+              flush=True)
+        os.makedirs(workdir, exist_ok=True)
+        make_corpus(workdir, args.train, args.test, profile="easy")
+
+    res = {}
+    if args.results and os.path.exists(args.results):
+        res = json.load(open(args.results))
+    if "snn" not in res:
+        print(f"tpu-f32 SNN cycle (1+{args.rounds} rounds; round 0 is "
+              ">1 h of device time at 60k scale) ...", flush=True)
+        res["snn"] = run_tpu_cycle(workdir, args.rounds,
+                                   conf_writer=write_conf)
+        os.makedirs(os.path.dirname(args.results), exist_ok=True)
+        tmp = args.results + ".tmp"
+        json.dump(res, open(tmp, "w"))
+        os.replace(tmp, args.results)
+    render(args, res["snn"])
+
+
+def render(args, snn):
+    r0 = snn[0]
+    # the OK/NO stream records FIRST-try verdicts only; MAX_ITER
+    # saturation shows in the iteration total vs the 102399 ceiling
+    mean_iters = r0["bp_iters"] / max(1, args.train)
+    begin = "<!-- snn60k:f32:begin -->"
+    end = "<!-- snn60k:f32:end -->"
+    lines = [
+        begin,
+        "## SNN-BP at 60k: the budgeted-watchdog stress case",
+        "",
+        "The round-4 advisor's crash scenario: the f32 SNN route on a",
+        "MAX_ITER-saturated corpus, where any fixed sample-count launch",
+        "holds minutes of device time and the TPU runtime's ~60 s",
+        "watchdog kills the worker.  Round 5 bounds every launch by an",
+        "in-kernel iteration budget",
+        "(`ops/convergence_pallas.train_epoch_pallas_watchdog`); this is",
+        "that machinery surviving the full reference-scale workload --",
+        "one production-CLI SNN round over the same easy-profile",
+        f"{args.train}-file corpus as the ANN tables above:",
+        "",
+        "| round | OPT% | PASS% | BP iters | mean iters/sample |"
+        " train wall | epoch s | eval s |",
+        "|---|---|---|---|---|---|---|---|",
+    ]
+    for r in snn:
+        p = r["prof"]
+        lines.append(
+            f"| {r['round']} | {r['opt']:.1f} | {r['pass']:.1f} "
+            f"| {r['bp_iters']} | {r['bp_iters'] / max(1, args.train):,.0f} "
+            f"| {r['t_train'] / 60:.1f} min "
+            f"| {p.get('train_epoch', float('nan')):.0f} "
+            f"| {r['t_eval']} |")
+    lines += [
+        "",
+        f"Round 0 executes {r0['bp_iters']:,} BP iterations",
+        f"({mean_iters:,.0f}/sample against the {MAX_SNN_ITER} ceiling)",
+        f"in {r0['prof'].get('train_epoch', float('nan')) / 60:.0f} min of",
+        "continuous device time -- ~two orders of magnitude past the",
+        "watchdog limit for a single launch -- split into",
+        "iteration-budgeted launches that resume on device.  Accuracy",
+        "semantics are the documented SNN scope (PARITY_MNIST.md: on",
+        "non-separable corpora per-sample SNN-BP saturates at MAX_ITER",
+        "for every engine including ref-C; the 2-class SNN2 cycle is the",
+        "convergent regime).  The point of this table is the completed",
+        "run, not the PASS column.",
+        end,
+    ]
+    replace_marked_section(args.out, begin, end, lines)
+    print(f"appended SNN 60k section to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
