@@ -138,6 +138,26 @@ ON_CHIP_SUITE = """
     assert np.asarray(st_d.success).all()
     print("CHECK convergence OK", flush=True)
 
+    # --- budgeted watchdog driver: multi-launch resume on real Mosaic ---
+    # (the production TPU epoch; a tiny forced budget makes every sample
+    # its own launch, exercising the scalar-prefetch resume + sentinel
+    # merge that the 60k artifacts soak -- must match one launch exactly)
+    from hpnn_tpu.ops import convergence as _conv
+    from hpnn_tpu.ops.convergence_pallas import train_epoch_pallas_watchdog
+    _conv._CHUNKER_CACHE.clear()
+    _tr = _conv._get_chunker([w.shape for w in weights], "ANN", False,
+                             route="pallas_budget")
+    _tr.rate = 1.0 / _conv._WATCHDOG_SAFE_S  # budget == 1 iteration
+    w_wd, st_wd = train_epoch_pallas_watchdog(weights, xs, ts, "ANN",
+                                              False, precision="highest")
+    _conv._CHUNKER_CACHE.clear()
+    for f in ("init_err", "first_ok", "n_iter", "final_dep", "success"):
+        assert np.array_equal(np.asarray(getattr(st_wd, f)),
+                              np.asarray(getattr(st_tpu, f))), f
+    for a, b in zip(w_wd, w_tpu):
+        assert np.array_equal(np.asarray(a), b), "multi-launch drift"
+    print("CHECK watchdog OK", flush=True)
+
     # --- [dtype] bf16 compiles and trains on Mosaic (round 3: bf16 used
     # to fail three target constraints -- sub-32-bit scalarization, bf16
     # matmul acc, bf16 vector cmpf; this guards the f32-scalar fixes) ----
@@ -182,7 +202,7 @@ ON_CHIP_SUITE = """
 """
 
 CHECKS = ("backend", "dispatch", "fused_kernels", "convergence",
-          "bf16", "f64_parity")
+          "watchdog", "bf16", "f64_parity")
 
 
 def test_on_chip_suite():
