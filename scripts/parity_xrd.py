@@ -125,6 +125,13 @@ def run_engine(engine: str, workdir: str, rounds: int):
                             capture_output=True, text=True, timeout=14400)
         dt = time.time() - t0
         assert tr.returncode == 0, (engine, rnd, tr.stderr[-2000:])
+        # evaluate the kernel JUST TRAINED: the reference tutorial switches
+        # to the kernel.opt continuation conf before its first eval
+        # (tutorial.bash:102-104); evaluating the round-0 [init] generate
+        # conf would score a freshly generated kernel instead (the same
+        # round-4 fix parity_artifact/scale_mnist carry)
+        with open(os.path.join(workdir, "nn.conf"), "w") as f:
+            f.write(CONF.format(init="kernel.opt", extra=extra))
         rn = subprocess.run(run_cmd, cwd=workdir, env=env,
                             capture_output=True, text=True, timeout=3600)
         assert rn.returncode == 0, (engine, rnd, rn.stderr[-2000:])
@@ -135,6 +142,38 @@ def run_engine(engine: str, workdir: str, rounds: int):
         print(f"  XRD/{engine} round {rnd}: self-test PASS={acc:.1f}% "
               f"({dt:.0f}s train)", flush=True)
     return results
+
+
+# Hand-recorded round-5 measurement (the `.scratch/xrd_prof/profile.py`
+# protocol, run once on a quiet host).  Emitted verbatim into the artifact
+# so a regeneration of the cycle tables cannot silently destroy it; the
+# numbers do NOT regenerate with the cycles -- re-run that protocol to
+# refresh them.
+F64_DECOMPOSITION = """\
+## Why tpu-f64 looked 6% slower than ref-C (round-5 decomposition)
+
+Controlled re-measurement on a quiet host (`.scratch/xrd_prof/profile.py`
+protocol: sequential runs, fixed [seed] 10958, identical corpus, round 0
+only so both engines execute the SAME work):
+
+| engine | round-0 wall | BP iters | iters/s |
+|---|---|---|---|
+| ref-C | 340.7 s | 514051 | 1509 |
+| tpu-f64 (XLA on the same CPU) | 311.3 s (308.6 s epoch) | 514051 | 1666 |
+
+Both engines execute EXACTLY 514051 iterations -- the f64 trajectory
+matches the C reference iteration-for-iteration on the 851-230-230 BPM
+shape -- and the f64 EPOCH is ~10% FASTER, not slower.  A cycle table
+recorded under wall-clock (not epoch) timing charges each tpu-f64 round
+~4-6 s of Python/JAX process startup + program-cache load across 11
+separate CLI invocations, plus background contention on this 1-core host
+when the cycle was recorded; the epoch math itself wins.  Per-iteration
+micro-times (2000-iteration fori_loop chains, median of 3): full BPM
+body 590 us/iter (= 1694 iters/s, so the epoch scan adds ~2% overhead),
+of which the two forward matvecs are 33 us -- the cost is dominated by
+the backward pass + the three momentum-buffer read-modify-writes, the
+same traffic the C loop pays.
+""".splitlines()
 
 
 def main():
@@ -164,7 +203,11 @@ def main():
     # cached cells are only comparable at identical corpus scale (the
     # corpus itself is deterministic: seed 55 + deterministic pdif)
     meta = {"groups": args.groups, "per_group": args.per_group,
-            "rounds": args.rounds}
+            "rounds": args.rounds,
+            # semantic stamp (round-5): every eval incl. round 0 scores the
+            # kernel just trained; caches recorded under the old behavior
+            # scored a FRESH kernel at round 0 and must re-run
+            "eval": "kernel.opt"}
     if all_results.get("_meta") not in (None, meta):
         print(f"cache scale changed ({all_results['_meta']} -> {meta}); "
               "re-running", flush=True)
@@ -257,8 +300,9 @@ def main():
             "viable for this workload: BPM's lr=5e-4 updates quantize "
             "to zero (measured: <1% of weights ever moved).")
     lines.append("")
+    lines += F64_DECOMPOSITION
     with open(args.out, "w") as f:
-        f.write("\n".join(lines))
+        f.write("\n".join(lines) + "\n")
     print(f"wrote {args.out}")
 
 
