@@ -3,16 +3,19 @@
 The reference has exactly two nonlinearities:
 
 * ``ann_act(x) = 2/(1+exp(-x)) - 1`` (``/root/reference/src/ann.c:883-885``),
-  a [-1,1]-scaled sigmoid, mathematically ``tanh(x/2)`` -- we compute it as
-  ``jnp.tanh(x*0.5)`` (one fused XLA op) and verify the identity to fp64
-  precision in tests/test_ops.py.
+  a [-1,1]-scaled sigmoid, mathematically ``tanh(x/2)``.  fp64 (the parity
+  path) evaluates the reference's literal expression -- the tanh form
+  rounds differently on ~53% of inputs; f32/bf16 (throughput modes) use
+  ``jnp.tanh(x*0.5)``, one fused XLA op.  Identity verified in
+  tests/test_ops.py, bit-parity in tests/test_parity_fuzz.py.
 * the SNN softmax head ``o_i = exp(x_i - 1) / (TINY + sum_j exp(x_j - 1))``
   (``/root/reference/src/snn.c:296-334``): a softmax of (x-1) **without**
   max-subtraction and with the denominator seeded at TINY=1e-14
   (``dv=TINY`` before accumulation, ``snn.c:296``;
   TINY from ``/root/reference/include/libhpnn/common.h:79``).  Both quirks
   are preserved for bit-parity; inputs are activation-bounded so the missing
-  max-subtraction cannot overflow.
+  max-subtraction cannot overflow.  fp64 additionally accumulates the
+  denominator in the reference's serial order (see ``snn_softmax``).
 
 ``ann_dact(y) = -0.5*(y*y - 1)`` (``ann.c:886-888``) is the derivative of
 ann_act expressed in terms of the *output* y.
@@ -21,12 +24,22 @@ ann_act expressed in terms of the *output* y.
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 TINY = 1e-14  # /root/reference/include/libhpnn/common.h:79
 
 
 def ann_act(x):
-    """2/(1+e^-x)-1 == tanh(x/2) (ann.c:883-885)."""
+    """2/(1+e^-x)-1 == tanh(x/2) (ann.c:883-885).
+
+    fp64 evaluates the reference's LITERAL expression
+    ``2.0/(1.0+exp(-1.0*x))-1.0``: the tanh form rounds differently on
+    ~53% of inputs (absolute ~1e-17 -- measured), which per-sample
+    convergence training compounds into the parity path's residual
+    weight drift.  f32/bf16 keep the single fused tanh op (throughput
+    modes, statistical parity)."""
+    if jnp.result_type(x) == jnp.float64:
+        return 2.0 / (1.0 + jnp.exp(-1.0 * x)) - 1.0
     return jnp.tanh(x * 0.5)
 
 
@@ -40,7 +53,24 @@ def snn_softmax(x):
 
     Works on the last axis so the same code serves single vectors and
     batches.
+
+    fp64 accumulates the denominator in the reference's exact serial
+    order -- ``dv = TINY; for j: dv += e[j]`` (``snn.c:296-331``, the
+    serial/naive build our parity oracle compiles) -- via a loop-carried
+    ``lax.scan`` XLA cannot reassociate.  A freely-ordered
+    ``TINY + jnp.sum(e)`` differs by ~1 ulp per call, and per-sample
+    convergence training amplifies that into ~1e-15/iteration of weight
+    drift (measured: an 8.6k-iteration SNN-BP run drifted 6.4e-12, past
+    the 5e-12 parity bound, while ANN runs hold ~1e-15 at 180k
+    iterations).  f32/bf16 keep the vectorized sum: they are throughput
+    modes with statistical (not bitwise) parity claims, and a serialized
+    scan would gut the batched TPU eval.
     """
     e = jnp.exp(x - 1.0)
+    if e.dtype == jnp.float64:
+        init = jnp.full(e.shape[:-1], TINY, e.dtype)
+        dv, _ = lax.scan(lambda c, v: (c + v, None), init,
+                         jnp.moveaxis(e, -1, 0))
+        return e / dv[..., None]
     dv = TINY + jnp.sum(e, axis=-1, keepdims=True)
     return e / dv
