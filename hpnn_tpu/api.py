@@ -165,7 +165,9 @@ def _configure_local(path: str) -> NNDef | None:
             return None
         kernel = load_kernel(conf.f_kernel)
         if kernel is None:
-            nn_error(f"FAILED to load kernel {conf.f_kernel}\n")
+            # exact reference string (libhpnn.c:862) -- the filename is
+            # already in ann_load's own "Error opening kernel file:" line
+            nn_error("FAILED to load the NN kernel!\n")
             return None
     # ann_kernel_allocate's memory accounting line (ann.c:197), printed on
     # both the generate and load paths

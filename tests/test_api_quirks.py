@@ -83,3 +83,20 @@ def test_dp_batch_mode(corpus, capsys):  # noqa: F811
     k_tmp = load_kernel("kernel.tmp")
     k_opt = load_kernel("kernel.opt")
     assert not np.allclose(k_tmp.weights[0], k_opt.weights[0])
+
+
+def test_load_failure_prints_reference_error_strings(tmp_path, capsys):
+    """A missing [init] kernel file emits the reference's exact stderr
+    pair: ann_load's "Error opening kernel file: <f>" (ann.c:256) then
+    load_conf's "FAILED to load the NN kernel!" (libhpnn.c:862) -- found
+    by the round-5 malformed-conf sweep (our line used to embed the
+    filename in the second string too)."""
+    conf = tmp_path / "c.conf"
+    conf.write_text(
+        "[name] x\n[type] ANN\n[init] nosuch.opt\n[seed] 1\n[input] 4\n"
+        "[hidden] 3\n[output] 2\n[train] BP\n[sample_dir] .\n[test_dir] .\n")
+    assert configure(str(conf)) is None
+    err = capsys.readouterr().err
+    assert "NN(ERR): Error opening kernel file: nosuch.opt\n" in err
+    assert "NN(ERR): FAILED to load the NN kernel!\n" in err
+    assert "FAILED to load kernel " not in err
