@@ -218,16 +218,18 @@ def _load_ordered(dirpath: str, names: list[str], order: list[int],
         if vec_in is None or vec_out is None:
             events.append((line, None))
             continue
-        if vec_in.shape[0] != n_in or vec_out.shape[0] != n_out:
-            # the reference would read out of bounds here (no dim check,
-            # libhpnn.c:1243); we skip with a diagnostic -- documented
-            # deviation, cannot reproduce undefined behavior
+        if vec_in.shape[0] < n_in or vec_out.shape[0] < n_out:
+            # a section count SMALLER than the kernel dimension makes the
+            # reference copy past its allocation (libhpnn.c:1243, undefined
+            # behavior); we skip with a diagnostic -- documented deviation
             nn_error(f"sample {name} dimension mismatch, skipped!\n")
             events.append((line, None))
             continue
+        # a LARGER count is deterministic in the reference: it copies the
+        # first kernel-dimension values and ignores the rest -- truncate
         events.append((line, len(xs)))
-        xs.append(vec_in)
-        ts.append(vec_out)
+        xs.append(vec_in[:n_in])
+        ts.append(vec_out[:n_out])
     if not xs:
         return events, None, None
     return events, np.stack(xs), np.stack(ts)

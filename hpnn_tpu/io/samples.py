@@ -8,10 +8,19 @@ A sample file is text (``_NN(read,sample)``,
     [output] M
     t1 t2 ... tM
 
-The reference reads all N values from the single line following the header
-(libhpnn.c:1102-1111); we additionally accept values spanning several lines
-(documented deviation -- strictly more permissive, every reference-valid file
-parses identically).  Directory listing skips dotfiles (``libhpnn.c:1194-1198``)
+The reference reads all N values from the SINGLE line following the header
+(libhpnn.c:1102-1111) with raw ``strtod`` semantics -- a token strtod cannot
+convert yields 0.0 and advances one character (``GET_DOUBLE`` +
+``ptr=ptr2+1``, common.h:272-274), so short lines zero-fill and non-numeric
+tokens read as 0.0 rather than failing; the only read failures are
+unopenable/empty files and bad/zero section counts.  This parser replicates
+that behavior exactly (round-5 oracle sweep; the old version was stricter
+AND accepted multi-line values -- both divergences).  One deliberate
+deviation remains at the DRIVER level: a file whose section count is
+smaller than the kernel's dimension makes the reference copy past its
+allocation (libhpnn.c:1243, undefined behavior) -- ``api._load_ordered``
+skips such files with a diagnostic instead.
+Directory listing skips dotfiles (``libhpnn.c:1194-1198``)
 and preserves the OS readdir order, exactly like the reference -- required for
 the end-to-end training parity proven in tests/test_reference_parity.py (see
 list_sample_dir's docstring).
@@ -21,71 +30,156 @@ from __future__ import annotations
 
 import ctypes
 import os
+import re
 
 import numpy as np
 
 from ..utils.nn_log import nn_error
 
+# C strtod's accepted prefix: hex floats first (else the decimal branch
+# would stop at the "0" of "0x1f"), then decimal w/ optional exponent
+# (an incomplete exponent backtracks to the mantissa, like strtod), then
+# inf/infinity and nan(chars), all case-insensitive.
+_STRTOD_RE = re.compile(
+    r"[+-]?(?:"
+    r"0[xX](?:[0-9a-fA-F]+(?:\.[0-9a-fA-F]*)?|\.[0-9a-fA-F]+)"
+    r"(?:[pP][+-]?\d+)?"
+    r"|(?:\d+(?:\.\d*)?|\.\d+)(?:[eE][+-]?\d+)?"
+    r"|[iI][nN][fF](?:[iI][nN][iI][tT][yY])?"
+    r"|[nN][aA][nN](?:\([0-9A-Za-z_]*\))?"
+    r")")
+
+# a section count past any real workload (MNIST 784, XRD 851): the
+# reference ALLOCs the claimed count and error-exits the process on OOM
+# (common.h:161-167); aborting a 60k-file run on one corrupt header is
+# hostile, so counts beyond this are a read failure + skip instead
+# (documented deviation)
+_MAX_COUNT = 1 << 20
+
+
+def _strtod(s: str, pos: int) -> tuple[float, int]:
+    """GET_DOUBLE (common.h:272-274): parse strtod's longest prefix at
+    ``pos``; no conversion -> (0.0, pos) (strtod sets endptr=nptr)."""
+    m = _STRTOD_RE.match(s, pos)
+    if m is None:
+        return 0.0, pos
+    tok = m.group(0)
+    low = tok.lstrip("+-").lower()
+    if low.startswith("nan"):
+        v = float("nan")
+    elif low.startswith("inf"):
+        v = float("-inf") if tok[0] == "-" else float("inf")
+    elif low.startswith("0x"):
+        v = float.fromhex(tok)
+    else:
+        v = float(tok)
+    return v, m.end()
+
+
+def _skip_blank(s: str, pos: int) -> int:
+    """SKIP_BLANK (common.h:250-251): advance over non-ISGRAPH chars,
+    stopping at newline or end."""
+    while pos < len(s):
+        ch = s[pos]
+        if ch == "\n" or (ch.isprintable() and ch != " "):
+            break
+        pos += 1
+    return pos
+
+
+def _section_count(line: str, key: str) -> int | None:
+    """The reference's count parse: ``ptr += len("[input")+1`` (skipping
+    one char after the keyword, whatever it is), SKIP_BLANK, ISDIGIT
+    check, then strtoull's digit prefix (GET_UINT, common.h:269-271) --
+    so ``[input] 4.5`` reads count 4.  None = not a digit."""
+    after = line.split(key, 1)[1][1:]
+    pos = _skip_blank(after, 0)
+    if pos >= len(after) or not after[pos].isdigit():
+        return None
+    j = pos
+    while j < len(after) and after[j].isdigit():
+        j += 1
+    return int(after[pos:j])
+
+
+def _parse_values_line(line: str, n: int) -> np.ndarray:
+    """The reference's value loop (libhpnn.c:1102-1111): n GET_DOUBLEs
+    from ONE line; after each non-final value, skip exactly one char
+    (``ptr=ptr2+1``) then SKIP_BLANK.  A failed conversion yields 0.0
+    and the one-char skip still advances, which is what zero-fills short
+    lines and reads non-numeric tokens as 0.0."""
+    vals = np.empty(n, np.float64)
+    pos = _skip_blank(line, 0)
+    for idx in range(n - 1):
+        if pos >= len(line):
+            # past the end every GET_DOUBLE yields 0.0 -- short-circuit
+            # the remaining iterations (identical result, bounded time)
+            vals[idx:] = 0.0
+            return vals
+        v, end = _strtod(line, pos)
+        vals[idx] = v
+        # ptr=ptr2+1: in C this can only walk into the line's trailing
+        # '\n'/'\0' region (clamped here; identical for getline lines,
+        # which always carry their terminator)
+        pos = _skip_blank(line, min(end + 1, len(line)))
+    vals[n - 1] = _strtod(line, pos)[0]
+    return vals
+
 
 def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
-    """Parse one sample file; (None, None) on failure, as the reference."""
+    """Parse one sample file; (None, None) on failure, as the reference.
+
+    Control flow mirrors _NN(read,sample) (libhpnn.c:1070-1145): the
+    section keyword is matched anywhere in the current line, the values
+    come from the next line (READLINE), and that VALUES line is then
+    itself checked for the ``[output`` keyword in the same iteration.
+    At EOF, getline leaves the buffer unchanged, so a header with no
+    following line (re)parses the header line itself as values.
+    """
     try:
         fp = open(path, "r")
     except OSError:
         return None, None
-    vec_in: np.ndarray | None = None
-    vec_out: np.ndarray | None = None
     with fp:
         lines = fp.readlines()
+    if not lines:
+        # the reference's line==NULL check (libhpnn.c:1083-1087) is dead
+        # under glibc -- getline allocates even at immediate EOF, so an
+        # empty file silently yields (NULL, NULL) with no message
+        return None, None
+    vec_in: np.ndarray | None = None
+    vec_out: np.ndarray | None = None
     i = 0
-    while i < len(lines):
-        line = lines[i]
+    line = lines[0]
+    while True:
         if "[input" in line:
-            n, vals, i = _read_vector(lines, i, "[input", path, "input")
-            if vals is None:
+            n = _section_count(line, "[input")
+            if n is None or n == 0 or n > _MAX_COUNT:
+                nn_error(f"sample {path} input read failed!\n")
                 return None, None
-            vec_in = vals
-            continue
+            if i + 1 < len(lines):
+                i += 1
+                line = lines[i]
+            vec_in = _parse_values_line(line, n)
         if "[output" in line:
-            n, vals, i = _read_vector(lines, i, "[output", path, "output")
-            if vals is None:
+            n = _section_count(line, "[output")
+            if n is None or n > _MAX_COUNT:
+                nn_error(f"sample {path} output read failed!\n")
                 return None, None
-            vec_out = vals
-            continue
+            if n == 0:
+                # the reference prints "input read failed" for a zero
+                # OUTPUT count (copy-paste quirk, libhpnn.c:1122-1125)
+                nn_error(f"sample {path} input read failed!\n")
+                return None, None
+            if i + 1 < len(lines):
+                i += 1
+                line = lines[i]
+            vec_out = _parse_values_line(line, n)
         i += 1
+        if i >= len(lines):
+            break
+        line = lines[i]
     return vec_in, vec_out
-
-
-def _read_vector(lines, i, key, path, what):
-    rest = lines[i].split(key, 1)[1]
-    if rest[:1] == "]":
-        rest = rest[1:]
-    rest = rest.strip()
-    if not rest or not rest.split()[0].isdigit():
-        nn_error(f"sample {path} {what} read failed!\n")
-        return None, None, i
-    n = int(rest.split()[0])
-    if n == 0:
-        # the reference prints "input read failed" even for the output count
-        # (copy-paste quirk at libhpnn.c:1122-1125) -- grammar is API, keep it
-        nn_error(f"sample {path} input read failed!\n")
-        return None, None, i
-    vals: list[float] = []
-    i += 1
-    while len(vals) < n and i < len(lines):
-        for tok in lines[i].split():
-            try:
-                vals.append(float(tok))
-            except ValueError:
-                nn_error(f"sample {path} {what} read failed!\n")
-                return None, None, i
-            if len(vals) == n:
-                break
-        i += 1
-    if len(vals) < n:
-        nn_error(f"sample {path} {what} read failed!\n")
-        return None, None, i
-    return n, np.asarray(vals, dtype=np.float64), i
 
 
 # --- native fast path -------------------------------------------------------

@@ -8,15 +8,17 @@
  * well-formed shape
  *
  *     [input] N
- *     v1 ... vN            (may span lines)
+ *     v1 ... vN            (one line, like the reference reads it)
  *     [output] M
  *     t1 ... tM
  *
  * and DECLINES (rc -2) on anything unusual -- missing/zero counts,
- * over-capacity vectors, tokens strtod cannot fully consume, short data --
- * so the Python parser re-reads those files and keeps its reference-exact
- * diagnostics and edge-case behavior.  A decline is always correct, never
- * an error.
+ * over-capacity vectors, tokens strtod cannot fully consume, fewer than
+ * N values on the single line after the header (the reference reads
+ * values from ONE line, zero-filling via strtod semantics -- only the
+ * Python parser replicates that) -- so the Python parser re-reads those
+ * files and keeps its reference-exact quirk behavior.  A decline is
+ * always correct, never an error.
  *
  * No CPython dependency: plain C, called through ctypes.
  */
@@ -30,14 +32,18 @@
 #define RC_FALLBACK (-2)
 
 /* parse "<count>" after a "[input" / "[output" keyword; returns count or
- * -1 unless the whole first token is digits (the Python parser requires
- * token.isdigit() -- "4.5" or "2abc" must DECLINE, not truncate) */
+ * -1 unless the whole first token is digits.  The reference (and the
+ * Python parser) skip ONE char after the keyword UNCONDITIONALLY
+ * (ptr += len("[input")+1), so "[input42" reads count 2 there -- mirror
+ * that exactly, and still require a full-digit token ("4.5"/"2abc"
+ * DECLINE to the Python parser, which truncates like strtoull). */
 static long parse_count(const char *after)
 {
     const char *p;
     char *end;
     long n;
-    if (*after == ']') after++;
+    if (*after == '\0') return -1;
+    after++; /* skip one char after the keyword, whatever it is */
     while (*after && isspace((unsigned char)*after)) after++;
     if (!isdigit((unsigned char)*after)) return -1;
     for (p = after; *p && !isspace((unsigned char)*p); p++)
@@ -47,39 +53,49 @@ static long parse_count(const char *after)
     return n;
 }
 
-/* read `n` doubles starting at `pos` (rest of the header's line),
- * continuing across lines; every token must be fully consumed by strtod.
- * Returns 0 on success, RC_FALLBACK otherwise. */
+/* read `n` doubles from the ONE line following the header (the
+ * reference's READLINE + n GET_DOUBLEs, libhpnn.c:1102-1111); every
+ * token must be fully consumed by strtod and all n must be present on
+ * that line.  Returns 0 on success, RC_FALLBACK otherwise. */
 static int read_values(FILE *fp, char **line, size_t *cap, double *buf,
                        long n)
 {
     long got = 0;
+    ssize_t len = getline(line, cap, fp);
+    char *p;
+    if (len < 0) return RC_FALLBACK;
+    p = *line;
     while (got < n) {
-        ssize_t len = getline(line, cap, fp);
-        if (len < 0) return RC_FALLBACK;
-        char *p = *line;
-        while (got < n) {
-            while (*p && isspace((unsigned char)*p)) p++;
-            if (*p == '\0') break; /* next line */
+        while (*p && isspace((unsigned char)*p)) p++;
+        if (*p == '\0') return RC_FALLBACK; /* short line: Python path */
+        {
             char *tok_end = p;
+            char saved, *end;
+            double v;
             while (*tok_end && !isspace((unsigned char)*tok_end)) tok_end++;
-            char saved = *tok_end;
+            saved = *tok_end;
             *tok_end = '\0';
-            /* strtod accepts hex floats and nan(chars) that Python
-             * float() rejects -- decline those tokens outright */
+            /* strtod accepts hex floats and nan(chars) whose exact
+             * semantics live in the Python parser -- decline those */
             for (char *q = p; q < tok_end; q++) {
                 if (*q == 'x' || *q == 'X' || *q == '(') {
                     *tok_end = saved;
                     return RC_FALLBACK;
                 }
             }
-            char *end;
-            double v = strtod(p, &end);
+            v = strtod(p, &end);
             if (end != tok_end || end == p) return RC_FALLBACK;
             *tok_end = saved;
             buf[got++] = v;
             p = tok_end;
         }
+    }
+    /* the reference re-checks the VALUES line for section keywords in
+     * the same iteration -- a '[' anywhere in the unconsumed remainder
+     * could be one; decline so the Python parser handles the flow */
+    while (*p) {
+        if (*p == '[') return RC_FALLBACK;
+        p++;
     }
     return RC_OK;
 }

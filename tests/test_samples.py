@@ -21,12 +21,39 @@ def test_read_sample(tmp_path):
     np.testing.assert_allclose(vout, [1.0, -1.0])
 
 
-def test_read_sample_multiline_values(tmp_path):
+def test_read_sample_values_come_from_one_line(tmp_path):
+    """The reference reads ALL n values from the single line after the
+    header (libhpnn.c:1102-1111); strtod-at-line-end zero-fills the rest.
+    Round-5 oracle sweep: the old multi-line continuation was a real
+    divergence (the reference trains [1,2,0,0] here, not [1,2,3,4])."""
     p = tmp_path / "s2"
     p.write_text("[input] 4\n1.0 2.0\n3.0 4.0\n[output] 1\n1.0\n")
     vin, vout = read_sample(str(p))
-    np.testing.assert_allclose(vin, [1, 2, 3, 4])
+    np.testing.assert_allclose(vin, [1, 2, 0, 0])
     np.testing.assert_allclose(vout, [1])
+
+
+def test_read_sample_strtod_quirks(tmp_path):
+    """GET_DOUBLE is raw strtod: a non-numeric token reads as 0.0 (the
+    pointer advances one char per iteration), short lines zero-fill, and
+    a count like '4.5' parses as 4 (ISDIGIT check + strtoull prefix,
+    GET_UINT common.h:269-271).  All verified against the compiled
+    reference in the round-5 bad-sample sweep."""
+    p = tmp_path / "q1"
+    p.write_text("[input] 3\n1 x 3\n[output] 2\n1.0 -1.0\n")
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 0.0, 3.0])
+    np.testing.assert_allclose(vout, [1.0, -1.0])
+
+    p = tmp_path / "q2"
+    p.write_text("[input] 3\n1 2\n[output] 2\n1.0 -1.0\n")
+    vin, _ = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 2.0, 0.0])
+
+    p = tmp_path / "q3"
+    p.write_text("[input] 4.5\n1 2 3 4 5\n[output] 2\n1.0 -1.0\n")
+    vin, _ = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 2.0, 3.0, 4.0])
 
 
 def test_read_sample_missing_file():
@@ -47,3 +74,31 @@ def test_list_dir_skips_dotfiles(tmp_path):
     assert sorted(list_sample_dir(str(tmp_path))) == ["a", "b"]
 
 
+def test_read_sample_reference_flow_quirks(tmp_path):
+    """Round-5 review cases, each verified to mirror the reference flow:
+    a '[output' keyword ON the input-values line is honored in the same
+    iteration (libhpnn.c do-while structure), '[input42' skips one char
+    after the keyword so the count is 2 (ptr += 7), and an absurd count
+    fails gracefully instead of allocating (deviation: the reference
+    ALLOC-exits the process there)."""
+    from hpnn_tpu.io.samples import read_sample_fast
+
+    p = tmp_path / "embed"
+    p.write_text("[output] 1\n5\n[input] 2\n1 2 [output] 3\n7 8 9\n")
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 2.0])
+    np.testing.assert_allclose(vout, [7.0, 8.0, 9.0])
+    fin, fout = read_sample_fast(str(p), 50, 50)
+    np.testing.assert_array_equal(vin, fin)
+    np.testing.assert_array_equal(vout, fout)
+
+    p = tmp_path / "key42"
+    p.write_text("[input42\n7 8 9\n[output] 2\n1 -1\n")
+    vin, _ = read_sample(str(p))
+    np.testing.assert_allclose(vin, [7.0, 8.0])
+    fin, _ = read_sample_fast(str(p), 50, 50)
+    np.testing.assert_array_equal(vin, fin)
+
+    p = tmp_path / "huge"
+    p.write_text("[input] 99999999999999\n1 2\n[output] 2\n1 -1\n")
+    assert read_sample(str(p)) == (None, None)
