@@ -57,10 +57,21 @@ _STRTOD_RE = re.compile(
 _MAX_COUNT = 1 << 20
 
 
+_C_SPACE = " \t\n\r\v\f"  # C isspace set (C locale)
+
+
 def _strtod(s: str, pos: int) -> tuple[float, int]:
-    """GET_DOUBLE (common.h:272-274): parse strtod's longest prefix at
-    ``pos``; no conversion -> (0.0, pos) (strtod sets endptr=nptr)."""
-    m = _STRTOD_RE.match(s, pos)
+    """GET_DOUBLE (common.h:272-274): strtod skips leading C whitespace
+    (which can include a newline) then parses its longest prefix at
+    ``pos``; no conversion -> (0.0, pos) (strtod sets endptr=nptr).
+    A NUL in the simulated buffer is never crossed -- it terminates the
+    C string strtod sees."""
+    p = pos
+    while p < len(s) and s[p] in _C_SPACE:
+        p += 1
+    if p < len(s) and s[p] == "\0":
+        return 0.0, pos
+    m = _STRTOD_RE.match(s, p)
     if m is None:
         return 0.0, pos
     tok = m.group(0)
@@ -78,10 +89,12 @@ def _strtod(s: str, pos: int) -> tuple[float, int]:
 
 def _skip_blank(s: str, pos: int) -> int:
     """SKIP_BLANK (common.h:250-251): advance over non-ISGRAPH chars,
-    stopping at newline or end."""
+    stopping at newline, NUL, or end.  ISGRAPH is the C-locale set
+    (0x21-0x7E) -- bytes >0x7E are skipped as blanks, exactly like the
+    reference compiled under the C locale."""
     while pos < len(s):
         ch = s[pos]
-        if ch == "\n" or (ch.isprintable() and ch != " "):
+        if ch == "\n" or ch == "\0" or 0x21 <= ord(ch) <= 0x7E:
             break
         pos += 1
     return pos
@@ -102,27 +115,34 @@ def _section_count(line: str, key: str) -> int | None:
     return int(after[pos:j])
 
 
-def _parse_values_line(line: str, n: int) -> np.ndarray:
+def _parse_values_line(buf: str, n: int) -> np.ndarray:
     """The reference's value loop (libhpnn.c:1102-1111): n GET_DOUBLEs
     from ONE line; after each non-final value, skip exactly one char
     (``ptr=ptr2+1``) then SKIP_BLANK.  A failed conversion yields 0.0
     and the one-char skip still advances, which is what zero-fills short
-    lines and reads non-numeric tokens as 0.0."""
+    lines and reads non-numeric tokens as 0.0.
+
+    ``buf`` is the SIMULATED getline buffer, not just the current line:
+    the one-char skip steps PAST the line's NUL terminator into stale
+    bytes left by the file's earlier (longer) lines, and strtod can then
+    parse those -- e.g. a '[input] 5' header overwritten by a '1 2 3'
+    values line leaves ' 5' at offsets 7-8, and the reference reads
+    [1,2,3,0,5] (verified against the compiled oracle).  Past the end of
+    every previously written byte the C buffer holds malloc garbage;
+    that region reads as zeros here (documented residual -- it is not
+    reproducible even between builds of the reference)."""
     vals = np.empty(n, np.float64)
-    pos = _skip_blank(line, 0)
+    pos = _skip_blank(buf, 0)
     for idx in range(n - 1):
-        if pos >= len(line):
-            # past the end every GET_DOUBLE yields 0.0 -- short-circuit
-            # the remaining iterations (identical result, bounded time)
+        if pos >= len(buf):
+            # beyond the simulated buffer every GET_DOUBLE yields 0.0 --
+            # short-circuit the remaining iterations (bounded time)
             vals[idx:] = 0.0
             return vals
-        v, end = _strtod(line, pos)
+        v, end = _strtod(buf, pos)
         vals[idx] = v
-        # ptr=ptr2+1: in C this can only walk into the line's trailing
-        # '\n'/'\0' region (clamped here; identical for getline lines,
-        # which always carry their terminator)
-        pos = _skip_blank(line, min(end + 1, len(line)))
-    vals[n - 1] = _strtod(line, pos)[0]
+        pos = _skip_blank(buf, min(end + 1, len(buf)))
+    vals[n - 1] = _strtod(buf, pos)[0] if pos < len(buf) else 0.0
     return vals
 
 
@@ -135,9 +155,16 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
     itself checked for the ``[output`` keyword in the same iteration.
     At EOF, getline leaves the buffer unchanged, so a header with no
     following line (re)parses the header line itself as values.
+
+    The getline buffer is SIMULATED (``buf``): each new line overwrites
+    the front, leaving earlier lines' tail bytes (+ the NUL terminator
+    as an explicit char) reachable to the value loop's one-char skip --
+    see _parse_values_line.  Files are decoded latin-1 so every byte
+    maps to one char, like the byte-oriented reference (a corrupt byte
+    reads as junk that strtod turns into 0.0, never a decode error).
     """
     try:
-        fp = open(path, "r")
+        fp = open(path, "r", encoding="latin-1")
     except OSError:
         return None, None
     with fp:
@@ -151,6 +178,14 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
     vec_out: np.ndarray | None = None
     i = 0
     line = lines[0]
+    buf = line + "\0"
+
+    def _readline_into(new: str) -> str:
+        nonlocal buf
+        tail = buf[len(new) + 1:]
+        buf = new + "\0" + tail
+        return new
+
     while True:
         if "[input" in line:
             n = _section_count(line, "[input")
@@ -159,8 +194,8 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
                 return None, None
             if i + 1 < len(lines):
                 i += 1
-                line = lines[i]
-            vec_in = _parse_values_line(line, n)
+                line = _readline_into(lines[i])
+            vec_in = _parse_values_line(buf, n)
         if "[output" in line:
             n = _section_count(line, "[output")
             if n is None or n > _MAX_COUNT:
@@ -173,12 +208,12 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
                 return None, None
             if i + 1 < len(lines):
                 i += 1
-                line = lines[i]
-            vec_out = _parse_values_line(line, n)
+                line = _readline_into(lines[i])
+            vec_out = _parse_values_line(buf, n)
         i += 1
         if i >= len(lines):
             break
-        line = lines[i]
+        line = _readline_into(lines[i])
     return vec_in, vec_out
 
 

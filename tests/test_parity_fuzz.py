@@ -38,7 +38,9 @@ import pytest
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-from test_reference_parity import _nn_lines, _oracle, _run_mine, _run_ref
+from test_reference_parity import (_nn_lines, _oracle, _run_mine,
+                                   _run_mine_proc, _run_ref,
+                                   _run_ref_proc)
 
 from hpnn_tpu.io.kernel_io import load_kernel
 
@@ -104,3 +106,42 @@ def test_fuzz_case_parity(tmp_path, kind, train, n_in, hiddens, n_out,
     werr = max(float(np.abs(a - b).max())
                for a, b in zip(ref_k.weights, my_k.weights))
     assert werr < tol, (werr, tol, iters)
+
+
+# malformed-conf error paths: the reference prints its NN(ERR) diagnostics
+# to UNBUFFERED stderr and then typically segfaults dereferencing the NULL
+# conf (train_nn.c has no NULL check -- known UB); its BUFFERED stdout
+# drowns in the crash.  So the comparable surface is the stderr stream:
+# same lines, same order, and a nonzero exit on both sides (ours clean).
+CONF_CASES = {
+    "missing_type": "[name] t\n[init] generate\n[seed] 1\n[input] 3\n"
+                    "[hidden] 2\n[output] 2\n[train] BP\n"
+                    "[sample_dir] ./samples\n[test_dir] ./samples\n",
+    "zero_input": "[name] t\n[type] ANN\n[init] generate\n[seed] 1\n"
+                  "[input] 0\n[hidden] 2\n[output] 2\n[train] BP\n"
+                  "[sample_dir] ./samples\n[test_dir] ./samples\n",
+    "no_output": "[name] t\n[type] ANN\n[init] generate\n[seed] 1\n"
+                 "[input] 3\n[hidden] 2\n[train] BP\n"
+                 "[sample_dir] ./samples\n[test_dir] ./samples\n",
+    "bad_init_file": "[name] t\n[type] ANN\n[init] nosuch.opt\n[seed] 1\n"
+                     "[input] 3\n[hidden] 2\n[output] 2\n[train] BP\n"
+                     "[sample_dir] ./samples\n[test_dir] ./samples\n",
+    "negative_seed": "[name] t\n[type] ANN\n[init] generate\n[seed] -5\n"
+                     "[input] 3\n[hidden] 2\n[output] 2\n[train] BP\n"
+                     "[sample_dir] ./samples\n[test_dir] ./samples\n",
+}
+
+
+@pytest.mark.parametrize("case", sorted(CONF_CASES))
+def test_malformed_conf_stderr_parity(tmp_path, case):
+    (tmp_path / "samples").mkdir()
+    (tmp_path / "samples" / "s0").write_text(
+        "[input] 3\n1 2 3\n[output] 2\n1.0 -1.0\n")
+    (tmp_path / "nn.conf").write_text(CONF_CASES[case])
+    ref = _run_ref_proc(_oracle("train_nn"), ["-v", "-v", "nn.conf"],
+                        tmp_path)
+    mine = _run_mine_proc("train_nn", ["-v", "-v", "nn.conf"], tmp_path)
+    err = lambda r: [l for l in r.stderr.splitlines()
+                     if l.startswith("NN(ERR)")]
+    assert err(ref) == err(mine)
+    assert (ref.returncode != 0) == (mine.returncode != 0)

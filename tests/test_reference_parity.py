@@ -76,17 +76,26 @@ def _corpus(tmp_path, n=4, n_in=6, n_hid=4, n_out=3, kind="ANN",
     return conf
 
 
-def _run_ref(binary, args, cwd):
+def _run_ref_proc(binary, args, cwd):
+    """Oracle invocation; returns the CompletedProcess (stderr + rc
+    matter for the error-path parity tests in test_parity_fuzz)."""
     return subprocess.run([binary, *args], cwd=cwd, capture_output=True,
-                          text=True, timeout=600).stdout
+                          text=True, timeout=600)
 
 
-def _run_mine(app, args, cwd):
+def _run_mine_proc(app, args, cwd):
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "apps", f"{app}.py"), *args],
-        cwd=cwd, capture_output=True, text=True, timeout=600,
-        env=env).stdout
+        cwd=cwd, capture_output=True, text=True, timeout=600, env=env)
+
+
+def _run_ref(binary, args, cwd):
+    return _run_ref_proc(binary, args, cwd).stdout
+
+
+def _run_mine(app, args, cwd):
+    return _run_mine_proc(app, args, cwd).stdout
 
 
 def _nn_lines(text, what="NN"):

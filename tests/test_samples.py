@@ -102,3 +102,30 @@ def test_read_sample_reference_flow_quirks(tmp_path):
     p = tmp_path / "huge"
     p.write_text("[input] 99999999999999\n1 2\n[output] 2\n1 -1\n")
     assert read_sample(str(p)) == (None, None)
+
+
+def test_read_sample_stale_getline_buffer(tmp_path):
+    """ptr=ptr2+1 steps past the values line's NUL into bytes left by the
+    file's earlier (longer) lines -- the reference deterministically
+    parses them ('[input] 5' overwritten by '1 2 3' leaves ' 5' at
+    offsets 7-8 -> [1,2,3,0,5], verified against the compiled oracle).
+    The simulated getline buffer reproduces it."""
+    p = tmp_path / "stale"
+    p.write_text("[input] 5\n1 2 3\n[output] 2\n1.0 -1.0\n")
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 2.0, 3.0, 0.0, 5.0])
+    np.testing.assert_allclose(vout, [1.0, -1.0])
+
+
+def test_read_sample_corrupt_byte_is_not_fatal(tmp_path):
+    """A non-UTF-8 byte must parse like the byte-oriented reference, not
+    raise UnicodeDecodeError (round-5 review: one corrupt file must never
+    abort a 60k-file run).  0xFF is NOT ISGRAPH in the C locale, so
+    SKIP_BLANK treats it as a blank and the next value is the '3' --
+    [1,3,0], byte-matched against the compiled oracle end-to-end (unlike
+    ASCII junk like 'x', which IS graphic and reads as 0.0)."""
+    p = tmp_path / "corrupt"
+    p.write_bytes(b"[input] 3\n1 \xff 3\n[output] 2\n1.0 -1.0\n")
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 3.0, 0.0])
+    np.testing.assert_allclose(vout, [1.0, -1.0])
