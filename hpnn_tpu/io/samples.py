@@ -146,6 +146,53 @@ def _parse_values_line(buf: str, n: int) -> np.ndarray:
     return vals
 
 
+class _GetlineSim:
+    """The reference's READLINE/getline state: ONE growing buffer reused
+    for every line of a file.
+
+    * ``line`` is the C string the scanners see: the new line's bytes up
+      to (and excluding) the terminator -- keyword searches must use
+      :meth:`cline`, which additionally stops at any EMBEDDED NUL byte
+      from the file, like strstr would.
+    * ``buf`` is the full simulated buffer: the new line + an explicit
+      NUL + the stale tail of earlier, longer lines -- the strtod value
+      loops can walk into it (see _parse_values_line).
+    * a read at EOF FAILS, leaving line and buf unchanged and setting
+      ``feof``.  glibc sets the stream's EOF flag already on the read
+      that RETURNS a final line with no trailing newline (verified with
+      a compiled probe), so the reference's ``do{{scan;READLINE}}
+      while(!feof)`` loops never scan such a line -- replicated here.
+    * ``rewind`` clears feof but keeps the buffer (ann_load re-scans the
+      file per section phase with the same buffer).
+    """
+
+    def __init__(self, lines: list[str]):
+        self.lines = lines
+        self.i = -1
+        self.line = ""
+        self.buf = ""
+        self.feof = False
+
+    def readline(self) -> None:
+        if self.i + 1 < len(self.lines):
+            self.i += 1
+            new = self.lines[self.i]
+            self.buf = new + "\0" + self.buf[len(new) + 1:]
+            self.line = new
+            if self.i == len(self.lines) - 1 and not new.endswith("\n"):
+                self.feof = True
+        else:
+            self.feof = True
+
+    def cline(self) -> str:
+        """The C string strstr sees: up to the first embedded NUL."""
+        return self.line.split("\0", 1)[0]
+
+    def rewind(self) -> None:
+        self.i = -1
+        self.feof = False
+
+
 def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
     """Parse one sample file; (None, None) on failure, as the reference.
 
@@ -154,14 +201,12 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
     come from the next line (READLINE), and that VALUES line is then
     itself checked for the ``[output`` keyword in the same iteration.
     At EOF, getline leaves the buffer unchanged, so a header with no
-    following line (re)parses the header line itself as values.
-
-    The getline buffer is SIMULATED (``buf``): each new line overwrites
-    the front, leaving earlier lines' tail bytes (+ the NUL terminator
-    as an explicit char) reachable to the value loop's one-char skip --
-    see _parse_values_line.  Files are decoded latin-1 so every byte
-    maps to one char, like the byte-oriented reference (a corrupt byte
-    reads as junk that strtod turns into 0.0, never a decode error).
+    following line (re)parses the header line itself as values; a FINAL
+    header line without a trailing newline is never scanned at all (the
+    glibc feof timing, see _GetlineSim).  Files are decoded latin-1 so
+    every byte maps to one char, like the byte-oriented reference (a
+    corrupt byte reads as junk that strtod turns into 0.0, never a
+    decode error).
     """
     try:
         fp = open(path, "r", encoding="latin-1")
@@ -176,28 +221,20 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
         return None, None
     vec_in: np.ndarray | None = None
     vec_out: np.ndarray | None = None
-    i = 0
-    line = lines[0]
-    buf = line + "\0"
-
-    def _readline_into(new: str) -> str:
-        nonlocal buf
-        tail = buf[len(new) + 1:]
-        buf = new + "\0" + tail
-        return new
-
+    sim = _GetlineSim(lines)
+    sim.readline()
     while True:
-        if "[input" in line:
-            n = _section_count(line, "[input")
+        cl = sim.cline()
+        if "[input" in cl:
+            n = _section_count(cl, "[input")
             if n is None or n == 0 or n > _MAX_COUNT:
                 nn_error(f"sample {path} input read failed!\n")
                 return None, None
-            if i + 1 < len(lines):
-                i += 1
-                line = _readline_into(lines[i])
-            vec_in = _parse_values_line(buf, n)
-        if "[output" in line:
-            n = _section_count(line, "[output")
+            sim.readline()
+            vec_in = _parse_values_line(sim.buf, n)
+            cl = sim.cline()
+        if "[output" in cl:
+            n = _section_count(cl, "[output")
             if n is None or n > _MAX_COUNT:
                 nn_error(f"sample {path} output read failed!\n")
                 return None, None
@@ -206,14 +243,11 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
                 # OUTPUT count (copy-paste quirk, libhpnn.c:1122-1125)
                 nn_error(f"sample {path} input read failed!\n")
                 return None, None
-            if i + 1 < len(lines):
-                i += 1
-                line = _readline_into(lines[i])
-            vec_out = _parse_values_line(buf, n)
-        i += 1
-        if i >= len(lines):
+            sim.readline()
+            vec_out = _parse_values_line(sim.buf, n)
+        sim.readline()
+        if sim.feof:
             break
-        line = _readline_into(lines[i])
     return vec_in, vec_out
 
 

@@ -103,3 +103,69 @@ def test_validate():
     assert k.validate()
     k.weights[1] = np.zeros((2, 99))
     assert not k.validate()
+
+
+def test_load_kernel_strtod_leniency(tmp_path):
+    """ann_load's weight loop is raw GET_DOUBLE (ann.c:437-445): short
+    weight lines zero-fill, junk tokens read 0.0, and a neuron may
+    declare FEWER inputs than the layer width (its values land at the
+    per-neuron stride in the calloc'd flat array).  A file with no
+    [output] section at all loads with a ZERO output layer.  All
+    byte-verified against the compiled oracle end-to-end (round-5
+    kernel-file sweep)."""
+    from hpnn_tpu.io.kernel_io import load_kernel
+
+    base = ("[name] t\n[param] 3 2 2\n[input] 3\n"
+            "[hidden 1] 2\n"
+            "[neuron 1] 3\n 0.1 0.2\n"          # short: zero-fills
+            "[neuron 2] 3\n 0.1 zz 0.1\n"       # junk: one 0.0 PER CHAR
+            "[output] 2\n"
+            "[neuron 1] 2\n 0.3 0.1\n"
+            "[neuron 2] 2\n -0.1 0.2\n")
+    p = tmp_path / "k1.opt"
+    p.write_text(base)
+    k = load_kernel(str(p))
+    assert k is not None
+    np.testing.assert_allclose(k.weights[0][0], [0.1, 0.2, 0.0])
+    # 'zz' costs one failed-conversion iteration PER CHAR (ptr=ptr2+1
+    # advances a single char when strtod converts nothing), so the third
+    # value never reaches the trailing 0.1 -- oracle-verified
+    np.testing.assert_allclose(k.weights[0][1], [0.1, 0.0, 0.0])
+
+    # neuron declaring 2 of 3 inputs: per-neuron stride layout
+    p2 = tmp_path / "k2.opt"
+    p2.write_text(base.replace("[neuron 1] 3\n 0.1 0.2\n",
+                               "[neuron 1] 2\n 0.1 0.2\n"))
+    k2 = load_kernel(str(p2))
+    assert k2 is not None
+    flat = k2.weights[0].reshape(-1)
+    np.testing.assert_allclose(flat[:2], [0.1, 0.2])
+
+    # missing [output] section: zero output layer, load SUCCEEDS
+    p3 = tmp_path / "k3.opt"
+    p3.write_text(base[:base.index("[output]")])
+    k3 = load_kernel(str(p3))
+    assert k3 is not None
+    np.testing.assert_array_equal(k3.weights[1], np.zeros((2, 2)))
+
+
+def test_load_kernel_reference_error_messages(tmp_path, capsys):
+    """The error strings and their '->' location lines are the
+    reference's exact bytes (ann.c:400-434) -- pinned by the round-5
+    stderr-lens sweep."""
+    from hpnn_tpu.io.kernel_io import load_kernel
+
+    p = tmp_path / "k.opt"
+    p.write_text("[name] t\n[param] 3 2 2\n[input] 3\n"
+                 "[hidden 1] 2\n[neuron 1] 3\n 0.1 0.2 0.3\n")
+    assert load_kernel(str(p)) is None
+    err = capsys.readouterr().err
+    assert "NN(ERR): kernel read: neuron definition missing!\n" in err
+    assert "NN(ERR): -> hidden layer 1, neuron 2\n" in err
+
+    p.write_text("[name] t\n[param] 3 2 2\n[input] 3\n"
+                 "[hidden 1] 2\n[neuron 1] 4\n 1 2 3 4\n")
+    assert load_kernel(str(p)) is None
+    err = capsys.readouterr().err
+    assert "NN(ERR): kernel read: neuron inconsistent input number!\n" in err
+    assert "NN(ERR): -> n_input=4 (expected 3)!\n" in err
