@@ -10,6 +10,15 @@ see tests/test_parity_fuzz.py for the pinned regression cases and the
 model's derivation).  Round-5 provenance: this sweep caught the two f64
 ordering divergences fixed in ops/activations.py.
 
+Expected FAIL rate is NOT zero: on a small fraction of SNN corpora
+(measured 3/192; all SNN, none ANN) the exp residual crosses a visible
+threshold -- either the last printed decimal of a final= value, or,
+when a trajectory hovers near the dEp<=1e-6 stop, a different N_ITER,
+after which the weight histories legitimately diverge macroscopically.
+Before treating a FAIL as a bug, check the stream diff: identical
+init= with diverging N_ITER/final tail = the documented residual;
+a diverging init= or missing/extra lines = a real defect.
+
 Usage: python scripts/fuzz_parity.py [n_cases]   (default 12)
 """
 import os
