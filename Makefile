@@ -18,9 +18,16 @@ FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
              tests/test_bench_probe.py
 MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_pallas_convergence.py tests/test_cli_e2e.py
+SERVE_TESTS = tests/test_serve.py
 
 check:
-	python -m pytest $(FAST_TESTS) $(MESH_TESTS) -q
+	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) -q
+
+# serving tier: registry/batcher/metrics units + the end-to-end HTTP run
+# (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
+# scripts/serve_bench.py's client pool)
+serve-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(SERVE_TESTS) -q
 
 check-all:
 	python -m pytest tests/ -q
@@ -32,4 +39,8 @@ native:
 bench:
 	python bench.py
 
-.PHONY: check check-all native bench
+serve-bench:
+	python scripts/serve_bench.py --conf nn.conf --requests 256 \
+	    --rows 3,5,7 --concurrency 16 --out SERVE_BENCH.json
+
+.PHONY: check check-all serve-check native bench serve-bench

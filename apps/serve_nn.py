@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""serve_nn -- long-lived inference server for trained hpnn kernels.
+
+Usage: serve_nn [-v]... [-a addr] [-p port] [-b max-batch] [-q queue-rows]
+                [--linger-ms N] [--timeout-s N] [--no-warmup]
+                [conf (default ./nn.conf)]...
+
+Takes the same nn.conf files as run_nn; see hpnn_tpu/serve/ and the
+README "Serving" section for endpoints and backpressure semantics.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from hpnn_tpu.cli import serve_nn_main
+
+if __name__ == "__main__":
+    raise SystemExit(serve_nn_main())

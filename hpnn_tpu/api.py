@@ -11,9 +11,10 @@ the execution model is redesigned TPU-first:
   directory once into stacked (S, n) arrays and run the WHOLE epoch as one
   jit-compiled ``lax.scan`` on device (hpnn_tpu.ops.train_epoch) -- zero host
   round-trips per sample;
-* inference stacks the whole test set into one batched GEMM chain
-  (``ops.run_batch``) instead of one GEMV chain per file
-  (``libhpnn.c:1426``);
+* inference stacks the whole test set into one device launch
+  (``ops.run_batch``: a scanned per-row GEMV chain, keeping the
+  reference's per-file bit-independence -- see its docstring) instead of
+  one host-driven launch per file (``libhpnn.c:1426``);
 * the per-sample console lines are reconstructed afterwards from the scanned
   statistics, byte-identical to the reference's printf stream.
 

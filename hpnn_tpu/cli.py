@@ -179,9 +179,95 @@ def run_nn_main(argv: list[str] | None = None) -> int:
     return 0
 
 
+def serve_nn_main(argv: list[str] | None = None) -> int:
+    """serve_nn: long-lived inference server over the same ``.conf``
+    files run_nn takes (hpnn_tpu.serve).  New subsystem, so the flag
+    grammar is argparse rather than the reference parser -- there is no
+    reference binary to stay byte-compatible with."""
+    import argparse
+
+    argv = sys.argv[1:] if argv is None else argv
+    ap = argparse.ArgumentParser(
+        prog="serve_nn",
+        description="serve trained hpnn kernels over HTTP "
+                    "(POST /v1/kernels/<name>/infer)")
+    ap.add_argument("confs", nargs="*", default=["./nn.conf"],
+                    metavar="conf", help="nn.conf files (run_nn format; "
+                    "default ./nn.conf); each registers one kernel")
+    ap.add_argument("-v", "--verbose", action="count", default=0,
+                    help="increase verbosity (repeatable)")
+    ap.add_argument("-a", "--addr", default="127.0.0.1",
+                    help="bind address (default 127.0.0.1)")
+    ap.add_argument("-p", "--port", type=int, default=8080,
+                    help="bind port; 0 picks an ephemeral one")
+    ap.add_argument("-b", "--max-batch", type=int, default=64,
+                    help="max rows per device launch / largest batch "
+                    "bucket (default 64)")
+    ap.add_argument("-q", "--queue-rows", type=int, default=256,
+                    help="bounded queue capacity in rows; admission "
+                    "beyond it is rejected with 429 (default 256)")
+    ap.add_argument("--linger-ms", type=float, default=0.0,
+                    help="wait this long after the first queued request "
+                    "so concurrent clients can fill the batch (default "
+                    "0: dispatch immediately)")
+    ap.add_argument("--timeout-s", type=float, default=30.0,
+                    help="default per-request deadline (default 30)")
+    ap.add_argument("--no-warmup", action="store_true",
+                    help="skip pre-compiling the batch buckets at "
+                    "startup (first requests then pay the compiles)")
+    args = ap.parse_args(argv)
+
+    from .serve.server import ServeApp, make_server
+    from .utils.trace import phase
+
+    for _ in range(args.verbose):
+        nn_log.inc_verbosity()
+    with phase("init_all"):
+        runtime.init_all(nn_log.get_verbosity())
+    nn_log.set_verbosity(args.verbose)
+    app = ServeApp(max_batch=args.max_batch,
+                   max_queue_rows=args.queue_rows,
+                   linger_s=args.linger_ms / 1e3,
+                   default_timeout_s=args.timeout_s)
+    n_ok = 0
+    for conf in args.confs:
+        with phase("register"):
+            model = app.add_model(conf, warmup=not args.no_warmup)
+        if model is None:
+            sys.stderr.write(
+                f"FAILED to load NN configuration file {conf}! "
+                "(skipping)\n")
+        else:
+            n_ok += 1
+    if n_ok == 0:
+        sys.stderr.write("no kernel could be registered (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    httpd = make_server(args.addr, args.port, app)
+    host, port = httpd.server_address[:2]
+    # unconditional: the bound port is the serving contract (with -p 0
+    # it is the only way a launcher learns where to point clients)
+    sys.stdout.write(f"SERVE: listening on http://{host}:{port}\n")
+    sys.stdout.flush()
+    try:
+        httpd.serve_forever()
+    except KeyboardInterrupt:
+        sys.stdout.write("SERVE: draining...\n")
+        sys.stdout.flush()
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+        runtime.deinit_all()
+    return 0
+
+
 def train_nn_entry() -> None:  # console_scripts hook
     raise SystemExit(train_nn_main())
 
 
 def run_nn_entry() -> None:  # console_scripts hook
     raise SystemExit(run_nn_main())
+
+
+def serve_nn_entry() -> None:  # console_scripts hook
+    raise SystemExit(serve_nn_main())

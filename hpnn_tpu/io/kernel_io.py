@@ -41,7 +41,7 @@ import numpy as np
 
 from ..models.kernel import Kernel
 from ..utils.nn_log import nn_error
-from .samples import _MAX_COUNT, _GetlineSim, _skip_blank, _strtod
+from .samples import _GetlineSim, _is_digit, _skip_blank, _strtod
 
 
 def format_weight(v: float) -> str:
@@ -87,6 +87,16 @@ def _i32(v: int) -> int:
     return v - 2**32 if v >= 2**31 else v
 
 
+# Largest layer weight count allocated densely.  np.zeros calloc's, so
+# like the reference's calloc + Linux overcommit the untouched pages cost
+# nothing -- a dense allocation is correct (and cheap) far past any real
+# workload.  Only counts at/after 2^31 (16 GiB of doubles, where the
+# reference's own (UINT) index arithmetic is deep in overflow territory)
+# fall back to _SparseFlat.  The old 2^20 bound silently refused real
+# kernels, e.g. a 784x1338 hidden layer (ADVICE high).
+_DENSE_MAX = 1 << 31
+
+
 class _SparseFlat:
     """Stand-in for a layer whose claimed size exceeds any real workload:
     the reference calloc's it anyway (Linux overcommit succeeds untouched)
@@ -117,7 +127,7 @@ def _uint(s: str, pos: int) -> tuple[int, int]:
         neg = s[p] == "-"
         p += 1
     j = p
-    while j < len(s) and s[j].isdigit():
+    while j < len(s) and _is_digit(s[j]):
         j += 1
     if j == p:
         return 0, pos
@@ -131,13 +141,13 @@ def _scan_to_digit(line: str, pos: int) -> int:
     """``while(!ISDIGIT(*ptr) && *ptr!='\\n' && *ptr!='\\0') ptr++`` --
     returns the position of the first digit, or of the stopper."""
     while (pos < len(line) and line[pos] not in "\n\0"
-           and not line[pos].isdigit()):
+           and not _is_digit(line[pos])):
         pos += 1
     return pos
 
 
 def _at_digit(line: str, pos: int) -> bool:
-    return pos < len(line) and line[pos].isdigit()
+    return pos < len(line) and _is_digit(line[pos])
 
 
 def _read_weight_row(sim: _GetlineSim, flat: np.ndarray, stride: int,
@@ -287,7 +297,7 @@ def load_kernel(path: str) -> Kernel | None:
 
     dims = [n_in] + hid_out  # n_layers = n_hid hidden + 1 output
     flats = [np.zeros(dims[i + 1] * dims[i], np.float64)
-             if dims[i + 1] * dims[i] <= _MAX_COUNT
+             if dims[i + 1] * dims[i] < _DENSE_MAX
              else _SparseFlat(dims[i + 1] * dims[i])  # overcommit analog
              for i in range(len(dims) - 1)]
 
@@ -358,11 +368,16 @@ def load_kernel(path: str) -> Kernel | None:
         if sim.feof:
             break
 
-    if any(isinstance(f, _SparseFlat) for f in flats):
-        # completing a load at this size would need a multi-GB dense
-        # array (and a correspondingly impossible file); the reference
-        # would be deep in overcommitted memory here -- fail cleanly
-        return None
+    for i, f in enumerate(flats):
+        if isinstance(f, _SparseFlat):
+            # completing a load at this size would need a >=16 GiB dense
+            # array (and a correspondingly impossible file); the reference
+            # would be deep in overcommitted memory here -- fail with a
+            # diagnostic naming the layer (documented deviation; the old
+            # bare `return None` looked like an unreadable file)
+            nn_error(f"kernel read: layer {i + 1} weight count "
+                     f"{f.size} too large to allocate!\n")
+            return None
     weights = [flats[i].reshape(dims[i + 1], dims[i])
                for i in range(len(dims) - 1)]
     return Kernel(name=name, weights=weights)

@@ -60,6 +60,14 @@ _MAX_COUNT = 1 << 20
 _C_SPACE = " \t\n\r\v\f"  # C isspace set (C locale)
 
 
+def _is_digit(ch: str) -> bool:
+    """C ISDIGIT: ASCII '0'-'9' ONLY.  str.isdigit also accepts Unicode
+    digits -- the latin-1 superscripts 0xB2/0xB3/0xB9 in a corrupt file
+    would pass an .isdigit() gate and then raise ValueError from int()
+    instead of taking the graceful error path (ADVICE medium)."""
+    return "0" <= ch <= "9"
+
+
 def _strtod(s: str, pos: int) -> tuple[float, int]:
     """GET_DOUBLE (common.h:272-274): strtod skips leading C whitespace
     (which can include a newline) then parses its longest prefix at
@@ -107,12 +115,16 @@ def _section_count(line: str, key: str) -> int | None:
     so ``[input] 4.5`` reads count 4.  None = not a digit."""
     after = line.split(key, 1)[1][1:]
     pos = _skip_blank(after, 0)
-    if pos >= len(after) or not after[pos].isdigit():
+    if pos >= len(after) or not _is_digit(after[pos]):
         return None
     j = pos
-    while j < len(after) and after[j].isdigit():
+    while j < len(after) and _is_digit(after[j]):
         j += 1
-    return int(after[pos:j])
+    # (UINT)strtoull semantics, exactly like kernel_io._uint: saturate at
+    # 2^64-1, then the macro's cast truncates to 32 bits -- BEFORE the
+    # driver's _MAX_COUNT range check, so the two parsers agree with the
+    # reference on absurd counts (ADVICE low)
+    return min(int(after[pos:j]), 2**64 - 1) & 0xFFFFFFFF
 
 
 def _parse_values_line(buf: str, n: int) -> np.ndarray:

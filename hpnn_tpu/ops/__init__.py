@@ -74,9 +74,10 @@ def select_run_batch(dtype=None):
 
     The Pallas fused linear+activation kernels (the ``fw_mv_acc`` analog,
     ``/root/reference/src/cuda_ann.cu:77-86,538-577``) serve f32/bf16 on
-    TPU; the plain XLA GEMM chain serves fp64 parity and other backends.
-    Returns ``(fn, name)`` with fn call-compatible with
-    ``run_batch(weights, xs, kind)``.
+    TPU; the XLA ``run_batch`` (a scanned per-row GEMV chain -- row
+    results bit-independent of batch composition, see its docstring)
+    serves fp64 parity and other backends.  Returns ``(fn, name)`` with
+    fn call-compatible with ``run_batch(weights, xs, kind)``.
     """
     if _use_pallas(dtype):
         from .pallas_kernels import batched_forward_pallas_jit

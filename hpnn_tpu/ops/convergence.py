@@ -160,10 +160,31 @@ def train_epoch(weights, xs, ts, kind: str, momentum: bool,
 
 @functools.partial(jax.jit, static_argnames=("kind",))
 def run_batch(weights, xs, kind: str):
-    """Batched inference over the whole test set (one GEMM chain)."""
-    from .steps import batched_forward
+    """Batched inference: ONE device launch over the whole (S, n) set,
+    computed as a scan of per-row GEMV chains.
 
-    return batched_forward(weights, xs, kind)
+    The reference evaluates one GEMV chain per test FILE
+    (``libhpnn.c:1426``), so each sample's result is bit-independent of
+    every other sample.  A plain batched GEMM here loses that: XLA picks
+    the contraction split per SHAPE, so a row's f64 result shifts at the
+    ULP level with the corpus size (measured on CPU: 784-long
+    contractions differ between (64, n) and (96, n) batches).  The
+    ``lax.map`` form keeps the launch batched -- still one dispatch, no
+    host round-trips -- while making every row's reduction order
+    identical across ANY batch size, padding, or position (asserted in
+    tests/test_serve.py).  That row-determinism is what lets the serving
+    subsystem's micro-batcher coalesce and pad requests freely and still
+    answer bit-identically to this offline path.
+
+    The GEMM-chain throughput story is untouched: ``batched_forward``
+    still serves the DP/TP eval routes, and on TPU f32/bf16
+    ``select_run_batch`` dispatches to the fused Pallas kernels.  This
+    fp64/XLA path is the PARITY path -- determinism outranks the ~2x
+    GEMM speedup for small-MLP eval.
+    """
+    from .steps import forward
+
+    return lax.map(lambda x: forward(weights, x, kind)[-1], xs)
 
 
 # Max samples per device launch on TPU.  The axon TPU runtime kills any

@@ -38,6 +38,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from .activations import ann_act
 
+# the TPU compiler-params dataclass was renamed TPUCompilerParams ->
+# CompilerParams when Pallas TPU stabilized; accept both spellings
+_CompilerParams = getattr(pltpu, "CompilerParams", None) \
+    or pltpu.TPUCompilerParams
+
 def _interpret() -> bool:
     """Interpret mode on any non-TPU backend.
 
@@ -119,7 +124,7 @@ def fused_linear_act(w, xs, act: bool = True, tile_b: int = 256,
         out_specs=pl.BlockSpec((tile_b, tile_n), lambda bi, i, j: (bi, i)),
         out_shape=jax.ShapeDtypeStruct((bp, np_), xs.dtype),
         scratch_shapes=[pltpu.VMEM((tile_b, tile_n), acc_dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(xp, wp)

@@ -25,12 +25,32 @@ Two TPU-native implementations:
 from __future__ import annotations
 
 import functools
+import inspect
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.5 exports shard_map at the top level
+    from jax import shard_map as _shard_map
+except ImportError:  # jax 0.4.x: the experimental module is the only home
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+# the replication-check keyword was renamed check_rep -> check_vma when
+# shard_map graduated; resolve the installed spelling once
+_CHECK_KW = ("check_vma"
+             if "check_vma" in inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(f, **kw):
+    """Version-tolerant ``shard_map``: accepts the modern ``check_vma``
+    keyword on every jax this repo supports (0.4.x spells it
+    ``check_rep``)."""
+    if "check_vma" in kw and _CHECK_KW != "check_vma":
+        kw[_CHECK_KW] = kw.pop("check_vma")
+    return _shard_map(f, **kw)
 
 from ..ops import steps
 from .mesh import (

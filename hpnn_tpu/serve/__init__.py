@@ -1,0 +1,37 @@
+"""Long-lived inference serving subsystem.
+
+The batch CLI apps (``apps/run_nn.py``) pay kernel load + jit trace +
+compile on every invocation; the reference's whole point is *on-the-fly*
+use of small MLPs inside a long-lived host program (SURVEY section 0).
+This package keeps the compiled state resident and feeds it full batches:
+
+* :mod:`registry`  -- loads kernels through the existing ``io.kernel_io``
+  + ``api.configure`` path, keys them by name, and caches jitted
+  batched-forward callables per (topology, dtype, batch-bucket) so
+  steady-state requests never recompile;
+* :mod:`batcher`   -- a bounded micro-batching queue that coalesces
+  concurrent requests into one device launch, pads to power-of-two batch
+  buckets (bounding the compile cache), enforces per-request deadlines,
+  rejects immediately when full (backpressure), and drains gracefully on
+  shutdown;
+* :mod:`server`    -- a stdlib-only HTTP front-end (``ThreadingHTTPServer``):
+  ``POST /v1/kernels/<name>/infer``, ``GET /healthz``, ``GET /metrics``;
+* :mod:`metrics`   -- per-request latency histograms (p50/p99), queue
+  depth, batch fill ratio, compile-cache hits/misses, reject/timeout
+  counts, exported on ``/metrics``.
+
+Everything imports lazily off the hot path so pure-IO users of hpnn_tpu
+never pull in the HTTP stack.
+"""
+
+from .batcher import DeadlineExceeded, MicroBatcher, QueueFull, ServeClosed
+from .metrics import LatencyHistogram, ServeMetrics
+from .registry import ModelRegistry, ServedModel
+from .server import ServeApp, make_server
+
+__all__ = [
+    "DeadlineExceeded", "MicroBatcher", "QueueFull", "ServeClosed",
+    "LatencyHistogram", "ServeMetrics",
+    "ModelRegistry", "ServedModel",
+    "ServeApp", "make_server",
+]

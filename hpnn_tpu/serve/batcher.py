@@ -1,0 +1,228 @@
+"""Micro-batching engine: a bounded queue coalescing concurrent requests
+into one device launch per batch.
+
+Design (the serving analog of ``ops.run_batch``'s "stack the whole test
+set into one GEMM chain"):
+
+* **bounded queue, immediate reject** -- admission is row-counted against
+  ``max_queue_rows``; a full queue raises :class:`QueueFull` at submit
+  time (the HTTP layer maps it to 429 + Retry-After; 503 is reserved for
+  a draining server) instead of letting latency grow unboundedly.
+  Backpressure must be visible to clients, not absorbed into the queue.
+* **coalescing** -- the worker drains whatever is queued (up to
+  ``max_batch`` rows, never splitting one request across launches),
+  concatenates the rows, and dispatches ONE forward through the
+  registry's bucketed compile cache.  An optional ``linger_s`` makes the
+  worker wait that long after the first request arrives so concurrent
+  clients can fill the bucket (throughput mode); the default 0 ships
+  every batch as soon as the device is free (latency mode).
+* **deadlines** -- each request carries an absolute deadline.  Expired
+  requests are dropped at dispatch time without touching the device, and
+  the submitting thread raises :class:`DeadlineExceeded` (HTTP 504) --
+  a stale answer is not an answer.
+* **graceful drain** -- ``close(drain=True)`` stops admission
+  (:class:`ServeClosed`), lets the worker finish everything already
+  admitted, then joins the thread.  Nothing admitted is ever silently
+  dropped.
+
+One batcher (and one worker thread) per served model: batches must be
+model-homogeneous, and per-model FIFO keeps tail latency analyzable.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from ..utils.nn_log import nn_dbg, nn_warn
+from .metrics import ServeMetrics
+from .registry import ServedModel, bucket_rows
+
+
+class QueueFull(Exception):
+    """Admission rejected: the bounded queue is at capacity."""
+
+
+class DeadlineExceeded(Exception):
+    """The request's deadline passed before a result was produced."""
+
+
+class ServeClosed(Exception):
+    """The batcher is shutting down and no longer admits requests."""
+
+
+class _Pending:
+    __slots__ = ("xs", "rows", "deadline", "t_enq", "t_dispatch",
+                 "event", "result", "error")
+
+    def __init__(self, xs: np.ndarray, deadline: float):
+        self.xs = xs
+        self.rows = xs.shape[0]
+        self.deadline = deadline
+        self.t_enq = time.monotonic()
+        self.t_dispatch = 0.0
+        self.event = threading.Event()
+        self.result: np.ndarray | None = None
+        self.error: Exception | None = None
+
+
+class MicroBatcher:
+    def __init__(self, model: ServedModel,
+                 metrics: ServeMetrics | None = None,
+                 max_queue_rows: int = 256,
+                 max_batch: int | None = None,
+                 linger_s: float = 0.0):
+        self.model = model
+        self.metrics = metrics or model.registry.metrics
+        self.max_queue_rows = int(max_queue_rows)
+        self.max_batch = int(max_batch or model.registry.max_batch)
+        assert self.max_batch <= model.registry.max_batch, \
+            "batcher max_batch cannot exceed the registry bucket cap"
+        self.linger_s = float(linger_s)
+        self._q: deque[_Pending] = deque()
+        self._qrows = 0
+        self._cv = threading.Condition()
+        self._closing = False
+        self._paused = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"hpnn-batcher-{model.name}",
+            daemon=True)
+        self._thread.start()
+
+    # --- introspection (metrics gauge + tests) -------------------------
+    def depth(self) -> int:
+        """Queued ROWS (not requests): the unit admission is counted in."""
+        return self._qrows
+
+    def pause(self) -> None:
+        """Hold dispatch (queue keeps admitting until full).  Test /
+        operations hook -- this is how the e2e suite makes queue-full
+        deterministic."""
+        with self._cv:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cv:
+            self._paused = False
+            self._cv.notify_all()
+
+    # --- client side ----------------------------------------------------
+    def submit(self, xs: np.ndarray, timeout_s: float) -> np.ndarray:
+        """Enqueue (rows, n_inputs) float64 inputs and block until the
+        batch containing them completes.  Raises QueueFull /
+        DeadlineExceeded / ServeClosed; any model exception propagates."""
+        rows = xs.shape[0]
+        if not 1 <= rows <= self.max_batch:
+            raise ValueError(
+                f"request rows {rows} outside [1, {self.max_batch}]")
+        p = _Pending(xs, time.monotonic() + timeout_s)
+        with self._cv:
+            if self._closing:
+                raise ServeClosed(f"kernel '{self.model.name}' draining")
+            if self._qrows + rows > self.max_queue_rows:
+                raise QueueFull(
+                    f"queue at {self._qrows}/{self.max_queue_rows} rows")
+            self._q.append(p)
+            self._qrows += rows
+            self._cv.notify_all()
+        # grace covers the in-flight batch ahead of us: the worker either
+        # answers or expires us at ITS next dispatch, so wait generously
+        # and trust the worker-side deadline as the authority
+        if not p.event.wait(timeout=timeout_s + 1.0):
+            raise DeadlineExceeded(
+                f"no result within {timeout_s:.3f}s")
+        if p.error is not None:
+            raise p.error
+        self.metrics.latency.observe(time.monotonic() - p.t_enq)
+        return p.result
+
+    # --- worker ---------------------------------------------------------
+    def _take_batch(self) -> list[_Pending] | None:
+        """Pop up to max_batch rows of requests (FIFO, never splitting a
+        request); None when closing with an empty queue."""
+        with self._cv:
+            while True:
+                if self._q and not self._paused:
+                    break
+                if self._closing and not self._q:
+                    return None
+                self._cv.wait(timeout=0.05)
+            if self.linger_s > 0.0 and not self._closing:
+                # throughput mode: give concurrent clients linger_s from
+                # the FIRST queued request to fill the bucket
+                head = self._q[0]
+                while (self._qrows < self.max_batch
+                       and not self._closing and not self._paused):
+                    remain = head.t_enq + self.linger_s - time.monotonic()
+                    if remain <= 0:
+                        break
+                    self._cv.wait(timeout=remain)
+            batch, rows = [], 0
+            while self._q and rows + self._q[0].rows <= self.max_batch:
+                p = self._q.popleft()
+                rows += p.rows
+                batch.append(p)
+            self._qrows -= rows
+            return batch
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._take_batch()
+            if batch is None:
+                return
+            now = time.monotonic()
+            live: list[_Pending] = []
+            for p in batch:
+                if now > p.deadline:
+                    p.error = DeadlineExceeded(
+                        f"expired {now - p.deadline:.3f}s before dispatch")
+                    p.event.set()
+                else:
+                    p.t_dispatch = now
+                    live.append(p)
+            if not live:
+                continue
+            rows = sum(p.rows for p in live)
+            try:
+                outs = self.model.infer(
+                    np.concatenate([p.xs for p in live]))
+                self.metrics.count_batch(
+                    rows, bucket_rows(rows, self.model.registry.max_batch))
+                off = 0
+                for p in live:
+                    p.result = outs[off:off + p.rows]
+                    off += p.rows
+                    self.metrics.queue_latency.observe(
+                        p.t_dispatch - p.t_enq)
+                    p.event.set()
+            except Exception as exc:  # device/model failure: fail the
+                # batch's requests, keep serving the next one
+                nn_warn(f"serve: batch failed for "
+                        f"'{self.model.name}': {exc}\n")
+                for p in live:
+                    p.error = exc
+                    p.event.set()
+
+    # --- lifecycle ------------------------------------------------------
+    def close(self, drain: bool = True, timeout_s: float = 30.0) -> None:
+        """Stop admission; drain=True lets the worker finish the queue,
+        drain=False fails queued requests with ServeClosed."""
+        with self._cv:
+            self._closing = True
+            self._paused = False
+            if not drain:
+                while self._q:
+                    p = self._q.popleft()
+                    p.error = ServeClosed("server shutting down")
+                    p.event.set()
+                self._qrows = 0
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout_s)
+        if self._thread.is_alive():  # pragma: no cover - watchdog only
+            nn_warn(f"serve: batcher '{self.model.name}' did not drain "
+                    f"within {timeout_s}s\n")
+        else:
+            nn_dbg(f"serve: batcher '{self.model.name}' drained\n")

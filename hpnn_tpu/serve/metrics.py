@@ -1,0 +1,203 @@
+"""Serving metrics: latency histograms, counters, gauges.
+
+Stdlib-only (no prometheus_client in the image): a small thread-safe
+registry that renders both the Prometheus text exposition format and a
+JSON snapshot.  The latency histogram uses log-spaced buckets so p50/p99
+come out of one pass over ~60 counters with bounded relative error
+(~12% per bucket step) -- the standard histogram-quantile trade-off.
+
+Counters follow the subsystem's life: requests by outcome (``ok``,
+``queue_full``, ``deadline``, ``bad_request``, ``not_found``,
+``error``), device batches, batched rows, batch fill ratio, and the
+registry's compile-cache hits/misses.  Queue depth is a live gauge read
+through a callback at render time, so the metric can never go stale.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Callable
+
+# log-spaced latency bounds: 100 us .. ~107 s, factor 1.26 (log10 step
+# 0.1) -- 61 buckets, ~12% relative quantile error, good enough to tell
+# a 2 ms batch hit from a 50 ms queue stall
+_BUCKET_FACTOR = 10.0 ** 0.1
+_BUCKET_MIN_S = 1e-4
+_N_BUCKETS = 61
+
+_REQUEST_OUTCOMES = ("ok", "queue_full", "deadline", "bad_request",
+                     "not_found", "error")
+
+
+class LatencyHistogram:
+    """Log-bucketed latency histogram with percentile estimation."""
+
+    def __init__(self):
+        self._counts = [0] * (_N_BUCKETS + 1)  # +1 overflow bucket
+        self._sum = 0.0
+        self._n = 0
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _bucket(seconds: float) -> int:
+        if seconds <= _BUCKET_MIN_S:
+            return 0
+        i = int(math.log(seconds / _BUCKET_MIN_S) / math.log(_BUCKET_FACTOR)) + 1
+        return min(i, _N_BUCKETS)
+
+    @staticmethod
+    def _upper_bound(i: int) -> float:
+        """Upper edge of bucket i (seconds)."""
+        return _BUCKET_MIN_S * _BUCKET_FACTOR ** i
+
+    def observe(self, seconds: float) -> None:
+        with self._lock:
+            self._counts[self._bucket(seconds)] += 1
+            self._sum += seconds
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+    @property
+    def total(self) -> float:
+        return self._sum
+
+    def percentile(self, p: float) -> float:
+        """Estimated p-th percentile in seconds (upper bucket edge --
+        conservative).  0.0 when empty."""
+        with self._lock:
+            if self._n == 0:
+                return 0.0
+            rank = p / 100.0 * self._n
+            seen = 0
+            for i, c in enumerate(self._counts):
+                seen += c
+                if seen >= rank:
+                    return self._upper_bound(i)
+            return self._upper_bound(_N_BUCKETS)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            n, s = self._n, self._sum
+        return {
+            "count": n,
+            "sum_seconds": round(s, 6),
+            "mean_ms": round(s / n * 1e3, 3) if n else 0.0,
+            "p50_ms": round(self.percentile(50) * 1e3, 3),
+            "p99_ms": round(self.percentile(99) * 1e3, 3),
+        }
+
+
+class ServeMetrics:
+    """One metrics registry per server instance (tests need isolation,
+    so this is deliberately NOT a module-level singleton)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.latency = LatencyHistogram()        # whole-request wall
+        self.queue_latency = LatencyHistogram()  # enqueue -> dispatch
+        self.requests = {k: 0 for k in _REQUEST_OUTCOMES}
+        self.rows_total = 0
+        self.batches_total = 0
+        self._fill_sum = 0.0  # sum of (rows / bucket) per dispatched batch
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._depth_fns: dict[str, Callable[[], int]] = {}
+
+    # --- write side -----------------------------------------------------
+    def count_request(self, outcome: str) -> None:
+        with self._lock:
+            self.requests[outcome] = self.requests.get(outcome, 0) + 1
+
+    def count_batch(self, rows: int, bucket: int) -> None:
+        with self._lock:
+            self.batches_total += 1
+            self.rows_total += rows
+            self._fill_sum += rows / float(bucket)
+
+    def count_cache(self, hit: bool) -> None:
+        with self._lock:
+            if hit:
+                self.cache_hits += 1
+            else:
+                self.cache_misses += 1
+
+    def register_queue(self, name: str, depth_fn: Callable[[], int]) -> None:
+        """Register a live queue-depth gauge for one served kernel."""
+        with self._lock:
+            self._depth_fns[name] = depth_fn
+
+    # --- read side ------------------------------------------------------
+    def batch_fill_ratio(self) -> float:
+        with self._lock:
+            return (self._fill_sum / self.batches_total
+                    if self.batches_total else 0.0)
+
+    def snapshot(self) -> dict:
+        depths = {name: fn() for name, fn in list(self._depth_fns.items())}
+        with self._lock:
+            req = dict(self.requests)
+            out = {
+                "requests": req,
+                "rows_total": self.rows_total,
+                "batches_total": self.batches_total,
+                "compile_cache": {"hits": self.cache_hits,
+                                  "misses": self.cache_misses},
+            }
+        out["batch_fill_ratio"] = round(self.batch_fill_ratio(), 4)
+        out["queue_depth"] = depths
+        out["latency"] = self.latency.snapshot()
+        out["queue_latency"] = self.queue_latency.snapshot()
+        return out
+
+    def render_json(self) -> str:
+        return json.dumps(self.snapshot()) + "\n"
+
+    def render_prometheus(self) -> str:
+        """Prometheus text exposition (type comments + samples)."""
+        snap = self.snapshot()
+        lines = [
+            "# HELP hpnn_serve_requests_total Requests by outcome.",
+            "# TYPE hpnn_serve_requests_total counter",
+        ]
+        for outcome, n in sorted(snap["requests"].items()):
+            lines.append(
+                f'hpnn_serve_requests_total{{outcome="{outcome}"}} {n}')
+        lines += [
+            "# HELP hpnn_serve_rows_total Input rows batched to device.",
+            "# TYPE hpnn_serve_rows_total counter",
+            f"hpnn_serve_rows_total {snap['rows_total']}",
+            "# HELP hpnn_serve_batches_total Device launches dispatched.",
+            "# TYPE hpnn_serve_batches_total counter",
+            f"hpnn_serve_batches_total {snap['batches_total']}",
+            "# HELP hpnn_serve_batch_fill_ratio Mean rows/bucket per batch.",
+            "# TYPE hpnn_serve_batch_fill_ratio gauge",
+            f"hpnn_serve_batch_fill_ratio {snap['batch_fill_ratio']}",
+            "# HELP hpnn_serve_compile_cache_total Forward-callable cache.",
+            "# TYPE hpnn_serve_compile_cache_total counter",
+            'hpnn_serve_compile_cache_total{result="hit"} '
+            f"{snap['compile_cache']['hits']}",
+            'hpnn_serve_compile_cache_total{result="miss"} '
+            f"{snap['compile_cache']['misses']}",
+            "# HELP hpnn_serve_queue_depth Requests waiting per kernel.",
+            "# TYPE hpnn_serve_queue_depth gauge",
+        ]
+        for name, depth in sorted(snap["queue_depth"].items()):
+            lines.append(f'hpnn_serve_queue_depth{{kernel="{name}"}} {depth}')
+        for key in ("latency", "queue_latency"):
+            h = snap[key]
+            lines += [
+                f"# HELP hpnn_serve_{key}_seconds Request {key} summary.",
+                f"# TYPE hpnn_serve_{key}_seconds summary",
+                f'hpnn_serve_{key}_seconds{{quantile="0.5"}} '
+                f"{h['p50_ms'] / 1e3}",
+                f'hpnn_serve_{key}_seconds{{quantile="0.99"}} '
+                f"{h['p99_ms'] / 1e3}",
+                f"hpnn_serve_{key}_seconds_sum {h['sum_seconds']}",
+                f"hpnn_serve_{key}_seconds_count {h['count']}",
+            ]
+        return "\n".join(lines) + "\n"

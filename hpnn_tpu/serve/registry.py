@@ -1,0 +1,217 @@
+"""Model registry: named kernels + a bounded compile cache of jitted
+batched-forward callables.
+
+Loading goes through the EXISTING ``io`` + ``api.configure`` path -- the
+same ``.conf`` files ``run_nn`` accepts -- so a kernel that trains and
+evaluates offline serves unchanged.  Evaluation is the exact
+``api.run_kernel`` batch pipeline (``ops.select_run_batch``): weights
+cast once to the conf dtype, inputs batched into one GEMM chain, outputs
+pulled as float64 -- responses are bit-identical to what ``run_nn``
+computes for the same input rows (asserted end-to-end in
+``tests/test_serve.py``).
+
+The compile cache is keyed by (topology, dtype, batch-bucket, kind):
+requests are padded up to power-of-two row buckets, so the set of
+compiled programs per model is bounded by log2(max_batch)+1 and a
+warmed-up server NEVER retraces or recompiles in steady state (jit
+caches are keyed on shapes + statics, and bucketing fixes the shapes).
+Hits/misses are counted into ``ServeMetrics``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as np
+
+from ..utils.nn_log import nn_dbg, nn_out
+from .metrics import ServeMetrics
+
+
+def bucket_rows(rows: int, max_batch: int) -> int:
+    """Power-of-two batch bucket: smallest 2^k >= rows, capped at
+    max_batch (rows beyond the cap are the batcher's problem -- it never
+    dispatches more than max_batch rows)."""
+    if rows >= max_batch:
+        return max_batch
+    b = 1
+    while b < rows:
+        b <<= 1
+    return b
+
+
+class ServedModel:
+    """One registered kernel: host weights + device-resident cast copies
+    and the per-bucket forward cache entry points."""
+
+    def __init__(self, name: str, nn, registry: "ModelRegistry"):
+        from ..io.conf import NN_TYPE_ANN, NN_TYPE_SNN
+
+        self.name = name
+        self.nn = nn                      # api.NNDef (conf + kernel)
+        self.registry = registry
+        # LNN evaluates through the SNN branch, exactly like run_kernel
+        # (libhpnn.c:1455-1456)
+        self.kind = (NN_TYPE_SNN if nn.conf.type != NN_TYPE_ANN
+                     else NN_TYPE_ANN)
+        self.n_inputs = nn.kernel.n_inputs
+        self.n_outputs = nn.kernel.n_outputs
+        self._weights = None              # cast lazily on first infer
+        self._lock = threading.Lock()
+
+    @property
+    def dtype(self):
+        from ..api import _dtype_of
+
+        return _dtype_of(self.nn.conf)
+
+    @property
+    def dtype_name(self) -> str:
+        return self.nn.conf.dtype
+
+    @property
+    def topology(self) -> tuple:
+        return tuple(self.nn.kernel.params)
+
+    def weights(self):
+        """Device weights in the conf dtype, cast ONCE and kept resident
+        (the whole point of a long-lived server)."""
+        with self._lock:
+            if self._weights is None:
+                import jax.numpy as jnp
+
+                self._weights = tuple(
+                    jnp.asarray(w, dtype=self.dtype)
+                    for w in self.nn.kernel.weights)
+            return self._weights
+
+    def infer(self, xs: np.ndarray) -> np.ndarray:
+        """Batched forward for (rows, n_inputs) float64 inputs; returns
+        (rows, n_outputs) float64 -- the run_kernel eval pipeline."""
+        return self.registry.forward(self, xs)
+
+    def warmup(self) -> int:
+        """Compile every batch bucket up front so steady-state traffic
+        never pays a trace/compile.  Returns the bucket count."""
+        n = 0
+        b = 1
+        while True:
+            xs = np.zeros((b, self.n_inputs), np.float64)
+            self.registry.forward(self, xs)
+            n += 1
+            if b >= self.registry.max_batch:
+                return n
+            b <<= 1
+
+
+class ModelRegistry:
+    """Name -> ServedModel map plus the shared forward-callable cache."""
+
+    def __init__(self, metrics: ServeMetrics | None = None,
+                 max_batch: int = 64):
+        assert max_batch >= 1
+        self.metrics = metrics or ServeMetrics()
+        # buckets are powers of two, so the cap must be one: round a
+        # non-pow2 request (serve_nn -b 48) UP to the next bucket --
+        # otherwise warmup would double past the cap and bucket_rows
+        # could return a bucket above it
+        self.max_batch = 1 << (int(max_batch) - 1).bit_length()
+        if self.max_batch != int(max_batch):
+            from ..utils.nn_log import nn_warn
+
+            nn_warn(f"serve: max_batch {max_batch} rounded up to the "
+                    f"power-of-two bucket {self.max_batch}\n")
+        self._models: dict[str, ServedModel] = {}
+        self._cache: dict[tuple, object] = {}
+        self._lock = threading.Lock()
+
+    # --- registration ---------------------------------------------------
+    def register_conf(self, conf_path: str,
+                      name: str | None = None) -> ServedModel | None:
+        """Load a kernel through api.configure (the run_nn path: parse
+        conf, then load or generate the kernel).  Returns None on any
+        parse/load failure -- the caller decides whether that is fatal."""
+        from ..api import configure
+
+        nn = configure(conf_path)
+        if nn is None or nn.kernel is None:
+            return None
+        if name is None:
+            name = nn.conf.name or os.path.splitext(
+                os.path.basename(conf_path))[0]
+        return self.register(name, nn)
+
+    def register(self, name: str, nn) -> ServedModel | None:
+        """Register under ``name``; a collision is a FAILURE (None) --
+        silently replacing a live model would reroute its traffic (hot
+        reload, when it comes, will be an explicit operation)."""
+        from ..utils.nn_log import nn_error
+
+        model = ServedModel(name, nn, self)
+        with self._lock:
+            if name in self._models:
+                nn_error(f"serve: kernel name '{name}' already "
+                         "registered!\n")
+                return None
+            self._models[name] = model
+        nn_out(f"serve: registered kernel '{name}' "
+               f"({'x'.join(str(p) for p in model.topology)}, "
+               f"{model.dtype_name}, {model.kind})\n")
+        return model
+
+    def get(self, name: str) -> ServedModel | None:
+        with self._lock:
+            return self._models.get(name)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    # --- the forward path ----------------------------------------------
+    def _callable_for(self, model: ServedModel, bucket: int):
+        """The jitted batched-forward entry for one (topology, dtype,
+        bucket, kind) key.  Creating the entry is the cache MISS (the
+        underlying jit compiles on its first call at this shape);
+        everything after is a hit and never recompiles."""
+        key = (model.topology, model.dtype_name, bucket, model.kind)
+        with self._lock:
+            fn = self._cache.get(key)
+            if fn is not None:
+                self.metrics.count_cache(hit=True)
+                return fn
+            from .. import ops
+
+            run_batch_fn, path = ops.select_run_batch(model.dtype)
+            weights, kind = model.weights(), model.kind
+
+            def fn(jxs, _fn=run_batch_fn, _w=weights, _k=kind):
+                return _fn(_w, jxs, _k)
+
+            self._cache[key] = fn
+            self.metrics.count_cache(hit=False)
+            nn_dbg(f"serve: compile-cache miss "
+                   f"(model={model.name} bucket={bucket} path={path})\n")
+            return fn
+
+    def forward(self, model: ServedModel, xs: np.ndarray) -> np.ndarray:
+        """Pad rows to the power-of-two bucket, run the cached jitted
+        forward, slice the real rows back out as float64."""
+        import jax.numpy as jnp
+
+        rows = xs.shape[0]
+        assert 1 <= rows <= self.max_batch, rows
+        bucket = bucket_rows(rows, self.max_batch)
+        fn = self._callable_for(model, bucket)
+        if bucket != rows:
+            pad = np.zeros((bucket - rows, xs.shape[1]), xs.dtype)
+            xs = np.concatenate([xs, pad])
+        jxs = jnp.asarray(xs, dtype=model.dtype)
+        outs = np.asarray(fn(jxs), dtype=np.float64)
+        return outs[:rows]
+
+    def cache_stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._cache),
+                    "hits": self.metrics.cache_hits,
+                    "misses": self.metrics.cache_misses}

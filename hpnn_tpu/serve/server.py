@@ -1,0 +1,260 @@
+"""Stdlib-only HTTP front-end for the serving subsystem.
+
+Endpoints:
+
+* ``POST /v1/kernels/<name>/infer`` -- body
+  ``{"inputs": [[...], ...]}`` (or ``"input": [...]`` for one row),
+  optional ``"timeout_ms"``.  Replies ``{"outputs": [[...], ...],
+  "argmax": [...]}``; outputs are float64 rendered by json's shortest
+  round-trip repr, so the bytes decode to EXACTLY the floats the
+  run_kernel batch path computes.
+* ``GET /healthz``  -- liveness + registered kernel list.
+* ``GET /metrics``  -- Prometheus text; ``?format=json`` for the JSON
+  snapshot (what scripts/serve_bench.py consumes).
+
+Status mapping (distinct by failure class, so clients can react):
+
+  ====  ==========================================================
+  200   result
+  400   malformed body / wrong input width / too many rows
+  404   unknown kernel
+  429   queue full (backpressure -- retry later; Retry-After: 1)
+  503   server draining (shutdown in progress)
+  504   deadline exceeded (queued or computed past the timeout)
+  ====  ==========================================================
+
+``ThreadingHTTPServer`` gives one thread per connection; they all block
+in ``MicroBatcher.submit`` and the per-model worker thread is the only
+one touching the device -- the HTTP layer is pure coordination.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..utils.nn_log import nn_dbg, nn_out
+from .batcher import DeadlineExceeded, MicroBatcher, QueueFull, ServeClosed
+from .metrics import ServeMetrics
+from .registry import ModelRegistry
+
+_INFER_RE = re.compile(r"^/v1/kernels/([^/]+)/infer$")
+
+
+class _HTTPError(Exception):
+    def __init__(self, status: int, outcome: str, message: str):
+        super().__init__(message)
+        self.status = status
+        self.outcome = outcome
+
+
+class ServeApp:
+    """Registry + per-model batchers + metrics: everything the HTTP
+    handler needs, independent of the socket layer (tests drive it
+    directly and through real HTTP)."""
+
+    def __init__(self, max_batch: int = 64, max_queue_rows: int = 256,
+                 linger_s: float = 0.0, default_timeout_s: float = 30.0,
+                 metrics: ServeMetrics | None = None):
+        self.metrics = metrics or ServeMetrics()
+        self.registry = ModelRegistry(metrics=self.metrics,
+                                      max_batch=max_batch)
+        self.batchers: dict[str, MicroBatcher] = {}
+        self.max_queue_rows = int(max_queue_rows)
+        self.linger_s = float(linger_s)
+        self.default_timeout_s = float(default_timeout_s)
+        self._closed = False
+
+    def add_model(self, conf_path: str, name: str | None = None,
+                  warmup: bool = True):
+        """Register one ``.conf`` (the same files run_nn takes).  With
+        ``warmup`` every batch bucket compiles now, so the first real
+        request is as fast as the thousandth.  A name collision is a
+        registration FAILURE (None, diagnosed by the registry): silently
+        replacing would leak the first batcher's worker and reroute its
+        traffic."""
+        model = self.registry.register_conf(conf_path, name=name)
+        if model is None:
+            return None
+        if warmup:
+            n = model.warmup()
+            nn_out(f"serve: warmed {n} batch bucket(s) for "
+                   f"'{model.name}'\n")
+        b = MicroBatcher(model, metrics=self.metrics,
+                         max_queue_rows=self.max_queue_rows,
+                         linger_s=self.linger_s)
+        self.batchers[model.name] = b
+        self.metrics.register_queue(model.name, b.depth)
+        return model
+
+    def infer(self, name: str, xs: np.ndarray,
+              timeout_s: float | None = None) -> np.ndarray:
+        b = self.batchers.get(name)
+        if b is None:
+            raise KeyError(name)
+        return b.submit(xs, timeout_s if timeout_s is not None
+                        else self.default_timeout_s)
+
+    def close(self, drain: bool = True) -> None:
+        self._closed = True
+        for b in self.batchers.values():
+            b.close(drain=drain)
+
+    # --- request handling (transport-independent) ----------------------
+    def handle_infer(self, name: str, body: bytes) -> dict:
+        b = self.batchers.get(name)
+        if b is None:
+            raise _HTTPError(404, "not_found", f"unknown kernel '{name}'")
+        try:
+            req = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise _HTTPError(400, "bad_request", f"bad JSON: {exc}")
+        if not isinstance(req, dict):
+            raise _HTTPError(400, "bad_request", "body must be an object")
+        raw = req.get("inputs")
+        if raw is None:
+            one = req.get("input")
+            raw = None if one is None else [one]
+        try:
+            xs = np.asarray(raw, dtype=np.float64)
+        except (TypeError, ValueError) as exc:
+            raise _HTTPError(400, "bad_request", f"bad inputs: {exc}")
+        model = b.model
+        if xs.ndim != 2 or xs.shape[1] != model.n_inputs:
+            raise _HTTPError(
+                400, "bad_request",
+                f"inputs must be (rows, {model.n_inputs}); "
+                f"got {list(xs.shape)}")
+        if not 1 <= xs.shape[0] <= b.max_batch:
+            raise _HTTPError(
+                400, "bad_request",
+                f"rows must be in [1, {b.max_batch}]; got {xs.shape[0]}")
+        timeout_s = self.default_timeout_s
+        if "timeout_ms" in req:
+            try:
+                timeout_s = float(req["timeout_ms"]) / 1e3
+            except (TypeError, ValueError):
+                raise _HTTPError(400, "bad_request", "bad timeout_ms")
+        try:
+            outs = b.submit(xs, timeout_s)
+        except QueueFull as exc:
+            raise _HTTPError(429, "queue_full", str(exc))
+        except DeadlineExceeded as exc:
+            raise _HTTPError(504, "deadline", str(exc))
+        except ServeClosed as exc:
+            raise _HTTPError(503, "error", str(exc))
+        except Exception as exc:
+            raise _HTTPError(500, "error", f"{type(exc).__name__}: {exc}")
+        return {
+            "kernel": name,
+            "outputs": outs.tolist(),
+            "argmax": [int(i) for i in np.argmax(outs, axis=1)],
+        }
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hpnn-serve/0.1"
+    protocol_version = "HTTP/1.1"
+
+    @property
+    def app(self) -> ServeApp:
+        return self.server.app  # type: ignore[attr-defined]
+
+    def log_message(self, fmt, *args):  # route through nn_log, not stderr
+        nn_dbg("serve: " + (fmt % args) + "\n")
+
+    def _reply(self, status: int, payload: dict,
+               content_type: str = "application/json",
+               extra_headers: dict | None = None) -> None:
+        body = (json.dumps(payload) + "\n").encode("utf-8") \
+            if content_type == "application/json" else payload
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        path, _, query = self.path.partition("?")
+        if path == "/healthz":
+            status = "draining" if self.app._closed else "ok"
+            self._reply(200 if status == "ok" else 503,
+                        {"status": status,
+                         "kernels": self.app.registry.names()})
+            return
+        if path == "/metrics":
+            if "format=json" in query:
+                self._reply(200, self.app.metrics.snapshot())
+            else:
+                self._reply(
+                    200,
+                    self.app.metrics.render_prometheus().encode("utf-8"),
+                    content_type="text/plain; version=0.0.4")
+            return
+        self._reply(404, {"error": f"no route {path}"})
+
+    def do_POST(self) -> None:
+        # drain the body FIRST, whatever the route: replying without
+        # consuming it would leave the bytes on the keep-alive stream to
+        # be misparsed as the next request line (protocol_version is 1.1)
+        try:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+        except ValueError:
+            self.close_connection = True  # unknown body length: resync
+            self.app.metrics.count_request("bad_request")
+            self._reply(400, {"error": "bad Content-Length",
+                              "reason": "bad_request"})
+            return
+        m = _INFER_RE.match(self.path)
+        if m is None:
+            self.app.metrics.count_request("not_found")
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            out = self.app.handle_infer(m.group(1), body)
+        except _HTTPError as exc:
+            self.app.metrics.count_request(exc.outcome)
+            headers = {"Retry-After": "1"} if exc.status == 429 else None
+            self._reply(exc.status,
+                        {"error": str(exc), "reason": exc.outcome},
+                        extra_headers=headers)
+            return
+        self.app.metrics.count_request("ok")
+        self._reply(200, out)
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    # socketserver's default listen backlog is 5: a burst of concurrent
+    # clients would see connection-refused at the KERNEL level before the
+    # queue-full admission control ever runs.  Backpressure must come
+    # from the 429 path, not the TCP accept queue.
+    request_queue_size = 128
+
+
+def make_server(host: str, port: int, app: ServeApp) -> ThreadingHTTPServer:
+    """Bind (port 0 -> ephemeral) and attach the app; caller decides
+    between serve_forever() and a background thread."""
+    httpd = _Server((host, port), _Handler)
+    httpd.app = app  # type: ignore[attr-defined]
+    return httpd
+
+
+def serve_in_thread(host: str, port: int,
+                    app: ServeApp) -> tuple[ThreadingHTTPServer,
+                                            threading.Thread]:
+    """Convenience used by tests and the bench driver: server on a
+    daemon thread, returns (httpd, thread); httpd.server_address has the
+    real port."""
+    httpd = make_server(host, port, app)
+    t = threading.Thread(target=httpd.serve_forever,
+                         name="hpnn-serve-http", daemon=True)
+    t.start()
+    return httpd, t

@@ -1,0 +1,240 @@
+#!/usr/bin/env python3
+"""Load generator for the serving subsystem: N concurrent clients against
+a live serve_nn server, BENCH-style JSON row out.
+
+Protocol (mirrors bench.py's honesty rules):
+
+* every request's wall time is measured client-side around the full HTTP
+  round trip -- what a user would see, queueing and JSON both included;
+* the row reports client-observed p50/p99/mean latency AND the server's
+  own /metrics snapshot (batch fill ratio, compile-cache hits/misses,
+  queue rejections), so a throughput claim can be cross-checked against
+  what the server actually batched;
+* non-200 responses are never silently dropped: the row counts outcomes
+  by status and the process exits non-zero if anything but the expected
+  statuses came back.
+
+Usable three ways:
+
+* CLI against a running server:
+    python scripts/serve_bench.py --url http://127.0.0.1:8080 \
+        --kernel tiny --n-inputs 8 --requests 256 --concurrency 16
+* CLI self-hosted (spawns the server in-process from a conf):
+    python scripts/serve_bench.py --conf nn.conf --requests 256
+* as a library: tests/test_serve.py drives ``run_load`` directly for the
+  end-to-end acceptance assertions (bit-parity vs the run_kernel batch
+  path, zero steady-state compile-cache misses, queue-full rejection).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+
+def http_json(url: str, payload: dict | None = None,
+              timeout_s: float = 60.0) -> tuple[int, dict]:
+    """One request; returns (status, decoded body).  HTTP errors with a
+    JSON body decode like successes (the server's distinct reject
+    statuses ARE the API); transport errors raise."""
+    if payload is None:
+        req = urllib.request.Request(url)
+    else:
+        req = urllib.request.Request(
+            url, data=json.dumps(payload).encode("utf-8"),
+            headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode("utf-8", "replace")
+        try:
+            return exc.code, json.loads(body)
+        except json.JSONDecodeError:
+            return exc.code, {"error": body}
+
+
+def fetch_metrics(base_url: str) -> dict:
+    _, body = http_json(base_url.rstrip("/") + "/metrics?format=json")
+    return body
+
+
+def run_load(base_url: str, kernel: str, inputs: np.ndarray,
+             rows_per_request: int | list[int] = 1,
+             concurrency: int = 16,
+             timeout_s: float = 60.0) -> dict:
+    """Fire the whole ``inputs`` array at the server as concurrent
+    requests and return per-request records + aggregate stats.
+
+    ``rows_per_request``: an int, or a list of sizes cycled through --
+    e.g. [3, 5, 7] exercises several batch sizes inside one bucket.
+    Rows are assigned to requests IN ORDER, so record i's outputs align
+    with the matching slice of ``inputs`` (what the parity check needs).
+    """
+    inputs = np.asarray(inputs, dtype=np.float64)
+    sizes = ([rows_per_request] if isinstance(rows_per_request, int)
+             else list(rows_per_request))
+    chunks = []
+    lo = si = 0
+    while lo < inputs.shape[0]:
+        k = min(sizes[si % len(sizes)], inputs.shape[0] - lo)
+        chunks.append((lo, lo + k))
+        lo += k
+        si += 1
+    url = f"{base_url.rstrip('/')}/v1/kernels/{kernel}/infer"
+    records: list[dict | None] = [None] * len(chunks)
+    next_i = [0]
+    ilock = threading.Lock()
+    start_gate = threading.Event()
+
+    def worker():
+        start_gate.wait()
+        while True:
+            with ilock:
+                i = next_i[0]
+                if i >= len(chunks):
+                    return
+                next_i[0] += 1
+            a, b = chunks[i]
+            t0 = time.perf_counter()
+            try:
+                status, body = http_json(
+                    url, {"inputs": inputs[a:b].tolist()}, timeout_s)
+            except Exception as exc:  # transport-level failure
+                status, body = -1, {"error": f"{type(exc).__name__}: {exc}"}
+            records[i] = {
+                "rows": (a, b),
+                "status": status,
+                "latency_s": time.perf_counter() - t0,
+                "outputs": body.get("outputs"),
+                "reason": body.get("reason"),
+            }
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(min(concurrency, len(chunks)))]
+    for t in threads:
+        t.start()
+    t_wall = time.perf_counter()
+    start_gate.set()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - t_wall
+
+    lats = sorted(r["latency_s"] for r in records)
+    statuses: dict[str, int] = {}
+    for r in records:
+        statuses[str(r["status"])] = statuses.get(str(r["status"]), 0) + 1
+    ok_rows = sum(b - a for (a, b), r in
+                  ((r["rows"], r) for r in records) if r["status"] == 200)
+
+    def pct(p):
+        return lats[min(len(lats) - 1, int(p / 100.0 * len(lats)))]
+
+    return {
+        "records": records,
+        "n_requests": len(records),
+        "concurrency": len(threads),
+        "wall_s": round(wall, 4),
+        "requests_per_s": round(len(records) / wall, 2),
+        "rows_per_s": round(ok_rows / wall, 2),
+        "p50_ms": round(pct(50) * 1e3, 3),
+        "p99_ms": round(pct(99) * 1e3, 3),
+        "mean_ms": round(statistics.mean(lats) * 1e3, 3),
+        "statuses": statuses,
+    }
+
+
+def bench_row(base_url: str, kernel: str, load: dict) -> dict:
+    """BENCH-style JSON row: client-observed numbers + the server's own
+    accounting for cross-checking."""
+    m = fetch_metrics(base_url)
+    return {
+        "metric": f"serve_{kernel}",
+        "value": load["requests_per_s"],
+        "unit": "requests/sec",
+        "rows_per_s": load["rows_per_s"],
+        "n_requests": load["n_requests"],
+        "concurrency": load["concurrency"],
+        "p50_ms": load["p50_ms"],
+        "p99_ms": load["p99_ms"],
+        "mean_ms": load["mean_ms"],
+        "statuses": load["statuses"],
+        "batch_fill_ratio": m.get("batch_fill_ratio"),
+        "batches_total": m.get("batches_total"),
+        "compile_cache": m.get("compile_cache"),
+        "server_requests": m.get("requests"),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", default=None,
+                    help="base URL of a running server; omit with --conf "
+                    "to self-host one in-process")
+    ap.add_argument("--conf", default=None,
+                    help="nn.conf: self-host this kernel (and derive "
+                    "input dims + the kernel name from it)")
+    ap.add_argument("--kernel", default=None,
+                    help="kernel name (required with --url)")
+    ap.add_argument("--n-inputs", type=int, default=None,
+                    help="input width for random inputs (required with "
+                    "--url)")
+    ap.add_argument("--requests", type=int, default=256)
+    ap.add_argument("--rows", default="1",
+                    help="rows per request: int or comma list cycled "
+                    "(e.g. 3,5,7)")
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--timeout-s", type=float, default=60.0)
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON row to this path")
+    args = ap.parse_args()
+
+    sizes = [int(s) for s in str(args.rows).split(",")]
+    httpd = app = None
+    if args.conf:
+        from hpnn_tpu.serve.server import ServeApp, serve_in_thread
+
+        app = ServeApp()
+        model = app.add_model(args.conf, name=args.kernel)
+        if model is None:
+            print(json.dumps({"error": f"cannot load {args.conf}"}))
+            return 2
+        kernel, n_in = model.name, model.n_inputs
+        httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+        base_url = "http://127.0.0.1:%d" % httpd.server_address[1]
+    else:
+        if not args.url or not args.kernel or not args.n_inputs:
+            ap.error("--url requires --kernel and --n-inputs")
+        base_url, kernel, n_in = args.url, args.kernel, args.n_inputs
+
+    rng = np.random.default_rng(args.seed)
+    total_rows = sum(sizes[i % len(sizes)] for i in range(args.requests))
+    inputs = rng.uniform(-1.0, 1.0, (total_rows, n_in))
+    try:
+        load = run_load(base_url, kernel, inputs, rows_per_request=sizes,
+                        concurrency=args.concurrency,
+                        timeout_s=args.timeout_s)
+        row = bench_row(base_url, kernel, load)
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+            app.close(drain=True)
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(json.dumps(row) + "\n")
+    bad = sum(n for s, n in load["statuses"].items()
+              if s not in ("200", "429"))
+    return 1 if bad else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

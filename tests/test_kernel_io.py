@@ -169,3 +169,62 @@ def test_load_kernel_reference_error_messages(tmp_path, capsys):
     err = capsys.readouterr().err
     assert "NN(ERR): kernel read: neuron inconsistent input number!\n" in err
     assert "NN(ERR): -> n_input=4 (expected 3)!\n" in err
+
+
+def test_load_kernel_large_layer_allocates_densely(tmp_path, capsys):
+    """The old 2^20 weight-count gate silently returned None for real
+    kernels, e.g. a 784x1338 hidden layer (ADVICE high).  Counts below
+    2^31 now allocate densely (calloc/overcommit, untouched pages are
+    free); only a genuinely infeasible claim falls back to _SparseFlat
+    and fails WITH a diagnostic."""
+    from hpnn_tpu.io.kernel_io import load_kernel
+
+    n_in, n_hid, n_out = 784, 1338, 4  # 784*1338 = 1_048_992 > 2^20
+    lines = [f"[name] big\n[param] {n_in} {n_hid} {n_out}\n"
+             f"[input] {n_in}\n[hidden 1] {n_hid}\n"]
+    # declared section with only the first neuron written: the reference
+    # leaves unwritten rows at calloc-zero only if the block count short-
+    # circuits, so write every neuron header with a short values line
+    for j in range(n_hid):
+        lines.append(f"[neuron {j + 1}] {n_in}\n0.5\n")
+    lines.append(f"[output] {n_out}\n")
+    for j in range(n_out):
+        lines.append(f"[neuron {j + 1}] {n_hid}\n0.25\n")
+    p = tmp_path / "big.opt"
+    p.write_text("".join(lines))
+    k = load_kernel(str(p))
+    assert k is not None
+    assert k.weights[0].shape == (n_hid, n_in)
+    assert k.weights[1].shape == (n_out, n_hid)
+    assert k.weights[0][0, 0] == 0.5 and k.weights[1][0, 0] == 0.25
+    # short value lines zero-fill
+    assert k.weights[0][0, 1] == 0.0
+
+
+def test_load_kernel_infeasible_layer_diagnostic(tmp_path, capsys):
+    """A >=2^31 weight claim cannot complete; it must fail with a
+    diagnostic naming the layer, not a bare silent None."""
+    from hpnn_tpu.io.kernel_io import load_kernel
+
+    p = tmp_path / "huge.opt"
+    p.write_text("[name] h\n[param] 1048576 4096 2\n[input] 1048576\n")
+    assert load_kernel(str(p)) is None
+    err = capsys.readouterr().err
+    assert "too large to allocate" in err
+
+
+def test_load_kernel_superscript_digit_not_fatal(tmp_path, capsys):
+    """latin-1 0xB2 in a corrupt kernel file: C ISDIGIT rejects it, so
+    the digit-prefix parse must stop there instead of feeding int() a
+    Unicode digit (ValueError crash with str.isdigit)."""
+    from hpnn_tpu.io.kernel_io import load_kernel
+
+    p = tmp_path / "sup.opt"
+    p.write_bytes(b"[name] s\n[param] 2 2\xb2 2\n[input] 2\n"
+                  b"[hidden 1] 2\n"
+                  b"[neuron 1] 2\n 0.1 0.2\n[neuron 2] 2\n 0.3 0.4\n"
+                  b"[output] 2\n"
+                  b"[neuron 1] 2\n 0.5 0.6\n[neuron 2] 2\n 0.7 0.8\n")
+    k = load_kernel(str(p))  # '2<B2>' parses as 2: load succeeds
+    assert k is not None
+    np.testing.assert_allclose(k.weights[0], [[0.1, 0.2], [0.3, 0.4]])

@@ -597,6 +597,7 @@ def test_bf16_composes_with_parallel_knobs(tmp_path, capsys, extra,
     assert all(np.isfinite(w).all() for w in nn.kernel.weights)
 
 
+@pytest.mark.slow  # ~5 min on the 1-core CPU mesh; `make check-all` runs it
 def test_tp_train_epoch_adaptive_chunks_parity(monkeypatch):
     """The TP epoch's ADAPTIVE launch sizing (HPNN_EPOCH_CHUNK unset on
     TPU) must be trajectory-exact vs the single-device epoch.  Forced on

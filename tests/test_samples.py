@@ -129,3 +129,38 @@ def test_read_sample_corrupt_byte_is_not_fatal(tmp_path):
     vin, vout = read_sample(str(p))
     np.testing.assert_allclose(vin, [1.0, 3.0, 0.0])
     np.testing.assert_allclose(vout, [1.0, -1.0])
+
+    # latin-1 superscript digits (0xB2 = '2-superscript') pass Python's
+    # str.isdigit but blow up int(); C ISDIGIT rejects them, so a count
+    # like '3<B2>' must read 3 (digit-prefix stops at the superscript,
+    # which is >0x7E and non-graphic -> skipped like a blank in the
+    # values line), never raise ValueError
+    p = tmp_path / "corrupt_b2"
+    p.write_bytes(b"[input] 3\xb2\n1 2 3\n[output] 2\n1.0 -1.0\n")
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(vout, [1.0, -1.0])
+
+    # a count that IS a bare superscript digit is not ISDIGIT at all:
+    # graceful read-failure path, not a crash
+    p = tmp_path / "corrupt_b2_only"
+    p.write_bytes(b"[input] \xb2\n1 2\n[output] 2\n1.0 -1.0\n")
+    assert read_sample(str(p)) == (None, None)
+
+
+def test_section_count_saturates_like_strtoull(tmp_path):
+    """GET_UINT is (UINT)strtoull: 64-bit saturation then 32-bit
+    truncation -- the SAME rule kernel_io._uint applies, so the two
+    parsers agree with the reference on absurd counts.  2^32+3 truncates
+    to count 3 (the reference would alloc 3 and read on)."""
+    p = tmp_path / "wrap"
+    p.write_text(f"[input] {2**32 + 3}\n1 2 3\n[output] 2\n1.0 -1.0\n")
+    vin, vout = read_sample(str(p))
+    np.testing.assert_allclose(vin, [1.0, 2.0, 3.0])
+    np.testing.assert_allclose(vout, [1.0, -1.0])
+
+    # a 30-digit count saturates at 2^64-1, truncates to 2^32-1, and
+    # fails the _MAX_COUNT range check gracefully
+    p = tmp_path / "sat"
+    p.write_text(f"[input] {10**30}\n1 2\n[output] 2\n1.0 -1.0\n")
+    assert read_sample(str(p)) == (None, None)

@@ -1,0 +1,509 @@
+"""Serving subsystem: registry/batcher/metrics units + the end-to-end
+acceptance run.
+
+E2E (the ISSUE 1 acceptance criteria): a live ThreadingHTTPServer on an
+ephemeral port serving a tutorial-style kernel on CPU, >= 64 concurrent
+requests fired through scripts/serve_bench.py's client pool, asserting
+
+  (a) every response bit-matches the ``run_kernel`` batch path
+      (``ops.run_batch`` on the same float64 rows, same dtype cast),
+  (b) the compile cache records ZERO misses after warm-up across >= 3
+      different batch sizes inside one bucket,
+  (c) queue-full requests are rejected with the DISTINCT 429 status
+      immediately (not stalled), while admitted requests still answer,
+
+and the serve_bench BENCH-style JSON row carries p50/p99 + throughput.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import serve_bench  # noqa: E402
+
+from hpnn_tpu.serve import (  # noqa: E402
+    DeadlineExceeded,
+    LatencyHistogram,
+    MicroBatcher,
+    ModelRegistry,
+    QueueFull,
+    ServeApp,
+    ServeClosed,
+    ServeMetrics,
+)
+from hpnn_tpu.serve.registry import bucket_rows  # noqa: E402
+from hpnn_tpu.serve.server import serve_in_thread  # noqa: E402
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+
+
+def _write_kernel_conf(tmp_path, name="tiny", dtype=None):
+    """Generate + dump a kernel, then a run_nn-style conf that loads it
+    (the tutorial checkpoint workflow: train writes kernel.opt, serving
+    loads it).  Returns the RELOADED kernel: the %17.15f text round trip
+    quantizes weights, and run_nn serves the on-disk values -- parity
+    must be asserted against what both sides actually load."""
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path, load_kernel
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(1234, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(kern, kpath)
+    kern = load_kernel(kpath)
+    conf = tmp_path / f"{name}.conf"
+    text = (f"[name] {name}\n[type] ANN\n[init] {kpath}\n[seed] 1\n"
+            "[train] BP\n")
+    if dtype:
+        text += f"[dtype] {dtype}\n"
+    conf.write_text(text)
+    return str(conf), kern
+
+
+# --- metrics ----------------------------------------------------------------
+
+def test_latency_histogram_percentiles():
+    h = LatencyHistogram()
+    for ms in range(1, 101):  # 1..100 ms uniform
+        h.observe(ms / 1e3)
+    assert h.count == 100
+    # log-bucketed: estimates carry ~26% bucket width, assert loosely
+    assert 0.040 <= h.percentile(50) <= 0.080
+    assert 0.090 <= h.percentile(99) <= 0.160
+    snap = h.snapshot()
+    assert snap["count"] == 100 and snap["p99_ms"] >= snap["p50_ms"]
+
+
+def test_metrics_render_both_formats():
+    m = ServeMetrics()
+    m.count_request("ok")
+    m.count_request("queue_full")
+    m.count_batch(rows=6, bucket=8)
+    m.count_cache(hit=False)
+    m.count_cache(hit=True)
+    m.register_queue("k", lambda: 3)
+    prom = m.render_prometheus()
+    assert 'hpnn_serve_requests_total{outcome="ok"} 1' in prom
+    assert 'hpnn_serve_requests_total{outcome="queue_full"} 1' in prom
+    assert 'hpnn_serve_queue_depth{kernel="k"} 3' in prom
+    snap = json.loads(m.render_json())
+    assert snap["compile_cache"] == {"hits": 1, "misses": 1}
+    assert snap["batch_fill_ratio"] == 0.75
+    assert snap["queue_depth"] == {"k": 3}
+
+
+# --- registry ---------------------------------------------------------------
+
+def test_bucket_rows_power_of_two():
+    assert [bucket_rows(r, 64) for r in (1, 2, 3, 5, 8, 9, 63, 64)] == \
+        [1, 2, 4, 8, 8, 16, 64, 64]
+
+
+def test_registry_cache_bounded_by_buckets(tmp_path):
+    conf, _ = _write_kernel_conf(tmp_path)
+    reg = ModelRegistry(max_batch=8)
+    model = reg.register_conf(conf)
+    assert model is not None and model.name == "tiny"
+    assert model.topology == (N_IN, N_HID, N_OUT)
+    # 3 batch sizes inside the 8-bucket -> ONE compile-cache entry
+    for rows in (5, 6, 7):
+        out = model.infer(np.zeros((rows, N_IN)))
+        assert out.shape == (rows, N_OUT)
+    st = reg.cache_stats()
+    assert st == {"entries": 1, "misses": 1, "hits": 2}
+    # warmup covers every bucket; everything after is a hit
+    model.warmup()
+    misses = reg.metrics.cache_misses
+    assert misses == 4  # buckets 1, 2, 4, 8
+    for rows in (1, 2, 3, 4, 8):
+        model.infer(np.zeros((rows, N_IN)))
+    assert reg.metrics.cache_misses == misses
+
+
+def test_registry_matches_run_kernel_batch_path(tmp_path):
+    """The serving forward IS the run_kernel eval pipeline: same dtype
+    cast, same batched GEMM chain, float64 out -- bitwise, including
+    when the batch is padded to the bucket."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu import ops
+
+    conf, kern = _write_kernel_conf(tmp_path)
+    reg = ModelRegistry(max_batch=16)
+    model = reg.register_conf(conf)
+    rng = np.random.default_rng(7)
+    xs = rng.uniform(-1, 1, (11, N_IN))
+    weights = tuple(jnp.asarray(w, dtype=jnp.float64)
+                    for w in kern.weights)
+    ref = np.asarray(ops.run_batch(weights, jnp.asarray(xs), "ANN"),
+                     dtype=np.float64)
+    got = model.infer(xs)  # 11 rows pad to the 16-bucket
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_registry_unknown_conf_returns_none(tmp_path, capsys):
+    reg = ModelRegistry()
+    assert reg.register_conf(str(tmp_path / "missing.conf")) is None
+
+
+# --- batcher ----------------------------------------------------------------
+
+class _EchoModel:
+    """Registry-free stand-in: infer returns row sums, records batches."""
+
+    class _Reg:
+        def __init__(self, max_batch):
+            self.max_batch = max_batch
+            self.metrics = ServeMetrics()
+
+    def __init__(self, max_batch=8, delay_s=0.0):
+        self.name = "echo"
+        self.registry = self._Reg(max_batch)
+        self.delay_s = delay_s
+        self.batches = []
+
+    def infer(self, xs):
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        self.batches.append(xs.shape[0])
+        return xs.sum(axis=1, keepdims=True)
+
+
+def test_batcher_coalesces_concurrent_requests():
+    model = _EchoModel(max_batch=8, delay_s=0.02)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=64)
+    b.pause()
+    outs = {}
+
+    def client(i):
+        x = np.full((1, 4), float(i))
+        outs[i] = b.submit(x, timeout_s=10.0)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for _ in range(100):
+        if b.depth() == 6:
+            break
+        time.sleep(0.01)
+    assert b.depth() == 6
+    b.resume()
+    for t in threads:
+        t.join()
+    for i in range(6):
+        np.testing.assert_array_equal(outs[i], [[4.0 * i]])
+    # all six single-row requests coalesced into ONE launch
+    assert model.batches == [6]
+    b.close()
+
+
+def test_batcher_queue_full_rejects_immediately():
+    model = _EchoModel(max_batch=4)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=4)
+    b.pause()
+    holders = [threading.Thread(
+        target=lambda: b.submit(np.zeros((1, 2)), 5.0)) for _ in range(4)]
+    for t in holders:
+        t.start()
+    for _ in range(100):
+        if b.depth() == 4:
+            break
+        time.sleep(0.01)
+    t0 = time.monotonic()
+    with pytest.raises(QueueFull):
+        b.submit(np.zeros((1, 2)), 5.0)
+    assert time.monotonic() - t0 < 1.0  # immediate, not queued-then-late
+    b.resume()
+    for t in holders:
+        t.join()
+    b.close()
+
+
+def test_batcher_deadline_expires_without_compute():
+    model = _EchoModel(max_batch=4)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=16)
+    b.pause()
+    results = []
+
+    def client():
+        try:
+            b.submit(np.zeros((1, 2)), timeout_s=0.05)
+            results.append("ok")
+        except DeadlineExceeded:
+            results.append("deadline")
+
+    t = threading.Thread(target=client)
+    t.start()
+    time.sleep(0.3)  # let the deadline lapse while paused
+    b.resume()
+    t.join()
+    assert results == ["deadline"]
+    assert model.batches == []  # never dispatched to the device
+    b.close()
+
+
+def test_batcher_graceful_drain():
+    model = _EchoModel(max_batch=2, delay_s=0.02)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=64)
+    b.pause()
+    outs = []
+    threads = [threading.Thread(
+        target=lambda: outs.append(b.submit(np.ones((1, 2)), 10.0)))
+        for _ in range(6)]
+    for t in threads:
+        t.start()
+    for _ in range(100):
+        if b.depth() == 6:
+            break
+        time.sleep(0.01)
+    b.resume()
+    b.close(drain=True)  # stops admission, finishes the queue
+    for t in threads:
+        t.join()
+    assert len(outs) == 6  # nothing admitted was dropped
+    with pytest.raises(ServeClosed):
+        b.submit(np.ones((1, 2)), 1.0)
+
+
+# --- HTTP end-to-end --------------------------------------------------------
+
+@pytest.fixture()
+def served(tmp_path):
+    """ServeApp + live HTTP server on an ephemeral port, tiny kernel."""
+    conf, kern = _write_kernel_conf(tmp_path)
+    # queue capacity admits the e2e's 64 fully-concurrent requests (up
+    # to 7 rows each); the queue-full test lowers it on its own batcher
+    app = ServeApp(max_batch=16, max_queue_rows=512)
+    model = app.add_model(conf, warmup=True)
+    assert model is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    yield base, app, model, kern
+    httpd.shutdown()
+    app.close(drain=True)
+
+
+def test_healthz_and_metrics_endpoints(served):
+    base, app, model, _ = served
+    status, body = serve_bench.http_json(base + "/healthz")
+    assert status == 200 and body["status"] == "ok"
+    assert body["kernels"] == ["tiny"]
+    with urllib.request.urlopen(base + "/metrics") as resp:
+        text = resp.read().decode()
+    assert "hpnn_serve_compile_cache_total" in text
+    m = serve_bench.fetch_metrics(base)
+    assert m["compile_cache"]["misses"] == 5  # warmed buckets 1..16
+    assert m["queue_depth"] == {"tiny": 0}
+
+
+def test_http_error_statuses(served):
+    base, app, model, _ = served
+    status, body = serve_bench.http_json(
+        base + "/v1/kernels/nope/infer", {"inputs": [[0.0] * N_IN]})
+    assert status == 404 and body["reason"] == "not_found"
+    status, body = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer", {"inputs": [[1.0, 2.0]]})
+    assert status == 400 and body["reason"] == "bad_request"
+    status, _ = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer",
+        {"inputs": np.zeros((17, N_IN)).tolist()})  # > max_batch rows
+    assert status == 400
+
+
+def test_e2e_concurrent_load_bit_parity_and_steady_state(served):
+    """The acceptance run: >= 64 concurrent requests via serve_bench,
+    bit-parity vs ops.run_batch, 0 compile-cache misses after warm-up
+    across >= 3 batch sizes in one bucket, BENCH row with p50/p99."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu import ops
+
+    base, app, model, kern = served
+    misses_after_warmup = app.metrics.cache_misses
+
+    rng = np.random.default_rng(3)
+    sizes = [3, 5, 7]  # 3 batch sizes, all inside the 8-bucket
+    n_requests = 64
+    total_rows = sum(sizes[i % 3] for i in range(n_requests))
+    inputs = rng.uniform(-1, 1, (total_rows, N_IN))
+
+    load = serve_bench.run_load(base, "tiny", inputs,
+                                rows_per_request=sizes, concurrency=64,
+                                timeout_s=60.0)
+    assert load["n_requests"] == n_requests
+    assert load["statuses"] == {"200": n_requests}
+
+    # (a) bitwise parity with the run_kernel batch path on the SAME rows
+    weights = tuple(jnp.asarray(w, dtype=jnp.float64)
+                    for w in kern.weights)
+    ref = np.asarray(ops.run_batch(weights, jnp.asarray(inputs), "ANN"),
+                     dtype=np.float64)
+    for r in load["records"]:
+        a, b = r["rows"]
+        got = np.asarray(r["outputs"], dtype=np.float64)
+        np.testing.assert_array_equal(got, ref[a:b])
+
+    # (b) steady state never recompiled: zero new misses across the run
+    m = serve_bench.fetch_metrics(base)
+    assert m["compile_cache"]["misses"] == misses_after_warmup
+    assert m["compile_cache"]["hits"] > 0
+    assert m["batches_total"] >= 1
+    assert 0.0 < m["batch_fill_ratio"] <= 1.0
+
+    # BENCH-style row: throughput + latency percentiles present
+    row = serve_bench.bench_row(base, "tiny", load)
+    assert row["unit"] == "requests/sec" and row["value"] > 0
+    assert row["p50_ms"] > 0 and row["p99_ms"] >= row["p50_ms"]
+    assert row["compile_cache"]["misses"] == misses_after_warmup
+
+
+def test_e2e_queue_full_distinct_status(served):
+    """(c) with dispatch held and the queue capacity lowered, a burst
+    must split into admitted requests (answered after resume) and 429
+    queue_full rejections -- rejected IMMEDIATELY, nothing stalls."""
+    base, app, model, kern = served
+    batcher = app.batchers["tiny"]
+    batcher.max_queue_rows = 8
+    batcher.pause()
+    rng = np.random.default_rng(5)
+    inputs = rng.uniform(-1, 1, (24, N_IN))
+    done = {}
+
+    def fire(i):
+        t0 = time.perf_counter()
+        status, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer",
+            {"inputs": inputs[i:i + 1].tolist(), "timeout_ms": 30000})
+        done[i] = (status, time.perf_counter() - t0, body.get("reason"))
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    # rejections must land while dispatch is STILL paused: wait for the
+    # queue to fill and the overflow to come back, then resume
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if sum(1 for s, _, _ in done.values() if s == 429) >= 16:
+            break
+        time.sleep(0.02)
+    rejected_while_paused = [i for i, (s, dt, _) in done.items()
+                             if s == 429]
+    batcher.resume()
+    for t in threads:
+        t.join()
+    statuses = [done[i][0] for i in range(24)]
+    assert statuses.count(200) == 8  # exactly the admitted capacity
+    assert statuses.count(429) == 16
+    assert len(rejected_while_paused) == 16  # rejects did NOT stall
+    for i, (s, dt, reason) in done.items():
+        if s == 429:
+            assert dt < 5.0 and reason == "queue_full"
+    m = serve_bench.fetch_metrics(base)
+    assert m["requests"]["queue_full"] == 16
+    batcher.max_queue_rows = 64
+
+
+def test_serve_drain_on_close(tmp_path):
+    """close(drain=True): in-flight work answers, new work gets 503."""
+    conf, _ = _write_kernel_conf(tmp_path, name="d")
+    app = ServeApp(max_batch=8, max_queue_rows=16)
+    app.add_model(conf, warmup=False)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    status, _ = serve_bench.http_json(
+        base + "/v1/kernels/d/infer", {"input": [0.0] * N_IN})
+    assert status == 200
+    app.close(drain=True)
+    status, body = serve_bench.http_json(
+        base + "/v1/kernels/d/infer", {"input": [0.0] * N_IN})
+    assert status == 503
+    httpd.shutdown()
+
+
+def test_serve_bench_cli_self_hosted(tmp_path, capsys, monkeypatch):
+    """The CLI path: self-host from a conf, emit ONE JSON row."""
+    conf, _ = _write_kernel_conf(tmp_path, name="cli")
+    out_path = str(tmp_path / "SERVE_BENCH.json")
+    monkeypatch.setattr(sys, "argv", [
+        "serve_bench.py", "--conf", conf, "--requests", "32",
+        "--rows", "2,3", "--concurrency", "8", "--out", out_path])
+    rc = serve_bench.main()
+    assert rc == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["metric"] == "serve_cli" and row["value"] > 0
+    assert row["statuses"] == {"200": 32}
+    assert json.loads(open(out_path).read())["metric"] == "serve_cli"
+
+
+def test_serve_nn_main_bad_conf(tmp_path, capsys):
+    """CLI wiring: an unloadable conf aborts with rc -1 before any
+    socket is bound."""
+    from hpnn_tpu import cli
+
+    rc = cli.serve_nn_main([str(tmp_path / "missing.conf")])
+    assert rc == -1
+    assert "no kernel could be registered" in capsys.readouterr().err
+
+
+def test_registry_non_pow2_max_batch_normalized(tmp_path):
+    """serve_nn -b 48: the bucket cap rounds up to a power of two, so
+    warmup's doubling walk and bucket_rows stay inside the cap (review
+    finding: warmup used to assert out at startup)."""
+    conf, _ = _write_kernel_conf(tmp_path)
+    reg = ModelRegistry(max_batch=48)
+    assert reg.max_batch == 64
+    model = reg.register_conf(conf)
+    assert model.warmup() == 7  # buckets 1..64
+    assert bucket_rows(40, reg.max_batch) == 64
+
+
+def test_add_model_name_collision_rejected(tmp_path, capsys):
+    """Two confs resolving to one name: the second registration fails
+    loudly instead of silently rerouting the first kernel's traffic."""
+    conf, _ = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    first = app.add_model(conf, warmup=False)
+    assert first is not None
+    assert app.add_model(conf, warmup=False) is None
+    assert "already registered" in capsys.readouterr().err
+    assert app.registry.get("tiny") is first  # original still serves
+    app.close()
+
+
+def test_keep_alive_connection_survives_error_replies(served):
+    """HTTP/1.1 keep-alive: an error reply must still drain the request
+    body, or the unread bytes desync the next request on the connection
+    (review finding)."""
+    import http.client
+
+    base, app, model, _ = served
+    host, port = base.replace("http://", "").split(":")
+    conn = http.client.HTTPConnection(host, int(port), timeout=30)
+    body = json.dumps({"inputs": [[0.0] * N_IN]})
+    # request 1: POST with a body to a bad route -> 404, body drained
+    conn.request("POST", "/v1/kernels/tiny/inferr", body=body,
+                 headers={"Content-Type": "application/json"})
+    r1 = conn.getresponse()
+    assert r1.status == 404
+    r1.read()
+    # request 2 on the SAME connection must parse cleanly
+    conn.request("POST", "/v1/kernels/tiny/infer", body=body,
+                 headers={"Content-Type": "application/json"})
+    r2 = conn.getresponse()
+    assert r2.status == 200
+    assert len(json.loads(r2.read())["outputs"]) == 1
+    conn.close()
