@@ -39,8 +39,14 @@ native:
 bench:
 	python bench.py
 
+# load-generates against a self-hosted fast-parity server AND emits the
+# strict-vs-fast-vs-mesh comparison (single-device + sharded rows in one
+# JSON line; --mesh -1 shards over every local device, so the same
+# target captures a chip topology or the virtual CPU mesh)
 serve-bench:
 	python scripts/serve_bench.py --conf nn.conf --requests 256 \
-	    --rows 3,5,7 --concurrency 16 --out SERVE_BENCH.json
+	    --rows 3,5,7 --concurrency 16 --parity fast \
+	    --fast-threshold 256 --max-batch 512 --mesh -1 \
+	    --compare-buckets 256,512 --out SERVE_BENCH.json
 
 .PHONY: check check-all serve-check native bench serve-bench

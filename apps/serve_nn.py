@@ -2,11 +2,15 @@
 """serve_nn -- long-lived inference server for trained hpnn kernels.
 
 Usage: serve_nn [-v]... [-a addr] [-p port] [-b max-batch] [-q queue-rows]
-                [--linger-ms N] [--timeout-s N] [--no-warmup]
+                [--linger-ms N] [--timeout-s N]
+                [--parity strict|fast] [--fast-threshold N] [--mesh N]
+                [--compile-cache DIR]
+                [--warmup-mode background|sync|off] [--no-warmup]
                 [conf (default ./nn.conf)]...
 
 Takes the same nn.conf files as run_nn; see hpnn_tpu/serve/ and the
-README "Serving" section for endpoints and backpressure semantics.
+README "Serving" section (incl. "Throughput vs parity") for endpoints,
+backpressure semantics, and the parity/mesh policy knobs.
 """
 import os
 import sys

@@ -501,7 +501,7 @@ def _probe_backend(max_wait_s: int = 900, attempt_timeout_s: int = 120,
         _time.sleep(min(backoff_s, remaining))
 
 
-def main() -> None:
+def main() -> int:
     parser = argparse.ArgumentParser()
     parser.add_argument("--only", default=None,
                         help="run a single config by name prefix")
@@ -650,16 +650,25 @@ def main() -> None:
     marker = pathlib.Path(__file__).resolve().parent / "BENCH_FALLBACK.json"
     if fallback:
         # a CPU capture must never masquerade as the round's chip number:
-        # leave a marker file next to the driver's BENCH_rNN.json and exit
-        # non-zero so automation notices even if it ignores the flag
+        # leave a marker file next to the driver's BENCH_rNN.json.  The
+        # honesty signals are the tpu_unreachable flag and the marker --
+        # NOT the exit code: round 5 exited 3 here and the harness
+        # recorded the whole (successful, honestly-flagged) run as
+        # "parsed": null.  A run that measured its workloads and printed
+        # its one JSON line is a SUCCESS and exits 0; the exit code only
+        # reports whether the benchmark itself ran.
         marker.write_text(json.dumps(out) + "\n")
-        sys.exit(3)
-    if not explicit_cpu:
+    elif not explicit_cpu:
         # a real CHIP capture clears any stale marker from an earlier
         # wedged run; a deliberate JAX_PLATFORMS=cpu sanity pass proves
         # nothing about the tunnel and must leave the marker alone
         marker.unlink(missing_ok=True)
+    ran = [r for r in records if "value" in r and "error" not in r]
+    # rc=1 only when NOTHING was measured (bad --only filter, or every
+    # config raised): the JSON line is still printed so the failure is
+    # diagnosable from stdout alone
+    return 0 if ran else 1
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

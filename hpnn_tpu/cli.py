@@ -212,9 +212,34 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     "0: dispatch immediately)")
     ap.add_argument("--timeout-s", type=float, default=30.0,
                     help="default per-request deadline (default 30)")
+    ap.add_argument("--parity", choices=("strict", "fast"),
+                    default="strict",
+                    help="serving tier: 'strict' answers bit-identically "
+                    "to run_nn (default); 'fast' routes buckets >= "
+                    "--fast-threshold to the GEMM/sharded throughput "
+                    "path (dtype-accurate, ULP-level batch-shape "
+                    "variation)")
+    ap.add_argument("--fast-threshold", type=int, default=256,
+                    help="smallest batch bucket the 'fast' parity tier "
+                    "applies to (default 256; smaller buckets keep the "
+                    "strict path)")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="shard 'fast' buckets over N devices on a data "
+                    "mesh (0: single device; -1: all local devices; "
+                    "capped to what is available)")
+    ap.add_argument("--compile-cache", default=None, metavar="DIR",
+                    help="persistent JAX compilation cache directory: "
+                    "restart warmup reloads compiled buckets instead of "
+                    "recompiling them")
+    ap.add_argument("--warmup-mode",
+                    choices=("background", "sync", "off"),
+                    default="background",
+                    help="bucket pre-compilation: 'background' (default) "
+                    "binds the socket immediately and reports 'warming' "
+                    "on /healthz until the compile cache is hot; 'sync' "
+                    "warms before binding; 'off' skips warmup")
     ap.add_argument("--no-warmup", action="store_true",
-                    help="skip pre-compiling the batch buckets at "
-                    "startup (first requests then pay the compiles)")
+                    help="alias for --warmup-mode off")
     args = ap.parse_args(argv)
 
     from .serve.server import ServeApp, make_server
@@ -225,14 +250,23 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
     with phase("init_all"):
         runtime.init_all(nn_log.get_verbosity())
     nn_log.set_verbosity(args.verbose)
+    if args.compile_cache:
+        # explicit flag: wins over HPNN_* env defaults applied by
+        # init_all, so restart warmup hits the on-disk cache
+        runtime.enable_compilation_cache(args.compile_cache)
+    warmup_mode = "off" if args.no_warmup else args.warmup_mode
     app = ServeApp(max_batch=args.max_batch,
                    max_queue_rows=args.queue_rows,
                    linger_s=args.linger_ms / 1e3,
-                   default_timeout_s=args.timeout_s)
+                   default_timeout_s=args.timeout_s,
+                   parity=args.parity,
+                   fast_threshold=args.fast_threshold,
+                   mesh_devices=(None if args.mesh < 0 else args.mesh))
     n_ok = 0
     for conf in args.confs:
         with phase("register"):
-            model = app.add_model(conf, warmup=not args.no_warmup)
+            model = app.add_model(conf, warmup=warmup_mode != "off",
+                                  background=warmup_mode == "background")
         if model is None:
             sys.stderr.write(
                 f"FAILED to load NN configuration file {conf}! "

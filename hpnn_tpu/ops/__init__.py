@@ -69,20 +69,43 @@ def select_train_epoch(dtype=None):
     return train_epoch, "xla"
 
 
-def select_run_batch(dtype=None):
+def select_run_batch(dtype=None, parity="strict"):
     """Pick the batched-inference implementation (run_kernel's eval path).
 
-    The Pallas fused linear+activation kernels (the ``fw_mv_acc`` analog,
-    ``/root/reference/src/cuda_ann.cu:77-86,538-577``) serve f32/bf16 on
-    TPU; the XLA ``run_batch`` (a scanned per-row GEMV chain -- row
-    results bit-independent of batch composition, see its docstring)
-    serves fp64 parity and other backends.  Returns ``(fn, name)`` with
-    fn call-compatible with ``run_batch(weights, xs, kind)``.
+    Two-axis tiering:
+
+    * ``parity="strict"`` (default) -- the bit-parity tier.  The XLA
+      ``run_batch`` (a scanned per-row GEMV chain -- row results
+      bit-independent of batch composition, see its docstring) serves
+      fp64 parity and other backends; on TPU f32/bf16 the Pallas fused
+      linear+activation kernels (the ``fw_mv_acc`` analog,
+      ``/root/reference/src/cuda_ann.cu:77-86,538-577``) take over (the
+      strict guarantee is CPU/f64-scoped, ROADMAP).
+    * ``parity="fast"`` -- the throughput tier.  TPU f32/bf16 keeps the
+      Pallas path; everything else gets the ``batched_forward`` GEMM
+      chain (one (S, M) @ (M, N) matmul per layer, ~2x the GEMV scan),
+      donated-input jitted on accelerator backends so XLA can reuse the
+      padded batch buffer.  Row results are dtype-accurate but may
+      differ from the strict tier at the ULP level depending on batch
+      shape -- the serving registry exposes the trade-off per model.
+
+    Returns ``(fn, name)`` with fn call-compatible with
+    ``run_batch(weights, xs, kind)``.
     """
+    if parity not in ("strict", "fast"):
+        raise ValueError(f"parity must be 'strict' or 'fast': {parity!r}")
     if _use_pallas(dtype):
         from .pallas_kernels import batched_forward_pallas_jit
 
         return batched_forward_pallas_jit, "pallas"
+    if parity == "fast":
+        import jax
+
+        from .convergence import run_batch_gemm, run_batch_gemm_donated
+
+        if jax.default_backend() != "cpu":
+            return run_batch_gemm_donated, "gemm"
+        return run_batch_gemm, "gemm"
     return run_batch, "xla"
 
 

@@ -58,6 +58,7 @@ from .steps import (
     MAX_BPM_ITER,
     MIN_BP_ITER,
     MIN_BPM_ITER,
+    batched_forward,
     bp_learn_rate,
     bpm_learn_rate,
     error,
@@ -185,6 +186,22 @@ def run_batch(weights, xs, kind: str):
     from .steps import forward
 
     return lax.map(lambda x: forward(weights, x, kind)[-1], xs)
+
+
+# The GEMM-chain siblings of ``run_batch``: the whole (S, n) set as
+# (S, M) @ (M, N) matmuls (ops.steps.batched_forward), ~2x the scanned
+# GEMV chain on CPU and MXU-shaped on TPU.  Row results are correct to
+# dtype accuracy but NOT bit-stable across batch shapes (XLA picks the
+# contraction split per shape -- see run_batch's docstring), which is why
+# serving exposes them behind the explicit ``fast`` parity policy only.
+# The donated variant lets XLA reuse the padded input buffer's memory
+# inside the computation (serving dispatches a fresh padded buffer per
+# batch); donation is a no-op warning on CPU, so ``select_run_batch``
+# only hands it out on accelerator backends.
+run_batch_gemm = jax.jit(batched_forward, static_argnames=("kind",))
+run_batch_gemm_donated = jax.jit(batched_forward,
+                                 static_argnames=("kind",),
+                                 donate_argnums=(1,))
 
 
 # Max samples per device launch on TPU.  The axon TPU runtime kills any

@@ -1,5 +1,6 @@
 from .dp import (
     batched_grads,
+    dp_eval_batch,
     dp_shard,
     dp_train_epoch,
     dp_train_epoch_batched,
@@ -10,6 +11,7 @@ from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
     batch_sharding,
+    data_mesh,
     global_array,
     make_mesh,
     replicated,
@@ -28,11 +30,11 @@ from .tp import (
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS",
-    "make_mesh", "batch_sharding", "global_array", "replicated",
-    "row_sharding", "shard_weights",
+    "make_mesh", "data_mesh", "batch_sharding", "global_array",
+    "replicated", "row_sharding", "shard_weights",
     "tp_forward", "tp_forward_colsharded", "tp_forward_explicit",
     "tp_run_batch", "tp_run_batch_colsharded", "tp_train_epoch",
     "tp_train_sample",
-    "batched_grads", "dp_shard", "dp_train_epoch",
+    "batched_grads", "dp_eval_batch", "dp_shard", "dp_train_epoch",
     "dp_train_epoch_batched", "dp_train_step", "dp_train_step_momentum",
 ]

@@ -157,6 +157,25 @@ def dp_train_epoch(weights, xs, ts, kind: str, momentum: bool,
                                   lr, alpha=alpha, mesh=mesh)
 
 
+@functools.partial(jax.jit, static_argnames=("kind", "mesh"))
+def dp_eval_batch(weights, xs, kind: str, mesh=None):
+    """Sharded batched inference: the eval twin of the training epochs.
+
+    xs (S, n_in) -> outputs (S, n_out) through the same GEMM chain
+    ``ops.run_batch``'s throughput siblings use, with the batch rows
+    constrained to the mesh's data axis so every layer's (S, M) @ (M, N)
+    matmul runs as a local shard matmul with replicated weights -- no
+    collectives at all on the forward pass (weights are replicated, the
+    batch dimension is embarrassingly parallel).  This is what the
+    serving registry's ``fast``-parity buckets dispatch through when a
+    mesh is attached: the padded bucket splits over devices exactly the
+    way ``dp_train_epoch_batched`` splits training batches.
+    """
+    if mesh is not None:
+        xs = lax.with_sharding_constraint(xs, batch_sharding(mesh))
+    return steps.batched_forward(weights, xs, kind)
+
+
 def dp_shard(weights, xs, ts, mesh):
     """Place a batch and replicated weights on the mesh for DP: batch rows
     split over the data axis, weights everywhere."""

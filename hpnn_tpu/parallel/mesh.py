@@ -48,6 +48,34 @@ def make_mesh(n_data: int | None = None, n_model: int = 1,
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
+def data_mesh(n_devices: int | None = None) -> Mesh | None:
+    """A pure-data mesh for batch-sharded serving/eval, or None when the
+    request cannot shard (one device, or an explicit n_devices < 2).
+
+    ``n_devices=None`` takes every local device; an explicit count is
+    capped to what is available (a serve config asking for 8 on a
+    4-device host gets 4, not a startup failure -- the capacity knob is
+    advisory, the mesh is the truth).  The count is then FLOORED to a
+    power of two: serving buckets are powers of two and a bucket only
+    shards when the device count divides it, so a 6-device mesh would be
+    built and then never used -- 4 devices that actually shard beat 6
+    that silently do not.
+    """
+    avail = len(jax.devices())
+    n = avail if n_devices is None else min(int(n_devices), avail)
+    if n < 2:
+        return None
+    pow2 = 1 << (n.bit_length() - 1)
+    if pow2 != n:
+        from ..utils.nn_log import nn_warn
+
+        nn_warn(f"serve: data mesh floored from {n} to {pow2} devices "
+                "(power-of-two batch buckets only shard over "
+                "power-of-two device counts)\n")
+        n = pow2
+    return make_mesh(n_data=n, n_model=1)
+
+
 def row_sharding(mesh: Mesh) -> NamedSharding:
     """Weight-row sharding: each model-rank owns a row block of every
     layer, the reference's layout (``ann.c:913-926``)."""

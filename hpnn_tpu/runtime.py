@@ -90,23 +90,31 @@ def init_runtime() -> None:
     nn_log.set_verbosity(0)
 
 
-def enable_compilation_cache() -> None:
+def enable_compilation_cache(cache_dir: str | None = None) -> None:
     """Persistent on-disk compilation cache for every driver process.
 
     The tutorial workflow launches a FRESH process per training round
     (``tutorials/mnist/tutorial.bash`` round loop, mirroring the
     reference's), so without this every round re-pays jit + Mosaic
     compilation -- the dominant cold-round cost (VERDICT r2 "weak" 1).
-    Opt out with HPNN_NO_COMPILE_CACHE=1; relocate with HPNN_CACHE_DIR.
-    An explicit JAX_COMPILATION_CACHE_DIR (jax's own env var) wins.
+    The same cost dominates ``serve_nn`` restarts: every batch bucket
+    recompiles during warmup unless this cache persists across processes
+    (the CLI's ``--compile-cache DIR`` passes ``cache_dir`` explicitly).
+
+    An explicit ``cache_dir`` argument wins over everything, including
+    the HPNN_NO_COMPILE_CACHE opt-out (the caller typed a flag; honor
+    it).  Otherwise: opt out with HPNN_NO_COMPILE_CACHE=1; relocate with
+    HPNN_CACHE_DIR; an explicit JAX_COMPILATION_CACHE_DIR (jax's own env
+    var) wins over the HPNN default.
     """
-    if os.environ.get("HPNN_NO_COMPILE_CACHE"):
-        return
+    if cache_dir is None:
+        if os.environ.get("HPNN_NO_COMPILE_CACHE"):
+            return
+        if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
+            return  # jax already configured from its own env var
     import jax
 
-    if os.environ.get("JAX_COMPILATION_CACHE_DIR"):
-        return  # jax already configured from its own env var
-    cache_dir = os.environ.get("HPNN_CACHE_DIR") or os.path.join(
+    cache_dir = cache_dir or os.environ.get("HPNN_CACHE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "hpnn_tpu", "jax_cache")
     try:
         os.makedirs(cache_dir, exist_ok=True)

@@ -7,13 +7,16 @@ This package keeps the compiled state resident and feeds it full batches:
 
 * :mod:`registry`  -- loads kernels through the existing ``io.kernel_io``
   + ``api.configure`` path, keys them by name, and caches jitted
-  batched-forward callables per (topology, dtype, batch-bucket) so
-  steady-state requests never recompile;
+  batched-forward callables per (topology, dtype, batch-bucket, parity
+  tier) so steady-state requests never recompile; the ``parity`` policy
+  ({strict, fast}) decides whether big buckets keep the bit-parity GEMV
+  scan or ride the GEMM chain, optionally sharded over a device mesh;
 * :mod:`batcher`   -- a bounded micro-batching queue that coalesces
   concurrent requests into one device launch, pads to power-of-two batch
-  buckets (bounding the compile cache), enforces per-request deadlines,
-  rejects immediately when full (backpressure), and drains gracefully on
-  shutdown;
+  buckets (bounding the compile cache), pipelines dispatch (host padding
+  + H2D of the next batch overlaps device compute of the current one),
+  enforces per-request deadlines, rejects immediately when full
+  (backpressure), and drains gracefully on shutdown;
 * :mod:`server`    -- a stdlib-only HTTP front-end (``ThreadingHTTPServer``):
   ``POST /v1/kernels/<name>/infer``, ``GET /healthz``, ``GET /metrics``;
 * :mod:`metrics`   -- per-request latency histograms (p50/p99), queue

@@ -24,6 +24,14 @@ set into one GEMM chain"):
   (:class:`ServeClosed`), lets the worker finish everything already
   admitted, then joins the thread.  Nothing admitted is ever silently
   dropped.
+* **pipelined dispatch** -- the worker keeps ONE batch in flight on the
+  device while it pads + H2Ds the next (the registry's
+  ``dispatch``/``collect`` split, double-buffered by the scratch pool):
+  batch N+1's host work overlaps batch N's device compute, and the D2H
+  sync happens entirely off the queue lock.  Results are delivered in
+  dispatch order by construction (one worker, FIFO pops, depth-1
+  pipeline), so pipelining can never reorder responses -- asserted in
+  ``tests/test_serve.py``.
 
 One batcher (and one worker thread) per served model: batches must be
 model-homogeneous, and per-model FIFO keeps tail latency analyzable.
@@ -39,7 +47,7 @@ import numpy as np
 
 from ..utils.nn_log import nn_dbg, nn_warn
 from .metrics import ServeMetrics
-from .registry import ServedModel, bucket_rows
+from .registry import ServedModel
 
 
 class QueueFull(Exception):
@@ -140,9 +148,20 @@ class MicroBatcher:
         return p.result
 
     # --- worker ---------------------------------------------------------
+    def _pop_locked(self) -> list[_Pending]:
+        """Pop up to max_batch rows FIFO, never splitting a request.
+        Caller holds the lock."""
+        batch, rows = [], 0
+        while self._q and rows + self._q[0].rows <= self.max_batch:
+            p = self._q.popleft()
+            rows += p.rows
+            batch.append(p)
+        self._qrows -= rows
+        return batch
+
     def _take_batch(self) -> list[_Pending] | None:
-        """Pop up to max_batch rows of requests (FIFO, never splitting a
-        request); None when closing with an empty queue."""
+        """BLOCKING pop of up to max_batch rows of requests; None when
+        closing with an empty queue."""
         with self._cv:
             while True:
                 if self._q and not self._paused:
@@ -160,51 +179,99 @@ class MicroBatcher:
                     if remain <= 0:
                         break
                     self._cv.wait(timeout=remain)
-            batch, rows = [], 0
-            while self._q and rows + self._q[0].rows <= self.max_batch:
-                p = self._q.popleft()
-                rows += p.rows
-                batch.append(p)
-            self._qrows -= rows
-            return batch
+            return self._pop_locked()
+
+    def _take_batch_nowait(self) -> list[_Pending]:
+        """Non-blocking pop for the pipelined path (a batch is already in
+        flight): grab whatever is queued NOW -- possibly nothing --
+        without waiting on the device or the lingering window.  While the
+        device is busy, an unfilled linger window defers to the next
+        blocking take instead of spinning."""
+        with self._cv:
+            if not self._q or self._paused:
+                return []
+            if (self.linger_s > 0.0 and not self._closing
+                    and self._qrows < self.max_batch
+                    and time.monotonic() <
+                    self._q[0].t_enq + self.linger_s):
+                return []
+            return self._pop_locked()
+
+    def _dispatch(self, batch: list[_Pending]):
+        """Expire stale requests, pad + launch the rest asynchronously.
+        Returns (live, handle, t0) or None when nothing was dispatched.
+        Runs entirely OFF the queue lock."""
+        now = time.monotonic()
+        live: list[_Pending] = []
+        for p in batch:
+            if now > p.deadline:
+                p.error = DeadlineExceeded(
+                    f"expired {now - p.deadline:.3f}s before dispatch")
+                p.event.set()
+            else:
+                p.t_dispatch = now
+                live.append(p)
+        if not live:
+            return None
+        xs = (live[0].xs if len(live) == 1
+              else np.concatenate([p.xs for p in live]))
+        try:
+            handle = self.model.registry.dispatch(self.model, xs)
+        except Exception as exc:  # dispatch-time failure: fail the
+            # batch's requests, keep serving the next one
+            nn_warn(f"serve: batch dispatch failed for "
+                    f"'{self.model.name}': {exc}\n")
+            for p in live:
+                p.error = exc
+                p.event.set()
+            return None
+        return live, handle, now
+
+    def _complete(self, inflight) -> None:
+        """D2H-sync one in-flight batch and deliver its slices.  The
+        sync happens here, off the queue lock, AFTER the next batch was
+        already dispatched -- that ordering is the pipeline."""
+        live, handle, t0 = inflight
+        try:
+            outs = self.model.registry.collect(handle)
+        except Exception as exc:  # device/model failure surfaces at D2H
+            nn_warn(f"serve: batch failed for "
+                    f"'{self.model.name}': {exc}\n")
+            for p in live:
+                p.error = exc
+                p.event.set()
+            return
+        rows = sum(p.rows for p in live)
+        # batch counters fire on COMPLETION, not dispatch: a batch that
+        # dies at D2H must not inflate rows_total / fill ratio (PR-1
+        # ordering, preserved across the pipeline split)
+        self.metrics.count_batch(rows, handle.bucket)
+        self.metrics.count_device(rows, handle.bucket,
+                                  time.monotonic() - t0)
+        off = 0
+        for p in live:
+            p.result = outs[off:off + p.rows]
+            off += p.rows
+            self.metrics.queue_latency.observe(p.t_dispatch - p.t_enq)
+            p.event.set()
 
     def _loop(self) -> None:
+        """Depth-1 pipelined worker: dispatch batch N+1 (host padding +
+        H2D + async launch) BEFORE collecting batch N's result, so host
+        work overlaps device compute.  FIFO pops + in-order completion
+        mean responses can never be reordered."""
+        inflight = None
         while True:
-            batch = self._take_batch()
-            if batch is None:
-                return
-            now = time.monotonic()
-            live: list[_Pending] = []
-            for p in batch:
-                if now > p.deadline:
-                    p.error = DeadlineExceeded(
-                        f"expired {now - p.deadline:.3f}s before dispatch")
-                    p.event.set()
-                else:
-                    p.t_dispatch = now
-                    live.append(p)
-            if not live:
-                continue
-            rows = sum(p.rows for p in live)
-            try:
-                outs = self.model.infer(
-                    np.concatenate([p.xs for p in live]))
-                self.metrics.count_batch(
-                    rows, bucket_rows(rows, self.model.registry.max_batch))
-                off = 0
-                for p in live:
-                    p.result = outs[off:off + p.rows]
-                    off += p.rows
-                    self.metrics.queue_latency.observe(
-                        p.t_dispatch - p.t_enq)
-                    p.event.set()
-            except Exception as exc:  # device/model failure: fail the
-                # batch's requests, keep serving the next one
-                nn_warn(f"serve: batch failed for "
-                        f"'{self.model.name}': {exc}\n")
-                for p in live:
-                    p.error = exc
-                    p.event.set()
+            if inflight is None:
+                batch = self._take_batch()
+                if batch is None:
+                    return  # closing, queue drained, nothing in flight
+            else:
+                batch = self._take_batch_nowait()
+            nxt = self._dispatch(batch) if batch else None
+            if inflight is not None:
+                self._complete(inflight)
+            inflight = nxt
 
     # --- lifecycle ------------------------------------------------------
     def close(self, drain: bool = True, timeout_s: float = 30.0) -> None:

@@ -100,10 +100,15 @@ class ServeMetrics:
         self._lock = threading.Lock()
         self.latency = LatencyHistogram()        # whole-request wall
         self.queue_latency = LatencyHistogram()  # enqueue -> dispatch
+        self.device_time = LatencyHistogram()    # dispatch -> D2H complete
         self.requests = {k: 0 for k in _REQUEST_OUTCOMES}
         self.rows_total = 0
         self.batches_total = 0
         self._fill_sum = 0.0  # sum of (rows / bucket) per dispatched batch
+        # per-bucket accounting: bucket -> [batches, rows, device_seconds]
+        # (rows/sec per bucket is derived at render time, so the gauge can
+        # never drift from its own numerator/denominator)
+        self._buckets: dict[int, list] = {}
         self.cache_hits = 0
         self.cache_misses = 0
         self._depth_fns: dict[str, Callable[[], int]] = {}
@@ -118,6 +123,21 @@ class ServeMetrics:
             self.batches_total += 1
             self.rows_total += rows
             self._fill_sum += rows / float(bucket)
+
+    def count_device(self, rows: int, bucket: int, seconds: float) -> None:
+        """One completed device launch: ``seconds`` is the wall from
+        async dispatch to D2H completion -- an UPPER bound on device
+        busy time.  It includes H2D and the launch, and under the
+        batcher's pipelining also the next batch's overlapped host-side
+        padding (the device is computing through that window; the
+        overlap is the point).  Per-bucket rows/sec derived from it is
+        therefore conservative, never inflated."""
+        self.device_time.observe(seconds)
+        with self._lock:
+            acc = self._buckets.setdefault(bucket, [0, 0, 0.0])
+            acc[0] += 1
+            acc[1] += rows
+            acc[2] += seconds
 
     def count_cache(self, hit: bool) -> None:
         with self._lock:
@@ -137,6 +157,20 @@ class ServeMetrics:
             return (self._fill_sum / self.batches_total
                     if self.batches_total else 0.0)
 
+    def bucket_stats(self) -> dict:
+        """Per-bucket device accounting incl. derived rows/sec (keys are
+        stringified bucket sizes, JSON-friendly)."""
+        with self._lock:
+            items = {b: list(acc) for b, acc in self._buckets.items()}
+        return {
+            str(b): {
+                "batches": n, "rows": rows,
+                "device_s": round(secs, 6),
+                "rows_per_s": round(rows / secs, 2) if secs > 0 else 0.0,
+            }
+            for b, (n, rows, secs) in sorted(items.items())
+        }
+
     def snapshot(self) -> dict:
         depths = {name: fn() for name, fn in list(self._depth_fns.items())}
         with self._lock:
@@ -152,6 +186,8 @@ class ServeMetrics:
         out["queue_depth"] = depths
         out["latency"] = self.latency.snapshot()
         out["queue_latency"] = self.queue_latency.snapshot()
+        out["device_time"] = self.device_time.snapshot()
+        out["buckets"] = self.bucket_stats()
         return out
 
     def render_json(self) -> str:
@@ -188,7 +224,27 @@ class ServeMetrics:
         ]
         for name, depth in sorted(snap["queue_depth"].items()):
             lines.append(f'hpnn_serve_queue_depth{{kernel="{name}"}} {depth}')
-        for key in ("latency", "queue_latency"):
+        lines += [
+            "# HELP hpnn_serve_bucket_rows_per_sec Device rows/sec per "
+            "batch bucket.",
+            "# TYPE hpnn_serve_bucket_rows_per_sec gauge",
+        ]
+        for bucket, st in sorted(snap["buckets"].items(),
+                                 key=lambda kv: int(kv[0])):
+            lines.append(
+                f'hpnn_serve_bucket_rows_per_sec{{bucket="{bucket}"}} '
+                f"{st['rows_per_s']}")
+        lines += [
+            "# HELP hpnn_serve_bucket_device_seconds_total Device wall "
+            "per batch bucket.",
+            "# TYPE hpnn_serve_bucket_device_seconds_total counter",
+        ]
+        for bucket, st in sorted(snap["buckets"].items(),
+                                 key=lambda kv: int(kv[0])):
+            lines.append(
+                f'hpnn_serve_bucket_device_seconds_total{{bucket='
+                f'"{bucket}"}} {st["device_s"]}')
+        for key in ("latency", "queue_latency", "device_time"):
             h = snap[key]
             lines += [
                 f"# HELP hpnn_serve_{key}_seconds Request {key} summary.",

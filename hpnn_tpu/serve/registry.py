@@ -1,21 +1,47 @@
 """Model registry: named kernels + a bounded compile cache of jitted
-batched-forward callables.
+batched-forward callables, tiered by an explicit per-registry **parity
+policy**.
 
 Loading goes through the EXISTING ``io`` + ``api.configure`` path -- the
 same ``.conf`` files ``run_nn`` accepts -- so a kernel that trains and
-evaluates offline serves unchanged.  Evaluation is the exact
-``api.run_kernel`` batch pipeline (``ops.select_run_batch``): weights
-cast once to the conf dtype, inputs batched into one GEMM chain, outputs
-pulled as float64 -- responses are bit-identical to what ``run_nn``
-computes for the same input rows (asserted end-to-end in
-``tests/test_serve.py``).
+evaluates offline serves unchanged.
 
-The compile cache is keyed by (topology, dtype, batch-bucket, kind):
-requests are padded up to power-of-two row buckets, so the set of
-compiled programs per model is bounded by log2(max_batch)+1 and a
-warmed-up server NEVER retraces or recompiles in steady state (jit
-caches are keyed on shapes + statics, and bucketing fixes the shapes).
-Hits/misses are counted into ``ServeMetrics``.
+Two serving tiers (``ops.select_run_batch``'s two axes):
+
+* ``parity="strict"`` (default) -- evaluation is the exact
+  ``api.run_kernel`` batch pipeline: weights cast once to the conf
+  dtype, inputs batched into one scanned per-row GEMV chain, outputs
+  pulled as float64 -- responses are bit-identical to what ``run_nn``
+  computes for the same input rows (asserted end-to-end in
+  ``tests/test_serve.py``), regardless of batching or padding.
+* ``parity="fast"`` -- buckets at or above ``fast_threshold`` rows route
+  to the GEMM chain (``ops.steps.batched_forward``; the Pallas fused
+  forward on TPU f32/bf16), and -- when a device ``mesh`` is attached --
+  the padded bucket is sharded over the mesh's data axis exactly the way
+  ``parallel/dp.py`` shards training batches (``dp_eval_batch``).
+  Answers are dtype-accurate but may differ from the strict tier at the
+  ULP level with batch shape; that trade-off is the policy knob, chosen
+  per registry, never silently.  Buckets below the threshold keep the
+  strict path (a 3-row request gains nothing from a GEMM).
+
+The compile cache is keyed by (model, topology, dtype, batch-bucket,
+kind, tier) -- the model is part of the key because entries bind that
+model's device weights (two same-topology kernels must never share an
+entry), while the underlying jits still share compiled programs across
+same-shaped models.  Requests are padded up to power-of-two row buckets,
+so the set of cache entries per model is bounded by log2(max_batch)+1
+per tier and
+a warmed-up server NEVER retraces or recompiles in steady state (jit
+caches are keyed on shapes + statics + shardings, and bucketing fixes
+the shapes).  Shardings and mesh-replicated weights are cached alongside
+(per (topology, dtype, bucket, mesh)) so steady-state sharded dispatch
+re-placements are pure H2D, no re-planning.  Hits/misses are counted
+into ``ServeMetrics``.
+
+Padding reuses per-bucket pinned scratch buffers (``_ScratchPool``)
+instead of allocating a fresh zeros block per request, and the
+``dispatch``/``collect`` split lets the batcher overlap host padding +
+H2D of the next batch with device compute of the current one.
 """
 
 from __future__ import annotations
@@ -28,6 +54,8 @@ import numpy as np
 from ..utils.nn_log import nn_dbg, nn_out
 from .metrics import ServeMetrics
 
+PARITY_MODES = ("strict", "fast")
+
 
 def bucket_rows(rows: int, max_batch: int) -> int:
     """Power-of-two batch bucket: smallest 2^k >= rows, capped at
@@ -39,6 +67,61 @@ def bucket_rows(rows: int, max_batch: int) -> int:
     while b < rows:
         b <<= 1
     return b
+
+
+class _ScratchPool:
+    """Reusable pinned host buffers, one free-list per bucket size.
+
+    ``forward`` used to allocate (and zero) a fresh pad block per request
+    (the round-1 implementation); a steady-state server churning 64-row
+    f64 buckets was allocating ~400 KB per request for bytes that are
+    identical every time.  The pool hands out a zero-tail buffer, the
+    caller writes its real rows, and ``release`` returns it once the
+    device has consumed it.  At most ``_KEEP`` buffers are kept per
+    bucket (enough for the batcher's double-buffered pipeline plus a
+    concurrent warmup); extras are dropped to the allocator.
+    """
+
+    _KEEP = 3
+
+    def __init__(self, n_inputs: int, np_dtype):
+        self.n_inputs = n_inputs
+        self.np_dtype = np_dtype
+        self._free: dict[int, list[np.ndarray]] = {}
+        self._lock = threading.Lock()
+
+    def acquire(self, bucket: int) -> np.ndarray:
+        with self._lock:
+            free = self._free.get(bucket)
+            if free:
+                return free.pop()
+        return np.zeros((bucket, self.n_inputs), self.np_dtype)
+
+    def release(self, buf: np.ndarray) -> None:
+        with self._lock:
+            free = self._free.setdefault(buf.shape[0], [])
+            if len(free) < self._KEEP:
+                free.append(buf)
+
+
+class _InFlight:
+    """One dispatched bucket: the device-side result plus the scratch
+    buffer to recycle once the result is collected."""
+
+    __slots__ = ("out", "rows", "bucket", "_buf", "_pool")
+
+    def __init__(self, out, rows: int, bucket: int,
+                 buf, pool: _ScratchPool):
+        self.out = out
+        self.rows = rows
+        self.bucket = bucket
+        self._buf = buf
+        self._pool = pool
+
+    def recycle(self) -> None:
+        if self._buf is not None:
+            self._pool.release(self._buf)
+            self._buf = None
 
 
 class ServedModel:
@@ -58,6 +141,8 @@ class ServedModel:
         self.n_inputs = nn.kernel.n_inputs
         self.n_outputs = nn.kernel.n_outputs
         self._weights = None              # cast lazily on first infer
+        self._mesh_weights = {}           # mesh -> replicated device copies
+        self._pool: _ScratchPool | None = None
         self._lock = threading.Lock()
 
     @property
@@ -78,39 +163,93 @@ class ServedModel:
         """Device weights in the conf dtype, cast ONCE and kept resident
         (the whole point of a long-lived server)."""
         with self._lock:
-            if self._weights is None:
-                import jax.numpy as jnp
+            return self.weights_nolock()
 
-                self._weights = tuple(
-                    jnp.asarray(w, dtype=self.dtype)
-                    for w in self.nn.kernel.weights)
-            return self._weights
+    def mesh_weights(self, mesh):
+        """Replicated device copies on ``mesh``, placed once and cached
+        per mesh -- steady-state sharded dispatch never re-places."""
+        with self._lock:
+            cached = self._mesh_weights.get(mesh)
+            if cached is None:
+                import jax
+
+                from ..parallel.mesh import replicated
+
+                rep = replicated(mesh)
+                cached = self._mesh_weights[mesh] = tuple(
+                    jax.device_put(w, rep) for w in self.weights_nolock())
+            return cached
+
+    def weights_nolock(self):
+        """weights() body without re-taking the (non-reentrant) lock."""
+        if self._weights is None:
+            import jax.numpy as jnp
+
+            self._weights = tuple(
+                jnp.asarray(w, dtype=self.dtype)
+                for w in self.nn.kernel.weights)
+        return self._weights
+
+    def scratch_pool(self) -> _ScratchPool:
+        with self._lock:
+            if self._pool is None:
+                self._pool = _ScratchPool(self.n_inputs,
+                                          np.dtype(self.dtype))
+            return self._pool
 
     def infer(self, xs: np.ndarray) -> np.ndarray:
         """Batched forward for (rows, n_inputs) float64 inputs; returns
         (rows, n_outputs) float64 -- the run_kernel eval pipeline."""
         return self.registry.forward(self, xs)
 
-    def warmup(self) -> int:
-        """Compile every batch bucket up front so steady-state traffic
-        never pays a trace/compile.  Returns the bucket count."""
-        n = 0
-        b = 1
+    def _buckets(self) -> list[int]:
+        buckets, b = [], 1
         while True:
-            xs = np.zeros((b, self.n_inputs), np.float64)
-            self.registry.forward(self, xs)
-            n += 1
+            buckets.append(b)
             if b >= self.registry.max_batch:
-                return n
+                return buckets
             b <<= 1
+
+    def warmup(self, workers: int | None = None) -> int:
+        """Compile every batch bucket up front so steady-state traffic
+        never pays a trace/compile.  Buckets compile CONCURRENTLY
+        (``workers`` threads, default min(4, n_buckets)): a 10-bucket
+        model warms in max-compile time, not sum-compile time -- jit
+        compilation releases the GIL into XLA and is thread-safe.
+        Returns the bucket count."""
+        buckets = self._buckets()
+
+        def one(b: int) -> None:
+            self.registry.forward(
+                self, np.zeros((b, self.n_inputs), np.float64))
+
+        if workers is None:
+            workers = min(4, len(buckets))
+        if workers <= 1 or len(buckets) == 1:
+            for b in buckets:
+                one(b)
+        else:
+            from concurrent.futures import ThreadPoolExecutor
+
+            with ThreadPoolExecutor(
+                    max_workers=min(workers, len(buckets)),
+                    thread_name_prefix=f"hpnn-warmup-{self.name}") as ex:
+                # list() propagates the first worker exception, like the
+                # serial loop would
+                list(ex.map(one, buckets))
+        return len(buckets)
 
 
 class ModelRegistry:
     """Name -> ServedModel map plus the shared forward-callable cache."""
 
     def __init__(self, metrics: ServeMetrics | None = None,
-                 max_batch: int = 64):
+                 max_batch: int = 64, parity: str = "strict",
+                 fast_threshold: int = 256, mesh=None):
         assert max_batch >= 1
+        if parity not in PARITY_MODES:
+            raise ValueError(
+                f"parity must be one of {PARITY_MODES}: {parity!r}")
         self.metrics = metrics or ServeMetrics()
         # buckets are powers of two, so the cap must be one: round a
         # non-pow2 request (serve_nn -b 48) UP to the next bucket --
@@ -122,8 +261,22 @@ class ModelRegistry:
 
             nn_warn(f"serve: max_batch {max_batch} rounded up to the "
                     f"power-of-two bucket {self.max_batch}\n")
+        self.parity = parity
+        self.fast_threshold = max(1, int(fast_threshold))
+        if parity == "fast" and self.fast_threshold > self.max_batch:
+            from ..utils.nn_log import nn_warn
+
+            # an explicitly requested fast policy that can never fire is
+            # a config error worth shouting about, not a silent strict
+            nn_warn(f"serve: parity=fast is inert -- fast_threshold "
+                    f"{self.fast_threshold} exceeds the largest batch "
+                    f"bucket {self.max_batch}; every bucket will serve "
+                    "strict (raise -b/--max-batch or lower "
+                    "--fast-threshold)\n")
+        self.mesh = mesh  # jax.sharding.Mesh with a "data" axis, or None
         self._models: dict[str, ServedModel] = {}
         self._cache: dict[tuple, object] = {}
+        self._shardings: dict[tuple, object] = {}
         self._lock = threading.Lock()
 
     # --- registration ---------------------------------------------------
@@ -157,7 +310,8 @@ class ModelRegistry:
             self._models[name] = model
         nn_out(f"serve: registered kernel '{name}' "
                f"({'x'.join(str(p) for p in model.topology)}, "
-               f"{model.dtype_name}, {model.kind})\n")
+               f"{model.dtype_name}, {model.kind}, "
+               f"parity={self.parity})\n")
         return model
 
     def get(self, name: str) -> ServedModel | None:
@@ -168,13 +322,49 @@ class ModelRegistry:
         with self._lock:
             return sorted(self._models)
 
+    # --- tier selection -------------------------------------------------
+    def tier_for(self, bucket: int) -> str:
+        """Which tier a bucket dispatches through under this registry's
+        policy: 'strict', 'fast', or 'fast@meshN' (sharded)."""
+        if self.parity != "fast" or bucket < self.fast_threshold:
+            return "strict"
+        mesh = self.mesh
+        if mesh is not None:
+            from ..parallel.mesh import DATA_AXIS
+
+            n = mesh.shape[DATA_AXIS]
+            if n > 1 and bucket % n == 0:
+                return f"fast@mesh{n}"
+        return "fast"
+
+    def _batch_sharding(self, mesh):
+        key = ("batch", mesh)
+        sh = self._shardings.get(key)
+        if sh is None:
+            from ..parallel.mesh import batch_sharding
+
+            sh = self._shardings[key] = batch_sharding(mesh)
+        return sh
+
     # --- the forward path ----------------------------------------------
     def _callable_for(self, model: ServedModel, bucket: int):
         """The jitted batched-forward entry for one (topology, dtype,
-        bucket, kind) key.  Creating the entry is the cache MISS (the
-        underlying jit compiles on its first call at this shape);
-        everything after is a hit and never recompiles."""
-        key = (model.topology, model.dtype_name, bucket, model.kind)
+        bucket, kind, tier) key.  Creating the entry is the cache MISS
+        (the underlying jit compiles on its first call at this shape);
+        everything after is a hit and never recompiles.  The callable
+        takes the PADDED (bucket, n_inputs) host buffer in the model's
+        numpy dtype and returns the device-side (bucket, n_outputs)
+        result WITHOUT synchronizing -- callers choose when to pay D2H.
+        """
+        tier = self.tier_for(bucket)
+        # the MODEL is part of the key: entries bind the model's device
+        # weights in their closure, so two same-topology kernels must
+        # never share an entry (they would cross-serve weights -- caught
+        # by the PR-2 verification drive).  XLA-level program sharing
+        # across same-shaped models is unaffected: the underlying jits
+        # trace weights as arguments and cache by shape.
+        key = (model.name, model.topology, model.dtype_name, bucket,
+               model.kind, tier)
         with self._lock:
             fn = self._cache.get(key)
             if fn is not None:
@@ -182,33 +372,70 @@ class ModelRegistry:
                 return fn
             from .. import ops
 
-            run_batch_fn, path = ops.select_run_batch(model.dtype)
-            weights, kind = model.weights(), model.kind
+            kind = model.kind
+            if tier.startswith("fast@mesh"):
+                from ..parallel.dp import dp_eval_batch
 
-            def fn(jxs, _fn=run_batch_fn, _w=weights, _k=kind):
-                return _fn(_w, jxs, _k)
+                mesh = self.mesh
+                xsh = self._batch_sharding(mesh)
+                wrep = model.mesh_weights(mesh)
+                path = f"gemm+{tier.split('@')[1]}"
+
+                def fn(buf, _w=wrep, _k=kind, _m=mesh, _sh=xsh):
+                    import jax
+
+                    return dp_eval_batch(_w, jax.device_put(buf, _sh),
+                                         _k, _m)
+            else:
+                run_batch_fn, path = ops.select_run_batch(
+                    model.dtype,
+                    parity="fast" if tier == "fast" else "strict")
+                weights = model.weights()
+
+                def fn(buf, _fn=run_batch_fn, _w=weights, _k=kind):
+                    import jax.numpy as jnp
+
+                    return _fn(_w, jnp.asarray(buf), _k)
 
             self._cache[key] = fn
             self.metrics.count_cache(hit=False)
             nn_dbg(f"serve: compile-cache miss "
-                   f"(model={model.name} bucket={bucket} path={path})\n")
+                   f"(model={model.name} bucket={bucket} tier={tier} "
+                   f"path={path})\n")
             return fn
 
-    def forward(self, model: ServedModel, xs: np.ndarray) -> np.ndarray:
-        """Pad rows to the power-of-two bucket, run the cached jitted
-        forward, slice the real rows back out as float64."""
-        import jax.numpy as jnp
-
+    def dispatch(self, model: ServedModel, xs: np.ndarray) -> _InFlight:
+        """Pad rows into a pooled scratch buffer and launch the cached
+        forward WITHOUT waiting for the result: the returned handle's
+        ``out`` is the device-side array (jax async dispatch), so the
+        caller can overlap the next batch's host work with this batch's
+        device compute.  ``collect`` pays the D2H sync."""
         rows = xs.shape[0]
         assert 1 <= rows <= self.max_batch, rows
         bucket = bucket_rows(rows, self.max_batch)
         fn = self._callable_for(model, bucket)
-        if bucket != rows:
-            pad = np.zeros((bucket - rows, xs.shape[1]), xs.dtype)
-            xs = np.concatenate([xs, pad])
-        jxs = jnp.asarray(xs, dtype=model.dtype)
-        outs = np.asarray(fn(jxs), dtype=np.float64)
-        return outs[:rows]
+        pool = model.scratch_pool()
+        buf = pool.acquire(bucket)
+        buf[:rows] = xs
+        if rows < bucket:
+            buf[rows:] = 0.0  # a reused buffer may carry a stale tail
+        out = fn(buf)
+        return _InFlight(out, rows, bucket, buf, pool)
+
+    def collect(self, handle: _InFlight) -> np.ndarray:
+        """Materialize a dispatched bucket as float64 host rows (the D2H
+        sync) and recycle its scratch buffer."""
+        try:
+            outs = np.asarray(handle.out, dtype=np.float64)
+        finally:
+            handle.recycle()
+        return outs[:handle.rows]
+
+    def forward(self, model: ServedModel, xs: np.ndarray) -> np.ndarray:
+        """Synchronous dispatch + collect: pad rows to the power-of-two
+        bucket, run the cached jitted forward, slice the real rows back
+        out as float64."""
+        return self.collect(self.dispatch(model, xs))
 
     def cache_stats(self) -> dict:
         with self._lock:

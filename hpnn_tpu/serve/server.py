@@ -8,7 +8,10 @@ Endpoints:
   "argmax": [...]}``; outputs are float64 rendered by json's shortest
   round-trip repr, so the bytes decode to EXACTLY the floats the
   run_kernel batch path computes.
-* ``GET /healthz``  -- liveness + registered kernel list.
+* ``GET /healthz``  -- readiness + registered kernel list: ``200 ok``
+  only once every background warmup finished (``503 warming`` before,
+  ``503 draining`` during shutdown), so load balancers admit traffic
+  when the compile cache is hot.
 * ``GET /metrics``  -- Prometheus text; ``?format=json`` for the JSON
   snapshot (what scripts/serve_bench.py consumes).
 
@@ -55,35 +58,93 @@ class _HTTPError(Exception):
 class ServeApp:
     """Registry + per-model batchers + metrics: everything the HTTP
     handler needs, independent of the socket layer (tests drive it
-    directly and through real HTTP)."""
+    directly and through real HTTP).
+
+    ``parity``/``fast_threshold``/``mesh_devices`` configure the
+    registry's serving tier (see ``registry.ModelRegistry``): ``strict``
+    keeps the bit-parity GEMV scan, ``fast`` routes big buckets to the
+    GEMM chain and -- with ``mesh_devices >= 2`` -- shards them over a
+    data-axis device mesh."""
 
     def __init__(self, max_batch: int = 64, max_queue_rows: int = 256,
                  linger_s: float = 0.0, default_timeout_s: float = 30.0,
-                 metrics: ServeMetrics | None = None):
+                 metrics: ServeMetrics | None = None,
+                 parity: str = "strict", fast_threshold: int = 256,
+                 mesh_devices: int | None = 0,
+                 warmup_workers: int | None = None):
         self.metrics = metrics or ServeMetrics()
+        mesh = None
+        if parity == "fast" and mesh_devices != 0:  # 0: explicitly off
+            from ..parallel.mesh import data_mesh
+
+            mesh = data_mesh(mesh_devices)  # None when < 2 devices
+        elif mesh_devices != 0:
+            from ..utils.nn_log import nn_warn
+
+            # an explicit mesh request that strict parity can never use
+            # deserves the same loud inert-config diagnostic the
+            # registry gives an unreachable fast_threshold
+            nn_warn("serve: --mesh is inert under parity=strict (the "
+                    "bit-parity GEMV scan never shards); pass "
+                    "--parity fast to enable sharded serving\n")
         self.registry = ModelRegistry(metrics=self.metrics,
-                                      max_batch=max_batch)
+                                      max_batch=max_batch,
+                                      parity=parity,
+                                      fast_threshold=fast_threshold,
+                                      mesh=mesh)
         self.batchers: dict[str, MicroBatcher] = {}
         self.max_queue_rows = int(max_queue_rows)
         self.linger_s = float(linger_s)
         self.default_timeout_s = float(default_timeout_s)
+        self.warmup_workers = warmup_workers
+        self._warming: set[str] = set()
+        self._warming_lock = threading.Lock()
         self._closed = False
 
+    def _warm(self, model) -> None:
+        try:
+            n = model.warmup(workers=self.warmup_workers)
+            nn_out(f"serve: warmed {n} batch bucket(s) for "
+                   f"'{model.name}'\n")
+        except Exception as exc:  # warmup is an optimization: a failure
+            # leaves compiles to first requests, it must not kill serving
+            from ..utils.nn_log import nn_warn
+
+            nn_warn(f"serve: warmup failed for '{model.name}': {exc}\n")
+        finally:
+            with self._warming_lock:
+                self._warming.discard(model.name)
+
+    def warming(self) -> list[str]:
+        """Kernels whose background warmup is still compiling."""
+        with self._warming_lock:
+            return sorted(self._warming)
+
     def add_model(self, conf_path: str, name: str | None = None,
-                  warmup: bool = True):
+                  warmup: bool = True, background: bool = False):
         """Register one ``.conf`` (the same files run_nn takes).  With
-        ``warmup`` every batch bucket compiles now, so the first real
-        request is as fast as the thousandth.  A name collision is a
-        registration FAILURE (None, diagnosed by the registry): silently
-        replacing would leak the first batcher's worker and reroute its
-        traffic."""
+        ``warmup`` every batch bucket compiles now -- buckets in
+        parallel (``warmup_workers`` threads) -- so the first real
+        request is as fast as the thousandth.  ``background=True``
+        returns immediately and warms on a daemon thread; ``/healthz``
+        reports ``warming`` (503) until every background warmup
+        finishes, so a load balancer admits traffic only when the
+        compile cache is hot (requests arriving earlier still work --
+        they just pay the compile).  A name collision is a registration
+        FAILURE (None, diagnosed by the registry): silently replacing
+        would leak the first batcher's worker and reroute its traffic."""
         model = self.registry.register_conf(conf_path, name=name)
         if model is None:
             return None
         if warmup:
-            n = model.warmup()
-            nn_out(f"serve: warmed {n} batch bucket(s) for "
-                   f"'{model.name}'\n")
+            if background:
+                with self._warming_lock:
+                    self._warming.add(model.name)
+                threading.Thread(
+                    target=self._warm, args=(model,),
+                    name=f"hpnn-warmup-{model.name}", daemon=True).start()
+            else:
+                self._warm(model)
         b = MicroBatcher(model, metrics=self.metrics,
                          max_queue_rows=self.max_queue_rows,
                          linger_s=self.linger_s)
@@ -183,10 +244,19 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:
         path, _, query = self.path.partition("?")
         if path == "/healthz":
-            status = "draining" if self.app._closed else "ok"
-            self._reply(200 if status == "ok" else 503,
-                        {"status": status,
-                         "kernels": self.app.registry.names()})
+            warming = self.app.warming()
+            if self.app._closed:
+                status = "draining"
+            elif warming:
+                status = "warming"
+            else:
+                status = "ok"
+            body = {"status": status,
+                    "kernels": self.app.registry.names(),
+                    "parity": self.app.registry.parity}
+            if warming:
+                body["warming"] = warming
+            self._reply(200 if status == "ok" else 503, body)
             return
         if path == "/metrics":
             if "format=json" in query:

@@ -24,6 +24,14 @@ Usable three ways:
 * as a library: tests/test_serve.py drives ``run_load`` directly for the
   end-to-end acceptance assertions (bit-parity vs the run_kernel batch
   path, zero steady-state compile-cache misses, queue-full rejection).
+
+``--compare-buckets 256,512`` (with ``--conf``) additionally times the
+strict GEMV-scan tier against the ``fast`` GEMM tier and -- when more
+than one device is visible and ``--mesh`` allows -- the mesh-sharded
+GEMM, attaching per-bucket rows/sec, speedup, and the max absolute
+deviation from the strict answer to the JSON row (``parity_compare``).
+``make serve-bench`` runs exactly this, so single-device and mesh rows
+land in one BENCH-style line.
 """
 
 from __future__ import annotations
@@ -170,7 +178,85 @@ def bench_row(base_url: str, kernel: str, load: dict) -> dict:
         "batches_total": m.get("batches_total"),
         "compile_cache": m.get("compile_cache"),
         "server_requests": m.get("requests"),
+        "device_time": m.get("device_time"),
+        "buckets": m.get("buckets"),
     }
+
+
+def compare_parity(conf: str, buckets: list[int], repeats: int = 5,
+                   mesh_devices: int | None = 0,
+                   seed: int = 42) -> list[dict]:
+    """Direct bucket-level tier comparison on one kernel: the strict
+    GEMV-scan path vs the ``fast`` GEMM chain vs (devices permitting)
+    the mesh-sharded GEMM -- the speedup row the parity policy is
+    justified by.
+
+    Timing is registry-level (``model.infer``: pad + H2D + launch + D2H
+    as float64 -- exactly what one serving dispatch pays, no HTTP/queue
+    noise), one warm pass then ``repeats`` timed passes, median
+    reported.  Each row also records the max absolute deviation of the
+    fast tiers from the strict answer, so the throughput claim carries
+    its accuracy cost (typically 0 or a few ULP)."""
+    from hpnn_tpu.api import configure
+    from hpnn_tpu.serve.registry import ModelRegistry
+
+    # ONE configure for every tier: a generate-mode conf re-parsed per
+    # registry would hand each tier different random weights and the
+    # "comparison" would compare different networks
+    nn = configure(conf)
+    if nn is None or nn.kernel is None:
+        raise RuntimeError(f"cannot load {conf}")
+    cap = max(buckets)
+    tiers = {
+        "strict": ModelRegistry(max_batch=cap, parity="strict"),
+        "fast": ModelRegistry(max_batch=cap, parity="fast",
+                              fast_threshold=min(buckets)),
+    }
+    if mesh_devices != 0:  # 0: explicitly off; None: all local devices
+        from hpnn_tpu.parallel.mesh import DATA_AXIS, data_mesh
+
+        mesh = data_mesh(mesh_devices)
+        if mesh is not None:
+            tiers[f"fast_mesh{mesh.shape[DATA_AXIS]}"] = ModelRegistry(
+                max_batch=cap, parity="fast",
+                fast_threshold=min(buckets), mesh=mesh)
+    models = {}
+    for tier, reg in tiers.items():
+        model = reg.register(f"cmp_{tier}", nn)
+        if model is None:
+            raise RuntimeError(f"cannot register {conf} for {tier}")
+        models[tier] = model
+
+    rng = np.random.default_rng(seed)
+    rows = []
+    for bucket in buckets:
+        xs = rng.uniform(-1.0, 1.0, (bucket, models["strict"].n_inputs))
+        row = {"bucket": bucket}
+        outs = {}
+        for tier, model in models.items():
+            outs[tier] = model.infer(xs)  # warm pass (compile)
+            times = []
+            for _ in range(repeats):
+                t0 = time.perf_counter()
+                model.infer(xs)
+                times.append(time.perf_counter() - t0)
+            dt = statistics.median(times)
+            row[tier] = {
+                "tier": model.registry.tier_for(
+                    min(bucket, model.registry.max_batch)),
+                "ms_per_batch": round(dt * 1e3, 3),
+                "rows_per_s": round(bucket / dt, 1),
+            }
+        base = row["strict"]["rows_per_s"]
+        for tier in models:
+            if tier == "strict":
+                continue
+            row[tier]["speedup_vs_strict"] = round(
+                row[tier]["rows_per_s"] / base, 3) if base else None
+            row[tier]["max_abs_diff_vs_strict"] = float(
+                np.max(np.abs(outs[tier] - outs["strict"])))
+        rows.append(row)
+    return rows
 
 
 def main() -> int:
@@ -193,16 +279,45 @@ def main() -> int:
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--timeout-s", type=float, default=60.0)
     ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--max-batch", type=int, default=64,
+                    help="self-hosted server's largest batch bucket")
+    ap.add_argument("--parity", choices=("strict", "fast"),
+                    default="strict",
+                    help="self-hosted serving tier (see serve_nn)")
+    ap.add_argument("--fast-threshold", type=int, default=256)
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard 'fast' buckets over N devices "
+                    "(0: off; -1: all local devices)")
+    ap.add_argument("--compare-buckets", default=None,
+                    help="comma list of bucket sizes (e.g. 256,512): "
+                    "attach a direct strict-vs-fast(-vs-sharded) "
+                    "speedup comparison to the row (needs --conf)")
+    ap.add_argument("--compare-repeats", type=int, default=5)
     ap.add_argument("--out", default=None,
                     help="also write the JSON row to this path")
     args = ap.parse_args()
 
     sizes = [int(s) for s in str(args.rows).split(",")]
+    mesh_devices = None if args.mesh < 0 else args.mesh
+    if args.compare_buckets and not args.conf:
+        # pure argument validation: reject BEFORE the load run, not
+        # after minutes of traffic whose row would then be discarded
+        ap.error("--compare-buckets needs --conf (registry-level "
+                 "timing self-hosts its own models)")
     httpd = app = None
     if args.conf:
+        # self-hosting replays serve_nn's runtime setup: fp64 on (the
+        # conf dtype decides the compute dtype; without x64 every f64
+        # kernel would silently serve f32 and the parity comparison
+        # would measure the wrong thing)
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
         from hpnn_tpu.serve.server import ServeApp, serve_in_thread
 
-        app = ServeApp()
+        app = ServeApp(max_batch=args.max_batch, parity=args.parity,
+                       fast_threshold=args.fast_threshold,
+                       mesh_devices=mesh_devices)
         model = app.add_model(args.conf, name=args.kernel)
         if model is None:
             print(json.dumps({"error": f"cannot load {args.conf}"}))
@@ -223,10 +338,17 @@ def main() -> int:
                         concurrency=args.concurrency,
                         timeout_s=args.timeout_s)
         row = bench_row(base_url, kernel, load)
+        row["parity"] = args.parity if args.conf else None
     finally:
         if httpd is not None:
             httpd.shutdown()
             app.close(drain=True)
+    if args.compare_buckets:
+        row["parity_compare"] = compare_parity(
+            args.conf,
+            [int(b) for b in str(args.compare_buckets).split(",")],
+            repeats=args.compare_repeats, mesh_devices=mesh_devices,
+            seed=args.seed)
     print(json.dumps(row))
     if args.out:
         with open(args.out, "w") as fp:

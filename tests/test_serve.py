@@ -154,27 +154,215 @@ def test_registry_unknown_conf_returns_none(tmp_path, capsys):
     assert reg.register_conf(str(tmp_path / "missing.conf")) is None
 
 
+def test_same_topology_models_never_share_weights(tmp_path):
+    """Cache entries bind a model's weights, so the cache key must carry
+    the model: two same-shaped kernels in one registry have to answer
+    from their OWN weights (caught live in the PR-2 verification drive:
+    the topology-only key cross-served the first model's weights)."""
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    confs = []
+    for i, seed in enumerate((1, 999)):
+        kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+        kpath = str(tmp_path / f"k{i}.opt")
+        dump_kernel_to_path(kern, kpath)
+        conf = tmp_path / f"m{i}.conf"
+        conf.write_text(f"[name] m{i}\n[type] ANN\n[init] {kpath}\n"
+                        "[seed] 1\n[train] BP\n")
+        confs.append(str(conf))
+    reg = ModelRegistry(max_batch=8)
+    m0 = reg.register_conf(confs[0])
+    m1 = reg.register_conf(confs[1])
+    xs = np.random.default_rng(0).uniform(-1, 1, (4, N_IN))
+    assert not np.array_equal(m0.infer(xs), m1.infer(xs))
+
+
+# --- parity policy + multi-device serving -----------------------------------
+
+def test_select_run_batch_parity_tiers():
+    """CPU tiering: strict -> the GEMV-scan run_batch, fast -> the GEMM
+    chain; a bogus parity is a loud error, never a silent default."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu import ops
+
+    _, name = ops.select_run_batch(jnp.float64)
+    assert name == "xla"
+    _, name = ops.select_run_batch(jnp.float64, parity="fast")
+    assert name == "gemm"
+    with pytest.raises(ValueError):
+        ops.select_run_batch(jnp.float64, parity="sloppy")
+    with pytest.raises(ValueError):
+        ModelRegistry(parity="sloppy")
+
+
+def test_tier_routing_by_bucket_and_mesh(tmp_path):
+    """The policy table: strict registries never leave the parity path;
+    fast registries route sub-threshold buckets to strict, big buckets
+    to the GEMM chain, and mesh-divisible big buckets to the shards."""
+    from hpnn_tpu.parallel.mesh import data_mesh
+
+    mesh = data_mesh(None)  # conftest's virtual 8-device CPU mesh
+    assert mesh is not None
+    strict = ModelRegistry(max_batch=256)
+    assert [strict.tier_for(b) for b in (4, 64, 256)] == ["strict"] * 3
+    fast = ModelRegistry(max_batch=256, parity="fast", fast_threshold=64)
+    assert fast.tier_for(32) == "strict"
+    assert fast.tier_for(64) == "fast"
+    sharded = ModelRegistry(max_batch=256, parity="fast",
+                            fast_threshold=64, mesh=mesh)
+    assert sharded.tier_for(32) == "strict"
+    assert sharded.tier_for(64) == "fast@mesh8"
+    # a 4-row bucket is not 8-divisible even above threshold
+    tiny = ModelRegistry(max_batch=4, parity="fast", fast_threshold=1,
+                         mesh=mesh)
+    assert tiny.tier_for(4) == "fast"
+
+
+def test_inert_fast_policy_warns(capsys):
+    """parity=fast with a threshold above the largest bucket can never
+    fire; the registry must say so instead of silently serving strict."""
+    from hpnn_tpu.utils import nn_log
+
+    nn_log.set_verbosity(1)
+    try:
+        reg = ModelRegistry(max_batch=64, parity="fast",
+                            fast_threshold=256)
+        assert reg.tier_for(64) == "strict"
+        assert "inert" in capsys.readouterr().out
+    finally:
+        nn_log.set_verbosity(0)
+
+
+def test_data_mesh_floors_to_power_of_two():
+    """Power-of-two buckets only shard over power-of-two device counts:
+    a 6-device request floors to 4 instead of building a mesh no bucket
+    can ever use."""
+    from hpnn_tpu.parallel.mesh import DATA_AXIS, data_mesh
+
+    mesh = data_mesh(6)
+    assert mesh is not None and mesh.shape[DATA_AXIS] == 4
+    assert data_mesh(1) is None
+    assert data_mesh(8).shape[DATA_AXIS] == 8
+
+
+def test_fast_sharded_matches_single_device_fast(tmp_path):
+    """(a) the mesh-sharded fast path answers EXACTLY what the
+    single-device fast path answers for the same rows: the batch axis is
+    embarrassingly parallel and weights are replicated, so sharding must
+    not change a single bit."""
+    from hpnn_tpu.parallel.mesh import data_mesh
+
+    conf, _ = _write_kernel_conf(tmp_path, name="meshy")
+    mesh = data_mesh(None)
+    assert mesh is not None
+    fast = ModelRegistry(max_batch=256, parity="fast", fast_threshold=64)
+    sharded = ModelRegistry(max_batch=256, parity="fast",
+                            fast_threshold=64, mesh=mesh)
+    m_fast = fast.register_conf(conf, name="f")
+    m_shard = sharded.register_conf(conf, name="s")
+    rng = np.random.default_rng(17)
+    for rows in (64, 200, 256):  # exact bucket, padded bucket, cap
+        xs = rng.uniform(-1, 1, (rows, N_IN))
+        np.testing.assert_array_equal(m_shard.infer(xs), m_fast.infer(xs))
+    st = sharded.cache_stats()
+    # buckets touched: 64 (rows=64) and 256 (rows=200 padded, rows=256)
+    assert st == {"entries": 2, "misses": 2, "hits": 1}
+
+
+def test_fast_policy_small_buckets_stay_bit_strict(tmp_path):
+    """(b) under the fast policy, buckets below the threshold still run
+    the strict GEMV scan and answer bit-identically to the offline
+    run_nn batch path."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu import ops
+
+    conf, kern = _write_kernel_conf(tmp_path, name="small")
+    fast = ModelRegistry(max_batch=256, parity="fast", fast_threshold=64)
+    model = fast.register_conf(conf, name="sm")
+    rng = np.random.default_rng(23)
+    xs = rng.uniform(-1, 1, (11, N_IN))
+    weights = tuple(jnp.asarray(w, dtype=jnp.float64)
+                    for w in kern.weights)
+    ref = np.asarray(ops.run_batch(weights, jnp.asarray(xs), "ANN"),
+                     dtype=np.float64)
+    np.testing.assert_array_equal(model.infer(xs), ref)
+
+
+def test_fast_policy_big_buckets_dtype_accurate(tmp_path):
+    """The fast tier's answers agree with strict to float64 round-off on
+    big buckets (the policy trades BIT-parity, not accuracy)."""
+    conf, _ = _write_kernel_conf(tmp_path, name="acc")
+    strict = ModelRegistry(max_batch=256)
+    fast = ModelRegistry(max_batch=256, parity="fast", fast_threshold=64)
+    m_s = strict.register_conf(conf, name="st")
+    m_f = fast.register_conf(conf, name="fa")
+    rng = np.random.default_rng(29)
+    xs = rng.uniform(-1, 1, (256, N_IN))
+    np.testing.assert_allclose(m_f.infer(xs), m_s.infer(xs),
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_scratch_pool_reuse_and_stale_tail_zeroed(tmp_path):
+    """The per-bucket scratch pool reuses buffers (no per-request zeros
+    allocation) AND a reused buffer's stale tail rows are re-zeroed, so
+    padded results stay identical to the fresh-buffer ones."""
+    conf, _ = _write_kernel_conf(tmp_path, name="scr")
+    reg = ModelRegistry(max_batch=16)
+    model = reg.register_conf(conf, name="sc")
+    rng = np.random.default_rng(31)
+    full = rng.uniform(-1, 1, (16, N_IN))
+    ref = model.infer(full)          # fills the 16-bucket scratch
+    got = model.infer(full[:11])     # same bucket, reused buffer
+    # strict rows are batch-composition-independent: the 11 rows must
+    # come back exactly as in the full batch, stale tail or not
+    np.testing.assert_array_equal(got, ref[:11])
+    pool = model.scratch_pool()
+    buf = pool.acquire(16)
+    pool.release(buf)
+    assert pool.acquire(16) is buf  # actually reused, not reallocated
+
+
 # --- batcher ----------------------------------------------------------------
 
 class _EchoModel:
-    """Registry-free stand-in: infer returns row sums, records batches."""
+    """Registry-free stand-in: infer returns row sums, records batches.
+    Implements the registry's dispatch/collect split the pipelined
+    batcher drives: dispatch records the launch, collect (the fake D2H
+    sync) pays the delay."""
+
+    class _Handle:
+        def __init__(self, out, rows, bucket):
+            self.out, self.rows, self.bucket = out, rows, bucket
 
     class _Reg:
-        def __init__(self, max_batch):
+        def __init__(self, model, max_batch):
+            self.model = model
             self.max_batch = max_batch
             self.metrics = ServeMetrics()
 
+        def dispatch(self, model, xs):
+            model.batches.append(xs.shape[0])
+            return _EchoModel._Handle(xs.sum(axis=1, keepdims=True),
+                                      xs.shape[0],
+                                      bucket_rows(xs.shape[0],
+                                                  self.max_batch))
+
+        def collect(self, handle):
+            if self.model.delay_s:
+                time.sleep(self.model.delay_s)
+            return handle.out
+
     def __init__(self, max_batch=8, delay_s=0.0):
         self.name = "echo"
-        self.registry = self._Reg(max_batch)
+        self.registry = self._Reg(self, max_batch)
         self.delay_s = delay_s
         self.batches = []
 
     def infer(self, xs):
-        if self.delay_s:
-            time.sleep(self.delay_s)
-        self.batches.append(xs.shape[0])
-        return xs.sum(axis=1, keepdims=True)
+        return self.registry.collect(self.registry.dispatch(self, xs))
 
 
 def test_batcher_coalesces_concurrent_requests():
@@ -251,6 +439,34 @@ def test_batcher_deadline_expires_without_compute():
     t.join()
     assert results == ["deadline"]
     assert model.batches == []  # never dispatched to the device
+    b.close()
+
+
+def test_batcher_pipelining_never_reorders_responses():
+    """(c) the depth-1 pipeline (dispatch N+1 before collecting N) must
+    deliver every client ITS OWN rows: fire many concurrent variable-size
+    requests through a slow model and check each result against its
+    input.  Multiple launches guarantee the pipeline actually cycled."""
+    model = _EchoModel(max_batch=4, delay_s=0.002)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=1024)
+    outs: dict[int, np.ndarray] = {}
+
+    def client(i):
+        x = np.full((1 + i % 3, 4), float(i))
+        outs[i] = b.submit(x, timeout_s=30.0)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(48)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(48):
+        rows = 1 + i % 3
+        np.testing.assert_array_equal(
+            outs[i], np.full((rows, 1), 4.0 * i))
+    assert len(model.batches) >= 2  # pipelined across several launches
     b.close()
 
 
@@ -415,6 +631,87 @@ def test_e2e_queue_full_distinct_status(served):
     m = serve_bench.fetch_metrics(base)
     assert m["requests"]["queue_full"] == 16
     batcher.max_queue_rows = 64
+
+
+def test_background_warmup_healthz_goes_ready(tmp_path):
+    """Background warmup: the socket answers immediately, /healthz says
+    'warming' (503) until every bucket compiled, then 'ok' (200) -- and
+    the compile cache is fully hot at that point."""
+    conf, _ = _write_kernel_conf(tmp_path, name="bg")
+    app = ServeApp(max_batch=16, max_queue_rows=64)
+    model = app.add_model(conf, warmup=True, background=True)
+    assert model is not None
+    app.batchers["bg"] and app.metrics  # registered before warm finishes
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    deadline = time.monotonic() + 60
+    seen = set()
+    while time.monotonic() < deadline:
+        status, body = serve_bench.http_json(base + "/healthz")
+        seen.add((status, body["status"]))
+        if body["status"] == "ok":
+            break
+        assert (status, body["status"]) == (503, "warming")
+        time.sleep(0.02)
+    assert (200, "ok") in seen
+    assert app.warming() == []
+    m = serve_bench.fetch_metrics(base)
+    assert m["compile_cache"]["misses"] == 5  # buckets 1..16, all warm
+    # traffic works post-warmup and the registry path is hot
+    status, body = serve_bench.http_json(
+        base + "/v1/kernels/bg/infer", {"input": [0.0] * N_IN})
+    assert status == 200
+    httpd.shutdown()
+    app.close(drain=True)
+
+
+def test_concurrent_warmup_compiles_every_bucket(tmp_path):
+    """Sync warmup with a thread pool still compiles exactly one entry
+    per bucket (no duplicate misses from racing workers)."""
+    conf, _ = _write_kernel_conf(tmp_path, name="cw")
+    reg = ModelRegistry(max_batch=64)
+    model = reg.register_conf(conf, name="cw")
+    assert model.warmup(workers=4) == 7  # buckets 1..64
+    assert reg.cache_stats()["entries"] == 7
+    assert reg.metrics.cache_misses == 7
+
+
+def test_device_time_and_bucket_metrics(tmp_path):
+    """The serving metrics grow device-time and per-bucket rows/sec:
+    dispatched batches land in the per-bucket table and both render
+    paths expose them."""
+    conf, _ = _write_kernel_conf(tmp_path, name="dm")
+    app = ServeApp(max_batch=8, max_queue_rows=64)
+    app.add_model(conf, warmup=False)
+    rng = np.random.default_rng(41)
+    for rows in (3, 5, 8):
+        app.infer("dm", rng.uniform(-1, 1, (rows, N_IN)))
+    snap = app.metrics.snapshot()
+    assert snap["device_time"]["count"] == 3
+    assert set(snap["buckets"]) == {"4", "8"}
+    b8 = snap["buckets"]["8"]
+    assert b8["batches"] == 2 and b8["rows"] == 13
+    assert b8["rows_per_s"] > 0 and b8["device_s"] > 0
+    prom = app.metrics.render_prometheus()
+    assert 'hpnn_serve_bucket_rows_per_sec{bucket="8"}' in prom
+    assert "hpnn_serve_device_time_seconds_count 3" in prom
+    app.close()
+
+
+def test_serve_bench_compare_parity(tmp_path):
+    """The serve_bench comparison row: strict vs fast vs mesh-sharded
+    rows/sec on one bucket, with the accuracy delta recorded."""
+    conf, _ = _write_kernel_conf(tmp_path, name="cmp")
+    rows = serve_bench.compare_parity(conf, [64], repeats=2,
+                                      mesh_devices=None)
+    (row,) = rows
+    assert row["bucket"] == 64
+    assert row["strict"]["rows_per_s"] > 0
+    assert row["fast"]["tier"] == "fast"
+    assert row["fast"]["speedup_vs_strict"] > 0
+    assert row["fast"]["max_abs_diff_vs_strict"] >= 0.0
+    mesh_keys = [k for k in row if k.startswith("fast_mesh")]
+    assert mesh_keys and row[mesh_keys[0]]["tier"] == "fast@mesh8"
 
 
 def test_serve_drain_on_close(tmp_path):
