@@ -14,8 +14,8 @@
 FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
              tests/test_samples.py tests/test_glibc_random.py \
              tests/test_tools.py tests/test_api_quirks.py \
-             tests/test_native_io.py tests/test_scale_scripts.py \
-             tests/test_bench_probe.py
+             tests/test_native_io.py tests/test_corpus.py \
+             tests/test_scale_scripts.py tests/test_bench_probe.py
 MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_pallas_convergence.py tests/test_cli_e2e.py
 SERVE_TESTS = tests/test_serve.py
@@ -49,4 +49,10 @@ serve-bench:
 	    --fast-threshold 256 --max-batch 512 --mesh -1 \
 	    --compare-buckets 256,512 --out SERVE_BENCH.json
 
-.PHONY: check check-all serve-check native bench serve-bench
+# corpus-ingestion throughput: serial vs parallel cold load vs warm
+# pack-cache load on a generated 10k-file corpus (parity asserted on
+# every row); emits IO_BENCH.json, rc!=0 if the speedup floors miss
+io-bench:
+	env JAX_PLATFORMS=cpu python scripts/io_bench.py --out IO_BENCH.json
+
+.PHONY: check check-all serve-check native bench serve-bench io-bench

@@ -1,7 +1,9 @@
 #!/usr/bin/env python3
 """run_nn -- flag-compatible rebuild of /root/reference/tests/run_nn.c.
 
-Usage: run_nn [-h] [-v]... [-O n] [-B n] [-S n] [conf (default ./nn.conf)]
+Usage: run_nn [-h] [-v]... [-O n] [-B n] [-S n]
+              [--compile-cache DIR] [--corpus-cache DIR]
+              [conf (default ./nn.conf)]
 """
 import os
 import sys

@@ -1,7 +1,9 @@
 #!/usr/bin/env python3
 """train_nn -- flag-compatible rebuild of /root/reference/tests/train_nn.c.
 
-Usage: train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n] [conf (default ./nn.conf)]
+Usage: train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n]
+                [--compile-cache DIR] [--corpus-cache DIR]
+                [conf (default ./nn.conf)]
 """
 import os
 import sys

@@ -47,11 +47,11 @@ Quirks preserved on purpose (each cited):
 from __future__ import annotations
 
 import dataclasses
-import os
 import time
 
 import numpy as np
 
+from .io import corpus as corpus_io
 from .io.conf import (
     NN_TRAIN_BP,
     NN_TRAIN_BPM,
@@ -62,7 +62,7 @@ from .io.conf import (
     load_conf,
 )
 from .io.kernel_io import dump_kernel, load_kernel
-from .io.samples import list_sample_dir, read_sample_fast
+from .io.samples import list_sample_dir
 from .models.kernel import Kernel, generate_kernel
 from .utils.glibc_random import GlibcRandom, shuffled_indices
 from .utils.nn_log import nn_cout, nn_dbg, nn_error, nn_out, nn_warn
@@ -199,41 +199,13 @@ def _shuffle_order(conf: NNConf, n: int) -> list[int]:
     return shuffled_indices(GlibcRandom(conf.seed), n)
 
 
-def _load_ordered(dirpath: str, names: list[str], order: list[int],
-                  header: str, n_in: int, n_out: int):
-    """Read samples in shuffled order, skipping unreadable/mismatched files
-    the way the driver does (``libhpnn.c:1230-1242``).
+# bulk loading in shuffle order lives in io.corpus (parallel loader +
+# packed corpus cache); it owns the driver's skip/diagnostic semantics
+# that used to live here as _load_ordered, byte-for-byte.
 
-    Returns (events, X, T) where events is a list of (header_line, row)
-    pairs in shuffle order; row is None for skipped files (their header is
-    still printed, unterminated, exactly like the reference which emits the
-    "FILE: name\\t" header before attempting the read).
-    """
-    xs, ts, events = [], [], []
-    for idx in order:
-        name = names[idx]
-        # NN_OUT(stdout,"%s FILE: %16.16s\t") -- printed before the read
-        line = f"{header} FILE: {name[:16]:>16}\t"
-        vec_in, vec_out = read_sample_fast(
-            os.path.join(dirpath, name), n_in, n_out)
-        if vec_in is None or vec_out is None:
-            events.append((line, None))
-            continue
-        if vec_in.shape[0] < n_in or vec_out.shape[0] < n_out:
-            # a section count SMALLER than the kernel dimension makes the
-            # reference copy past its allocation (libhpnn.c:1243, undefined
-            # behavior); we skip with a diagnostic -- documented deviation
-            nn_error(f"sample {name} dimension mismatch, skipped!\n")
-            events.append((line, None))
-            continue
-        # a LARGER count is deterministic in the reference: it copies the
-        # first kernel-dimension values and ignores the rest -- truncate
-        events.append((line, len(xs)))
-        xs.append(vec_in[:n_in])
-        ts.append(vec_out[:n_out])
-    if not xs:
-        return events, None, None
-    return events, np.stack(xs), np.stack(ts)
+# test-dir prefetch started by the last train_kernel call (tests join it
+# to assert the pack landed; production never waits on it)
+_prefetch_thread = None
 
 
 def train_kernel(nn: NNDef) -> bool:
@@ -261,13 +233,34 @@ def train_kernel(nn: NNDef) -> bool:
 
     from .utils.trace import phase, trace_weights
 
+    dtype = _dtype_of(conf)
+    # [dtype] bf16 keeps f32 MASTER weights on every training route
+    # (samples/activations stay bf16): pure-bf16 weight storage loses
+    # any update below a weight's bf16 ULP -- measured on the XRD BPM
+    # cycle as <1% of weights ever moving.  The Pallas kernel computes
+    # bf16 on the MXU against the f32 master; the XLA routes (DP/TP/
+    # non-TPU) promote the mixed bf16 x f32 matmuls to f32 -- mixed
+    # precision either way, never a silent training freeze.
+    wdtype = jnp.float32 if dtype == jnp.bfloat16 else dtype
     names = list_sample_dir(conf.samples)
+    staged = None
     if names is not None:
         order = _shuffle_order(conf, len(names))
+        # ingestion overlap: the corpus loads on background threads
+        # (pack-cache fast path, else parallel per-file reads) while
+        # this thread warms the device route -- H2D of the master
+        # weights and the epoch implementation selection (first jax /
+        # Pallas imports) run during the file walk instead of after it
+        handle = corpus_io.load_ordered_async(
+            conf.samples, names, order, "TRAINING",
+            nn.kernel.n_inputs, nn.kernel.n_outputs)
+        with phase("warmup"):
+            staged = tuple(jnp.asarray(w, dtype=wdtype)
+                           for w in nn.kernel.weights)
+            if conf.batch <= 0 and _model_shards(conf) <= 1:
+                ops.select_train_epoch(dtype)
         with phase("load_samples"):
-            events, xs, ts = _load_ordered(conf.samples, names, order,
-                                           "TRAINING", nn.kernel.n_inputs,
-                                           nn.kernel.n_outputs)
+            events, xs, ts = handle.result()
     else:
         events, xs, ts = [], None, None
     # multi-process agreement gate BEFORE any return path: a rank whose
@@ -306,19 +299,25 @@ def train_kernel(nn: NNDef) -> bool:
             nn_out(line)
         return finish()
 
-    dtype = _dtype_of(conf)
-    # [dtype] bf16 keeps f32 MASTER weights on every training route
-    # (samples/activations stay bf16): pure-bf16 weight storage loses
-    # any update below a weight's bf16 ULP -- measured on the XRD BPM
-    # cycle as <1% of weights ever moving.  The Pallas kernel computes
-    # bf16 on the MXU against the f32 master; the XLA routes (DP/TP/
-    # non-TPU) promote the mixed bf16 x f32 matmuls to f32 -- mixed
-    # precision either way, never a silent training freeze.
-    wdtype = jnp.float32 if dtype == jnp.bfloat16 else dtype
-    weights = tuple(jnp.asarray(w, dtype=wdtype) for w in nn.kernel.weights)
+    # the warmup block staged the master weights during the corpus load
+    # (names is not None on every path reaching here, so staged is set)
+    weights = staged
     # LNN trains through the SNN fallthrough (libhpnn.c:1260-1261)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
     trace_weights(weights, "train-in")
+
+    # prefetch the TEST corpus while the epoch runs on device: the host
+    # is idle through the device phase, so the pack for conf.tests is
+    # built in the background and the upcoming run_kernel (this process
+    # or the tutorial's fresh run_nn) warm-loads it.  Single-process
+    # only -- multi-host IO stays exactly as scheduled before.
+    global _prefetch_thread
+    _prefetch_thread = None
+    import jax
+
+    if conf.tests and jax.process_count() == 1:
+        _prefetch_thread = corpus_io.prefetch_pack_async(
+            conf.tests, nn.kernel.n_inputs, nn.kernel.n_outputs)
 
     model_shards = _model_shards(conf)
     if conf.batch > 0:
@@ -607,15 +606,30 @@ def run_kernel(nn: NNDef) -> None:
     usable = (nn.kernel is not None and conf.tests is not None
               and conf.type != NN_TYPE_UKN)
     names, events, xs, ts = None, [], None, None
+    weights = None
+    xs_dev = None
     if usable:
         names = list_sample_dir(conf.tests)
         if names is not None:
             order = _shuffle_order(conf, len(names))
+            # ingestion overlap: the test corpus loads in the background
+            # (warm loads mmap the pack train_kernel prefetched) while
+            # this thread stages the weights on device
+            handle = corpus_io.load_ordered_async(
+                conf.tests, names, order, "TESTING",
+                nn.kernel.n_inputs, nn.kernel.n_outputs)
+            with phase("warmup"):
+                dtype = _dtype_of(conf)
+                weights = tuple(jnp.asarray(w, dtype=dtype)
+                                for w in nn.kernel.weights)
+                ops.select_run_batch(dtype)
             with phase("load_tests"):
-                events, xs, ts = _load_ordered(conf.tests, names, order,
-                                               "TESTING",
-                                               nn.kernel.n_inputs,
-                                               nn.kernel.n_outputs)
+                events, xs, ts = handle.result()
+            if xs is not None:
+                # stream the loaded rows to device ahead of the eval
+                # launch: jax dispatch is async, so the H2D copy overlaps
+                # the agreement gate and event bookkeeping below
+                xs_dev = jnp.asarray(xs, dtype=dtype)
     # Coordinated eval bailout (the ann.c:242-248 handshake class, here
     # guarding the RUN path): one rank with a missing/divergent test dir
     # must abort EVERY rank before the sharded eval collective below, or
@@ -644,8 +658,9 @@ def run_kernel(nn: NNDef) -> None:
     if not agreed:
         return
 
+    # weights/xs_dev were staged during the overlapped load: every path
+    # reaching the eval below had usable names + loaded rows
     dtype = _dtype_of(conf)
-    weights = tuple(jnp.asarray(w, dtype=dtype) for w in nn.kernel.weights)
     # LNN evaluates through the SNN branch (libhpnn.c:1455-1456)
     kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
     model_shards = _model_shards(conf)
@@ -657,15 +672,12 @@ def run_kernel(nn: NNDef) -> None:
             from .parallel import tp_run_batch
 
             mesh, _ = _clamped_model_mesh(model_shards)
-            outs = np.asarray(
-                tp_run_batch(weights, jnp.asarray(xs, dtype=dtype), kind,
-                             mesh),
-                dtype=np.float64)
+            outs = np.asarray(tp_run_batch(weights, xs_dev, kind, mesh),
+                              dtype=np.float64)
         else:
             run_batch_fn, _ = ops.select_run_batch(dtype)
-            outs = np.asarray(
-                run_batch_fn(weights, jnp.asarray(xs, dtype=dtype), kind),
-                dtype=np.float64)
+            outs = np.asarray(run_batch_fn(weights, xs_dev, kind),
+                              dtype=np.float64)
 
     n_out = nn.kernel.n_outputs
     for line, i in events:

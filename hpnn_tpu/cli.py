@@ -3,12 +3,16 @@
 Flag-compatible rebuilds of the reference demo binaries
 (``/root/reference/tests/train_nn.c``, ``tests/run_nn.c``):
 
-    train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n] [conf]
-    run_nn   [-h] [-v]... [-O n] [-B n] [-S n] [conf]
+    train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n]
+             [--compile-cache DIR] [--corpus-cache DIR] [conf]
+    run_nn   [-h] [-v]... [-O n] [-B n] [-S n]
+             [--compile-cache DIR] [--corpus-cache DIR] [conf]
 
 * flags combine (``-vvv``) and -O/-B/-S accept attached (``-O4``) or
   separated (``-O 4``) values, like the reference parser
-  (``train_nn.c:100-199``);
+  (``train_nn.c:100-199``); the ``--compile-cache``/``--corpus-cache``
+  long options are rebuild extensions (persistent XLA program cache and
+  packed-corpus cache location, mirroring ``serve_nn``);
 * the conf file defaults to ``./nn.conf`` (``train_nn.c:215``);
 * train_nn dumps the untrained kernel to ``kernel.tmp`` before training and
   the trained kernel to ``kernel.opt`` after (``train_nn.c:224-243``) --
@@ -39,6 +43,10 @@ def _help_text(name: str, train: bool) -> str:
         "-O \tnumber of host threads (XLA-owned, kept for compatibility).",
         "-B \tnumber of BLAS threads (XLA-owned, kept for compatibility).",
         "-S \tnumber of device shards (XLA-owned, kept for compatibility).",
+        "--compile-cache DIR \tpersistent JAX compilation cache",
+        "\t(cold rounds reload compiled programs instead of recompiling).",
+        "--corpus-cache DIR \tpacked corpus cache location (default:",
+        "\ta dotfile next to each sample dir; HPNN_NO_CORPUS_CACHE=1 off).",
         "***********************************",
         "input:     neural network .def file",
         "contains the network definition and",
@@ -49,10 +57,18 @@ def _help_text(name: str, train: bool) -> str:
     return "\n".join(lines) + "\n"
 
 
+_LONG_OPTS = {"--compile-cache": "compile_cache",
+              "--corpus-cache": "corpus_cache"}
+
+
 def _parse_args(argv: list[str], name: str, train: bool):
-    """Reference-style parse; returns (filename, verbose) or None on -h,
-    raises SystemExit(-1) on syntax errors."""
+    """Reference-style parse; returns (filename, verbose, extras) or None
+    on -h, raises SystemExit(-1) on syntax errors.  ``extras`` holds the
+    long options this rebuild adds on top of the reference grammar
+    (--compile-cache/--corpus-cache, mirroring serve_nn); anything else
+    starting with ``--`` still errors like the reference parser."""
     filename = None
+    extras = {v: None for v in _LONG_OPTS.values()}
     numeric = {"O": runtime.set_omp_threads, "B": runtime.set_omp_blas,
                "S": runtime.set_cuda_streams}
     i = 0
@@ -61,6 +77,18 @@ def _parse_args(argv: list[str], name: str, train: bool):
         if arg == "-":
             # bare '-': the reference's switch loop sees ISGRAPH('\0') false
             # and silently ignores the argument (train_nn.c:86)
+            i += 1
+            continue
+        key, eq, val = arg.partition("=")
+        if key in _LONG_OPTS:
+            if not eq:
+                i += 1
+                val = argv[i] if i < len(argv) else ""
+            if not val:
+                sys.stderr.write(f"syntax error: bad {key} parameter!\n")
+                sys.stdout.write(_help_text(name, train))
+                raise SystemExit(-1)
+            extras[_LONG_OPTS[key]] = val
             i += 1
             continue
         if arg.startswith("-"):
@@ -110,7 +138,19 @@ def _parse_args(argv: list[str], name: str, train: bool):
                 raise SystemExit(-1)
             filename = arg
         i += 1
-    return filename or "./nn.conf", nn_log.get_verbosity()
+    return filename or "./nn.conf", nn_log.get_verbosity(), extras
+
+
+def _apply_extras(extras: dict) -> None:
+    """Wire the long options into the runtime: an explicit flag wins over
+    the HPNN_* env defaults init_all applied (same contract as serve_nn's
+    --compile-cache)."""
+    if extras.get("compile_cache"):
+        runtime.enable_compilation_cache(extras["compile_cache"])
+    if extras.get("corpus_cache"):
+        from .io import corpus
+
+        corpus.set_cache_dir(extras["corpus_cache"])
 
 
 def train_nn_main(argv: list[str] | None = None) -> int:
@@ -124,7 +164,8 @@ def train_nn_main(argv: list[str] | None = None) -> int:
     if parsed is None:
         runtime.deinit_all()
         return 0
-    filename, _verbose = parsed
+    filename, _verbose, extras = parsed
+    _apply_extras(extras)
     with phase("configure"):
         neural = configure(filename)
     if neural is None:
@@ -166,7 +207,8 @@ def run_nn_main(argv: list[str] | None = None) -> int:
     if parsed is None:
         runtime.deinit_all()
         return 0
-    filename, _verbose = parsed
+    filename, _verbose, extras = parsed
+    _apply_extras(extras)
     with phase("configure"):
         neural = configure(filename)
     if neural is None:

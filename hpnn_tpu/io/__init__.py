@@ -1,4 +1,10 @@
 from .conf import NNConf, dump_conf, load_conf, parse_conf
+from .corpus import (
+    load_ordered,
+    load_ordered_async,
+    pack_path,
+    prefetch_pack_async,
+)
 from .kernel_io import dump_kernel, dump_kernel_to_path, load_kernel
 from .samples import list_sample_dir, read_sample
 
@@ -12,4 +18,8 @@ __all__ = [
     "dump_kernel_to_path",
     "read_sample",
     "list_sample_dir",
+    "load_ordered",
+    "load_ordered_async",
+    "prefetch_pack_async",
+    "pack_path",
 ]

@@ -18,8 +18,8 @@ that behavior exactly (round-5 oracle sweep; the old version was stricter
 AND accepted multi-line values -- both divergences).  One deliberate
 deviation remains at the DRIVER level: a file whose section count is
 smaller than the kernel's dimension makes the reference copy past its
-allocation (libhpnn.c:1243, undefined behavior) -- ``api._load_ordered``
-skips such files with a diagnostic instead.
+allocation (libhpnn.c:1243, undefined behavior) -- the corpus loader
+(``io.corpus``) skips such files with a diagnostic instead.
 Directory listing skips dotfiles (``libhpnn.c:1194-1198``)
 and preserves the OS readdir order, exactly like the reference -- required for
 the end-to-end training parity proven in tests/test_reference_parity.py (see
@@ -31,10 +31,11 @@ from __future__ import annotations
 import ctypes
 import os
 import re
+import threading
 
 import numpy as np
 
-from ..utils.nn_log import nn_error
+from ..utils.nn_log import nn_error, nn_warn
 
 # C strtod's accepted prefix: hex floats first (else the decimal branch
 # would stop at the "0" of "0x1f"), then decimal w/ optional exponent
@@ -271,33 +272,52 @@ def read_sample(path: str) -> tuple[np.ndarray | None, np.ndarray | None]:
 # so diagnostics and edge-case behavior stay byte-identical.
 
 _native_lib = None
+_native_lock = threading.Lock()
+_native_warned = False
 
 
 def _native():
-    global _native_lib
+    global _native_lib, _native_warned
     if _native_lib is not None:
         return _native_lib or None
-    if os.environ.get("HPNN_NO_NATIVE_IO"):
-        _native_lib = False
-        return None
-    path = os.environ.get("HPNN_IO_LIB") or os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)))), "native", "libhpnn_io.so")
-    try:
-        lib = ctypes.CDLL(path)
-        lib.hpnn_read_sample.restype = ctypes.c_int
-        lib.hpnn_read_sample.argtypes = [
-            ctypes.c_char_p,
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int),
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int,
-            ctypes.POINTER(ctypes.c_int),
-        ]
-        _native_lib = lib
-    except OSError:
-        _native_lib = False
-        return None
+    with _native_lock:  # the parallel loader probes from worker threads
+        if _native_lib is not None:
+            return _native_lib or None
+        if os.environ.get("HPNN_NO_NATIVE_IO"):
+            _native_lib = False
+            return None
+        path = os.environ.get("HPNN_IO_LIB") or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), "native", "libhpnn_io.so")
+        try:
+            lib = ctypes.CDLL(path)
+            lib.hpnn_read_sample.restype = ctypes.c_int
+            lib.hpnn_read_sample.argtypes = [
+                ctypes.c_char_p,
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+                ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+                ctypes.POINTER(ctypes.c_int),
+            ]
+            _native_lib = lib
+        except OSError as exc:
+            # the fallback used to be SILENT: a deleted/unbuildable .so
+            # quietly reparsed 60k files in Python at ~10x the cost.
+            # Diagnose once, name the path tried, keep serving.
+            _native_lib = False
+            if not _native_warned:
+                _native_warned = True
+                nn_warn(f"native sample loader unavailable "
+                        f"({path}: {exc}); parsing samples in Python\n")
+            return None
     return _native_lib
+
+
+def native_io_status() -> str:
+    """'on' when the native fast path serves reads, 'off' otherwise
+    (opt-out env or a failed library load) -- surfaced in the loader's
+    load-stats line and the serve /metrics snapshot."""
+    return "on" if _native() is not None else "off"
 
 
 def read_sample_fast(path: str, n_in_hint: int, n_out_hint: int):
@@ -347,5 +367,6 @@ def list_sample_dir(dirpath: str) -> list[str] | None:
             and os.path.isfile(os.path.join(dirpath, n))]
 
 
-# NOTE: bulk loading in shuffle order lives in hpnn_tpu.api._load_ordered,
-# which owns the driver's skip/diagnostic semantics (one loader, no drift).
+# NOTE: bulk loading in shuffle order lives in hpnn_tpu.io.corpus
+# (parallel loader + packed corpus cache), which owns the driver's
+# skip/diagnostic semantics (one loader, no drift).

@@ -172,6 +172,8 @@ class ServeMetrics:
         }
 
     def snapshot(self) -> dict:
+        from ..io.samples import native_io_status
+
         depths = {name: fn() for name, fn in list(self._depth_fns.items())}
         with self._lock:
             req = dict(self.requests)
@@ -181,6 +183,10 @@ class ServeMetrics:
                 "batches_total": self.batches_total,
                 "compile_cache": {"hits": self.cache_hits,
                                   "misses": self.cache_misses},
+                # whether the native sample loader backs corpus ingestion
+                # (registration/warmup reload paths); "off" means the
+                # silent-fallback Python parser is doing the work
+                "native_io": native_io_status(),
             }
         out["batch_fill_ratio"] = round(self.batch_fill_ratio(), 4)
         out["queue_depth"] = depths
@@ -219,6 +225,11 @@ class ServeMetrics:
             f"{snap['compile_cache']['hits']}",
             'hpnn_serve_compile_cache_total{result="miss"} '
             f"{snap['compile_cache']['misses']}",
+            "# HELP hpnn_serve_native_io Native sample-loader in use "
+            "(1=on, 0=Python fallback).",
+            "# TYPE hpnn_serve_native_io gauge",
+            f"hpnn_serve_native_io "
+            f"{1 if snap['native_io'] == 'on' else 0}",
             "# HELP hpnn_serve_queue_depth Requests waiting per kernel.",
             "# TYPE hpnn_serve_queue_depth gauge",
         ]

@@ -212,20 +212,29 @@ class ServedModel:
 
     def warmup(self, workers: int | None = None) -> int:
         """Compile every batch bucket up front so steady-state traffic
-        never pays a trace/compile.  Buckets compile CONCURRENTLY
-        (``workers`` threads, default min(4, n_buckets)): a 10-bucket
-        model warms in max-compile time, not sum-compile time -- jit
-        compilation releases the GIL into XLA and is thread-safe.
-        Returns the bucket count."""
+        never pays a trace/compile.  Buckets compile CONCURRENTLY -- jit
+        compilation releases the GIL into XLA and is thread-safe.  By
+        default (``workers=None``) the compiles ride the shared
+        ingestion executor (``io.corpus.io_pool``): one bounded
+        background pool per process for corpus reads, pack prefetch and
+        warmup compiles instead of a fresh thread pool per model.  An
+        explicit ``workers`` count keeps the old private-pool behavior
+        (tests pin exact concurrency with it).  Returns the bucket
+        count."""
         buckets = self._buckets()
 
         def one(b: int) -> None:
             self.registry.forward(
                 self, np.zeros((b, self.n_inputs), np.float64))
 
-        if workers is None:
-            workers = min(4, len(buckets))
-        if workers <= 1 or len(buckets) == 1:
+        if workers is None and len(buckets) > 1:
+            from ..io.corpus import io_pool
+
+            # result() propagates the first worker exception, like the
+            # serial loop would
+            for f in [io_pool().submit(one, b) for b in buckets]:
+                f.result()
+        elif workers is None or workers <= 1 or len(buckets) == 1:
             for b in buckets:
                 one(b)
         else:

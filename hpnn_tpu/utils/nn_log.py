@@ -20,10 +20,13 @@ strings are a de-facto API of the framework.
 
 from __future__ import annotations
 
+import contextlib
 import sys
+import threading
 
 _verbosity = 0
 _is_main_process: bool | None = None
+_tls = threading.local()
 
 
 def _main_process() -> bool:
@@ -67,26 +70,73 @@ def _emit(stream, text: str) -> None:
         stream.flush()
 
 
+# --- deferred emission (thread-local capture) -------------------------------
+# The parallel corpus loader (io/corpus.py) parses files on worker threads
+# but must keep the console stream byte-identical to the serial loader:
+# each worker CAPTURES what its read would have printed, and the assembly
+# loop REPLAYS the entries at exactly the position the serial loop would
+# have emitted them.  Capture records (level, text) BEFORE the verbosity
+# gate; replay re-enters the normal functions, so gating/prefixes apply
+# once, at replay time -- the same moment the serial path would gate.
+
+@contextlib.contextmanager
+def capture(into: list | None = None):
+    """Divert this thread's nn_* output into a list of (level, text)."""
+    entries = into if into is not None else []
+    prev = getattr(_tls, "sink", None)
+    _tls.sink = entries
+    try:
+        yield entries
+    finally:
+        _tls.sink = prev
+
+
+def replay(entries) -> None:
+    """Emit captured entries through the normal gated functions."""
+    fns = {"dbg": nn_dbg, "out": nn_out, "cout": nn_cout,
+           "warn": nn_warn, "error": nn_error}
+    for level, text in entries:
+        fns[level](text)
+
+
+def _capture(level: str, text: str) -> bool:
+    sink = getattr(_tls, "sink", None)
+    if sink is None:
+        return False
+    sink.append((level, text))
+    return True
+
+
 def nn_dbg(text: str) -> None:
+    if _capture("dbg", text):
+        return
     if _verbosity > 2:
         _emit(sys.stdout, "NN(DBG): " + text)
 
 
 def nn_out(text: str) -> None:
+    if _capture("out", text):
+        return
     if _verbosity > 1:
         _emit(sys.stdout, "NN: " + text)
 
 
 def nn_cout(text: str) -> None:
     """Continuation output -- no prefix (libhpnn.h:107-111)."""
+    if _capture("cout", text):
+        return
     if _verbosity > 1:
         _emit(sys.stdout, text)
 
 
 def nn_warn(text: str) -> None:
+    if _capture("warn", text):
+        return
     if _verbosity > 0:
         _emit(sys.stdout, "NN(WARN): " + text)
 
 
 def nn_error(text: str) -> None:
+    if _capture("error", text):
+        return
     _emit(sys.stderr, "NN(ERR): " + text)

@@ -1,0 +1,185 @@
+"""Generate IO_BENCH.json: corpus-ingestion throughput rows.
+
+Measures the three ingestion paths on a generated pmnist-style corpus
+(default 10k files) through the SAME ``io.corpus.load_ordered`` entry
+the drivers use, asserting along the way that every path returns
+identical rows (the parity contract is part of the bench):
+
+* ``serial``         -- the serial per-file path: one file at a time
+  through the reference-exact parser in ``io/samples.py``
+  (``HPNN_NO_NATIVE_IO``), the ISSUE-3 baseline;
+* ``serial_native``  -- one file at a time with the native C reader
+  riding along (the pre-pipeline production fast path) -- context row;
+* ``parallel_cold``  -- the thread-pool loader (pack cache off);
+* ``pack_build``     -- parallel cold load + pack write (first touch);
+* ``pack_warm``      -- mmap'd pack replay (steady-state rounds; cost
+  is the parallel stat fingerprint pass, nothing opens the files).
+
+Acceptance floors (ISSUE 3): ``pack_warm`` >= 5x and ``parallel_cold``
+>= 2x over ``serial``; the ``speedups`` block also records both
+against ``serial_native`` for honesty (sandboxed CI filesystems
+serialize concurrent syscalls, capping the parallel win over the
+native-serial row well below what multi-core hosts see).
+
+Usage: python scripts/io_bench.py [--files 10000] [--n-in 196]
+       [--n-out 10] [--threads N] [--out IO_BENCH.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from hpnn_tpu.io import corpus, samples  # noqa: E402
+from hpnn_tpu.utils.glibc_random import GlibcRandom, shuffled_indices  # noqa: E402
+
+
+def gen_corpus(d: str, files: int, n_in: int, n_out: int) -> None:
+    if os.path.isdir(d) and len(
+            [n for n in os.listdir(d) if not n.startswith(".")]) == files:
+        return
+    print(f"generating {files}-file corpus under {d} ...", flush=True)
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(12345)
+    t0 = time.time()
+    for i in range(files):
+        x = rng.uniform(0.0, 255.0, n_in)
+        t = -np.ones(n_out)
+        t[i % n_out] = 1.0
+        with open(os.path.join(d, f"s{i:06d}"), "w") as fp:
+            fp.write(f"[input] {n_in}\n"
+                     + " ".join(f"{v:7.5f}" for v in x)
+                     + f"\n[output] {n_out}\n"
+                     + " ".join(f"{v:.1f}" for v in t) + "\n")
+    print(f"  corpus written in {time.time() - t0:.0f}s", flush=True)
+
+
+def corpus_bytes(d: str, names: list[str]) -> int:
+    return sum(os.stat(os.path.join(d, n)).st_size for n in names)
+
+
+def run_mode(tag: str, d: str, names, order, n_in: int, n_out: int,
+             env: dict) -> tuple[float, tuple]:
+    saved = {}
+    for k, v in env.items():
+        saved[k] = os.environ.get(k)
+        if v is None:
+            os.environ.pop(k, None)
+        else:
+            os.environ[k] = v
+    samples._native_lib = None  # env may flip HPNN_NO_NATIVE_IO
+    try:
+        t0 = time.perf_counter()
+        out = corpus.load_ordered(d, names, order, "TRAINING", n_in, n_out)
+        dt = time.perf_counter() - t0
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        samples._native_lib = None
+    return dt, out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--files", type=int, default=10000)
+    ap.add_argument("--n-in", type=int, default=196)
+    ap.add_argument("--n-out", type=int, default=10)
+    ap.add_argument("--threads", type=int, default=0,
+                    help="parallel pool width (0: the loader default)")
+    ap.add_argument("--workdir",
+                    default=os.path.join(REPO, ".scratch", "io_bench"))
+    ap.add_argument("--out", default=os.path.join(REPO, "IO_BENCH.json"))
+    args = ap.parse_args()
+
+    d = os.path.join(args.workdir, f"corpus-{args.files}")
+    gen_corpus(d, args.files, args.n_in, args.n_out)
+    names = samples.list_sample_dir(d)
+    order = shuffled_indices(GlibcRandom(10958), len(names))
+    total_mb = corpus_bytes(d, names) / 1e6
+    threads = {"HPNN_IO_THREADS": str(args.threads)} if args.threads else {}
+
+    pack = corpus.pack_path(d)
+    if os.path.exists(pack):
+        os.unlink(pack)
+
+    modes = [
+        ("serial", dict(HPNN_NO_CORPUS_CACHE="1",
+                        HPNN_IO_THREADS="1", HPNN_NO_NATIVE_IO="1")),
+        ("serial_native", dict(HPNN_NO_CORPUS_CACHE="1",
+                               HPNN_IO_THREADS="1")),
+        ("parallel_cold", dict({"HPNN_NO_CORPUS_CACHE": "1",
+                                "HPNN_NO_NATIVE_IO": None}, **threads)),
+        ("pack_build", dict(threads)),
+        ("pack_warm", dict(threads)),
+    ]
+    rows, ref = {}, None
+    for tag, env in modes:
+        dt, out = run_mode(tag, d, names, order, args.n_in, args.n_out, env)
+        events, X, T = out
+        if ref is None:
+            ref = out
+        else:
+            assert events == ref[0], f"{tag}: events diverge"
+            np.testing.assert_array_equal(X, ref[1], err_msg=tag)
+            np.testing.assert_array_equal(T, ref[2], err_msg=tag)
+        rows[tag] = {
+            "seconds": round(dt, 4),
+            "files_per_sec": round(len(names) / dt, 1),
+            "mb_per_sec": round(total_mb / dt, 2),
+        }
+        print(f"{tag:>14}: {dt:8.3f}s  {rows[tag]['files_per_sec']:>9} "
+              f"files/s  {rows[tag]['mb_per_sec']:>8} MB/s", flush=True)
+    assert os.path.exists(pack), "pack_build did not write the pack"
+
+    serial = rows["serial"]["seconds"]
+    native = rows["serial_native"]["seconds"]
+    result = {
+        "files": len(names),
+        "n_in": args.n_in,
+        "n_out": args.n_out,
+        "corpus_mb": round(total_mb, 2),
+        "io_threads": args.threads or corpus.io_threads(),
+        "cpu_count": os.cpu_count(),
+        "native_io": samples.native_io_status(),
+        "rows": rows,
+        "speedups": {
+            "parallel_cold_vs_serial": round(
+                serial / rows["parallel_cold"]["seconds"], 2),
+            "pack_warm_vs_serial": round(
+                serial / rows["pack_warm"]["seconds"], 2),
+            "parallel_cold_vs_serial_native": round(
+                native / rows["parallel_cold"]["seconds"], 2),
+            "pack_warm_vs_serial_native": round(
+                native / rows["pack_warm"]["seconds"], 2),
+        },
+    }
+    result["acceptance"] = {
+        "parallel_cold_ge_2x":
+            result["speedups"]["parallel_cold_vs_serial"] >= 2.0,
+        "pack_warm_ge_5x":
+            result["speedups"]["pack_warm_vs_serial"] >= 5.0,
+    }
+    ok = all(result["acceptance"].values())
+    with open(args.out, "w") as fp:
+        json.dump(result, fp, indent=1)
+        fp.write("\n")
+    print(f"wrote {args.out}")
+    print(json.dumps(result["speedups"]))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -113,10 +113,16 @@ def run_tpu_cycle(workdir, rounds, dtype="f32", conf_writer=None):
     reuses the cycle protocol with its own)."""
     wconf = conf_writer or write_conf
     env = dict(os.environ, HPNN_PROFILE="1")
+    # one shared compilation cache per scale run (the new CLI flag): the
+    # round-0 eval used to pay the full cold-compile spike every time a
+    # fresh .scratch was provisioned; with the explicit cache the spike
+    # is paid once per cache lifetime, not once per cycle
+    jaxcache = os.path.join(os.path.dirname(os.path.abspath(workdir)),
+                            "jaxcache")
     train_cmd = [sys.executable, os.path.join(REPO, "apps/train_nn.py"),
-                 "-v", "-v", "nn.conf"]
+                 "-v", "-v", "--compile-cache", jaxcache, "nn.conf"]
     run_cmd = [sys.executable, os.path.join(REPO, "apps/run_nn.py"),
-               "-v", "-v", "nn.conf"]
+               "-v", "-v", "--compile-cache", jaxcache, "nn.conf"]
     records = []
     for rnd in range(rounds + 1):
         wconf(workdir, first=(rnd == 0), dtype=dtype)
@@ -238,12 +244,19 @@ def run_ref_cross_eval(workdir, ref_workdir, conf_writer=None,
     return {"pass": acc, "seconds": round(dt, 1)}
 
 
+def _count_samples(dirpath) -> int:
+    """Sample files in a corpus dir -- dotfiles excluded, exactly like
+    the driver's listing (the ingestion pipeline may leave dot-prefixed
+    pack/cache artifacts near corpora; they are not samples)."""
+    return sum(1 for n in os.listdir(dirpath) if not n.startswith("."))
+
+
 def corpus_complete(root, n_train, n_test) -> bool:
     """Guard against an interrupted multi-minute generation being reused
     as a full corpus: both directories must hold their full file count."""
     try:
-        return (len(os.listdir(os.path.join(root, "samples"))) == n_train
-                and len(os.listdir(os.path.join(root, "tests"))) == n_test)
+        return (_count_samples(os.path.join(root, "samples")) == n_train
+                and _count_samples(os.path.join(root, "tests")) == n_test)
     except FileNotFoundError:
         return False
 
@@ -318,7 +331,8 @@ def subset_workdir(base, full_workdir, n_train, n_test):
             src = os.path.join(os.path.abspath(full_workdir), d)
             dst = os.path.join(sub, d)
             os.makedirs(dst, exist_ok=True)
-            for name in sorted(os.listdir(src))[:n]:
+            for name in sorted(m for m in os.listdir(src)
+                               if not m.startswith("."))[:n]:
                 os.symlink(os.path.join(src, name),
                            os.path.join(dst, name))
     return sub
