@@ -19,15 +19,29 @@ FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
 MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_pallas_convergence.py tests/test_cli_e2e.py
 SERVE_TESTS = tests/test_serve.py
+CKPT_TESTS = tests/test_ckpt.py
 
 check:
-	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) -q
+	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
+	    $(CKPT_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
 # scripts/serve_bench.py's client pool)
 serve-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(SERVE_TESTS) -q
+
+# checkpoint tier: snapshot atomicity/retention units, serve hot reload,
+# and the resume-parity e2e (kill-at-epoch-k + --resume == uninterrupted,
+# byte-for-byte, in-process AND across real process death)
+ckpt-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(CKPT_TESTS) -q
+
+# snapshot overhead (sync vs async io_pool writes) + hot-reload latency
+# under a client load; emits CKPT_BENCH.json
+ckpt-bench:
+	env JAX_PLATFORMS=cpu python scripts/ckpt_bench.py \
+	    --out CKPT_BENCH.json
 
 check-all:
 	python -m pytest tests/ -q
@@ -55,4 +69,5 @@ serve-bench:
 io-bench:
 	env JAX_PLATFORMS=cpu python scripts/io_bench.py --out IO_BENCH.json
 
-.PHONY: check check-all serve-check native bench serve-bench io-bench
+.PHONY: check check-all serve-check ckpt-check ckpt-bench native bench \
+    serve-bench io-bench

@@ -3,7 +3,10 @@
 
 Usage: run_nn [-h] [-v]... [-O n] [-B n] [-S n]
               [--compile-cache DIR] [--corpus-cache DIR]
-              [conf (default ./nn.conf)]
+              [--ckpt-dir DIR] [conf (default ./nn.conf)]
+
+--ckpt-dir names the checkpoint directory whose manifest fingerprint
+guards against evaluating a stale/modified kernel file (default ./ckpt).
 """
 import os
 import sys

@@ -6,6 +6,7 @@ Usage: serve_nn [-v]... [-a addr] [-p port] [-b max-batch] [-q queue-rows]
                 [--parity strict|fast] [--fast-threshold N] [--mesh N]
                 [--compile-cache DIR]
                 [--warmup-mode background|sync|off] [--no-warmup]
+                [--watch-ckpt [NAME=]DIR] [--watch-interval S]
                 [conf (default ./nn.conf)]...
 
 Takes the same nn.conf files as run_nn; see hpnn_tpu/serve/ and the

@@ -3,7 +3,14 @@
 
 Usage: train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n]
                 [--compile-cache DIR] [--corpus-cache DIR]
+                [--epochs N] [--ckpt-every N] [--ckpt-dir DIR]
+                [--ckpt-keep N] [--resume [PATH]]
                 [conf (default ./nn.conf)]
+
+The --epochs/--ckpt-*/--resume family is the checkpoint subsystem
+(hpnn_tpu/ckpt): crash-safe epoch-boundary snapshots and bit-exact
+resumable training; see the README "Checkpointing, resume & hot
+reload" section.
 """
 import os
 import sys

@@ -18,6 +18,8 @@ Package map:
     models/    the MLP kernel container + seeded generation
     ops/       jit step functions: forward, error, deltas, BP/BPM, while-loop
     parallel/  mesh runtime, TP/DP shardings, collectives
+    ckpt/      crash-safe snapshots, bit-exact resume, model lifecycle
+    serve/     long-lived inference serving (registry, batcher, HTTP)
     api.py     nn_def-level driver API (train_kernel / run_kernel)
 """
 
@@ -30,7 +32,7 @@ __all__ = ["io", "models", "runtime", "utils", "__version__"]
 
 def __getattr__(name):
     # ops/api/cli/parallel pull in jax; import lazily so pure-IO use stays light
-    if name in ("ops", "api", "cli", "parallel"):
+    if name in ("ops", "api", "cli", "parallel", "ckpt", "serve"):
         import importlib
 
         mod = importlib.import_module("." + name, __name__)

@@ -74,6 +74,16 @@ class NNDef:
 
     conf: NNConf
     kernel: Kernel | None = None
+    # persistent shuffle stream for in-process multi-epoch training
+    # (ckpt.trainer): when set, every train_kernel call CONTINUES this
+    # glibc stream instead of re-seeding -- and the checkpoint subsystem
+    # snapshots/restores its words for bit-exact resume.  None keeps the
+    # reference's fresh-srandom-per-process behavior.
+    shuffle_rng: object | None = None
+    # summary of the last completed training epoch (mean final error
+    # etc.), read by the checkpoint manager for the manifest's error
+    # trajectory; None until an epoch has run
+    last_epoch_stats: dict | None = None
 
     # accessor parity with _NN(get,n_inputs) etc. (libhpnn.c:1013-1066)
     @property
@@ -191,9 +201,13 @@ def _dtype_of(conf: NNConf):
             "bf16": jnp.bfloat16}.get(conf.dtype, jnp.float64)
 
 
-def _shuffle_order(conf: NNConf, n: int) -> list[int]:
+def _shuffle_order(conf: NNConf, n: int, rng=None) -> list[int]:
     """Seeded shuffle of n files (libhpnn.c:1218-1229); seed 0 -> time()
-    written back into the conf, as the reference mutates _CONF.seed."""
+    written back into the conf, as the reference mutates _CONF.seed.
+    A persistent ``rng`` (multi-epoch training, NNDef.shuffle_rng)
+    continues its stream instead of re-seeding."""
+    if rng is not None:
+        return shuffled_indices(rng, n)
     if conf.seed == 0:
         conf.seed = int(time.time())
     return shuffled_indices(GlibcRandom(conf.seed), n)
@@ -242,10 +256,11 @@ def train_kernel(nn: NNDef) -> bool:
     # non-TPU) promote the mixed bf16 x f32 matmuls to f32 -- mixed
     # precision either way, never a silent training freeze.
     wdtype = jnp.float32 if dtype == jnp.bfloat16 else dtype
+    nn.last_epoch_stats = None
     names = list_sample_dir(conf.samples)
     staged = None
     if names is not None:
-        order = _shuffle_order(conf, len(names))
+        order = _shuffle_order(conf, len(names), nn.shuffle_rng)
         # ingestion overlap: the corpus loads on background threads
         # (pack-cache fast path, else parallel per-file reads) while
         # this thread warms the device route -- H2D of the master
@@ -362,7 +377,8 @@ def train_kernel(nn: NNDef) -> bool:
                 kind, momentum, alpha=0.2)  # alpha=.2 (libhpnn.c:1248)
             nn.kernel.weights = [np.asarray(w, dtype=np.float64)
                                  for w in new_weights]
-        _emit_training_lines(events, stats, kind, momentum)
+        nn.last_epoch_stats = _emit_training_lines(events, stats, kind,
+                                                   momentum)
         ok = finish()
     trace_weights(nn.kernel.weights, "train-out")
     return ok
@@ -379,9 +395,11 @@ def _model_shards(conf: NNConf) -> int:
     return runtime.lib_runtime.n_streams
 
 
-def _emit_training_lines(events, stats, kind: str, momentum: bool) -> None:
+def _emit_training_lines(events, stats, kind: str, momentum: bool) -> dict:
     """Reconstruct the reference's per-sample console stream from scanned
-    statistics (grammar: ann.c:2322-2366, snn.c:1496-1499)."""
+    statistics (grammar: ann.c:2322-2366, snn.c:1496-1499).  Returns the
+    epoch summary (mean final error, success count) the checkpoint
+    manifest's error trajectory records."""
     init_err = np.asarray(stats.init_err, dtype=np.float64)
     first_ok = np.asarray(stats.first_ok)
     n_iter = np.asarray(stats.n_iter)
@@ -403,6 +421,10 @@ def _emit_training_lines(events, stats, kind: str, momentum: bool) -> None:
             nn_cout(" SUCCESS!\n" if success[i] else " FAIL!\n")
         if final_dep[i] > 0.1:
             nn_dbg("bad optimization!\n")
+    n = int(final_dep.shape[0])
+    return {"samples": n,
+            "mean_final": float(np.mean(final_dep)) if n else None,
+            "success": int(np.sum(success)) if n else 0}
 
 
 def _clamped_model_mesh(shards: int):
@@ -451,7 +473,8 @@ def _train_kernel_tp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
         kind, momentum, mesh, alpha=0.2)
     # events' row index i is assigned in load order, so the i-th loaded
     # row is the i-th entry of the scanned-out stats
-    _emit_training_lines(events, stats, kind, momentum)
+    nn.last_epoch_stats = _emit_training_lines(events, stats, kind,
+                                               momentum)
     nn.kernel.weights = [np.asarray(v, dtype=np.float64) for v in w]
     return finish()
 
@@ -585,6 +608,9 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     errs = np.asarray(errs, dtype=np.float64)
     for i in range(n_batches):
         nn_out(f"TRAINING BATCH {i:8d}\t err={errs[i]:15.10f}\n")
+    nn.last_epoch_stats = {"samples": int(s),
+                           "mean_final": float(np.mean(errs)),
+                           "success": 0}
     nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
     return finish()
 

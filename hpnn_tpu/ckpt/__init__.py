@@ -1,0 +1,41 @@
+"""Checkpoint & model-lifecycle subsystem.
+
+Crash-safe epoch-boundary snapshots, bit-exact ``train_nn --resume``,
+and the manifest contract the serving registry's hot-reload watcher
+consumes.  See ``snapshot.py`` for the on-disk format, ``manager.py``
+for the async (io_pool) writer, ``trainer.py`` for the multi-epoch
+driver with SIGTERM/SIGINT final-snapshot handling.
+"""
+
+from .manager import CheckpointManager
+from .snapshot import (
+    MANIFEST,
+    SNAPSHOT_KERNEL,
+    SNAPSHOT_META,
+    SNAPSHOT_STATE,
+    SnapshotState,
+    check_kernel_fingerprint,
+    fingerprint_bytes,
+    fingerprint_file,
+    load_bundle_kernel,
+    load_snapshot,
+    looks_like_checkpoint,
+    manifest_path,
+    publish_snapshot,
+    read_manifest,
+    record_final_kernel,
+    refresh_final_kernel,
+    snapshot_tag,
+    write_manifest,
+    write_snapshot,
+)
+from .trainer import train_loop
+
+__all__ = [
+    "CheckpointManager", "MANIFEST", "SNAPSHOT_KERNEL", "SNAPSHOT_META",
+    "SNAPSHOT_STATE", "SnapshotState", "check_kernel_fingerprint",
+    "fingerprint_bytes", "fingerprint_file", "load_bundle_kernel",
+    "load_snapshot", "looks_like_checkpoint", "manifest_path", "publish_snapshot",
+    "read_manifest", "record_final_kernel", "refresh_final_kernel", "snapshot_tag", "train_loop",
+    "write_manifest", "write_snapshot",
+]

@@ -1,0 +1,159 @@
+"""CheckpointManager: epoch-boundary snapshots off the critical path.
+
+The training loop hands the manager a *captured* copy of the mutable
+state at each epoch boundary (weight list reference -- the epoch
+replaces the list wholesale, never mutates it in place -- plus a copy
+of the RNG words and the error trajectory) and keeps running; the
+bundle is formatted and fsync'd on the shared ``io.corpus.io_pool``
+executor, overlapping the next epoch's device work exactly the way the
+corpus prefetcher does.  Writes are CHAINED through done-callbacks (a
+queued snapshot is only submitted when its predecessor finishes) so
+bundles and manifest generations land in epoch order while occupying
+at most one pool thread -- a burst of snapshots can never starve the
+corpus loader sharing the pool.
+
+Console discipline: the manager prints its one ``CKPT: snapshot ...``
+line synchronously on the training thread -- the async writer itself is
+silenced (``nn_log.capture``) so background completion can never
+interleave with the per-sample training stream, whose byte-for-byte
+reproducibility is the repo's core guarantee (and the resume-parity
+acceptance test compares whole console streams).
+
+Failures are never dropped: the first writer exception is re-raised
+from :meth:`flush` (the CLI flushes before declaring the run done).
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..io.conf import NN_TRAIN_BPM
+from ..utils import nn_log
+from ..utils.nn_log import nn_out
+from . import snapshot as snap
+
+
+class CheckpointManager:
+    def __init__(self, ckpt_dir: str, every: int = 1, keep_last: int = 0,
+                 use_pool: bool = True, target_epochs: int = 0):
+        self.ckpt_dir = ckpt_dir
+        self.every = max(0, int(every))
+        self.keep_last = max(0, int(keep_last))
+        self.use_pool = use_pool
+        # the run's --epochs goal, recorded in every bundle so a bare
+        # --resume knows how far the interrupted run meant to go
+        self.target_epochs = max(0, int(target_epochs))
+        self.errors: list[float | None] = []
+        self.last_saved_epoch = 0
+        self._future = None
+        self._lock = threading.Lock()
+
+    # --- trajectory -------------------------------------------------------
+    def seed_errors(self, errors) -> None:
+        """Carry the restored trajectory across a resume so the manifest
+        keeps the WHOLE run's error curve."""
+        self.errors = list(errors)
+
+    # --- capture ----------------------------------------------------------
+    def _capture(self, nn, epoch: int) -> dict:
+        conf = nn.conf
+        kernel = nn.kernel
+        momentum = kernel.momentum
+        if momentum is None and conf.train == NN_TRAIN_BPM:
+            # the reference zeroes the dw buffers at every sample entry
+            # (ann_raz_momentum, ann.c:2391) and frees them at epoch end,
+            # so the canonical BPM momentum state AT an epoch boundary is
+            # all-zeros -- that is what the bundle records
+            momentum = [np.zeros_like(w) for w in kernel.weights]
+        return {
+            "weights": kernel.weights,  # replaced per epoch, safe to share
+            "momentum": None if momentum is None
+            else [np.array(m, dtype=np.float64) for m in momentum],
+            "rng_state": (nn.shuffle_rng.get_state()
+                          if nn.shuffle_rng is not None else None),
+            "seed": int(conf.seed),
+            "epoch": int(epoch),
+            "errors": list(self.errors),
+            "name": kernel.name,
+            "train": conf.train,
+            "dtype": conf.dtype,
+            "target_epochs": self.target_epochs,
+        }
+
+    # --- saving -----------------------------------------------------------
+    def epoch_done(self, nn, epoch: int, mean_err: float | None) -> None:
+        self.errors.append(None if mean_err is None else float(mean_err))
+        if self.every and epoch % self.every == 0:
+            self.save(nn, epoch)
+
+    def save(self, nn, epoch: int, sync: bool = False) -> None:
+        job = self._capture(nn, epoch)
+        self.last_saved_epoch = int(epoch)
+        # the one console line, emitted HERE (deterministic position in
+        # the training stream); the tag alone, so streams stay
+        # comparable across different --ckpt-dir locations
+        nn_out(f"CKPT: snapshot {snap.snapshot_tag(epoch)}\n")
+        if sync or not self.use_pool:
+            self.flush()
+            self._write(job)
+            return
+        from concurrent.futures import Future
+
+        from ..io.corpus import io_pool
+
+        # bundles must land in epoch order, but the chain may never PARK
+        # a pool worker waiting on its predecessor (queued snapshots
+        # would otherwise occupy io_pool threads and starve the corpus
+        # loader sharing the pool): each job is submitted from the
+        # previous future's done-callback, so at most ONE pool thread
+        # writes at any time
+        fut = Future()
+        with self._lock:
+            prev = self._future
+            self._future = fut
+        if prev is None:
+            io_pool().submit(self._run_job, job, fut, None)
+        else:
+            prev.add_done_callback(
+                lambda p: io_pool().submit(self._run_job, job, fut, p))
+
+    def _run_job(self, job: dict, fut, prev) -> None:
+        if prev is not None and prev.exception() is not None:
+            fut.set_exception(prev.exception())  # first failure wins
+            return
+        try:
+            with nn_log.capture():  # the writer never prints
+                self._write(job)
+        except BaseException as exc:  # noqa: BLE001 -- surfaced at flush
+            fut.set_exception(exc)
+        else:
+            fut.set_result(None)
+
+    def _write(self, job: dict) -> None:
+        entry = snap.write_snapshot(
+            self.ckpt_dir, job["epoch"], weights=job["weights"],
+            momentum=job["momentum"], rng_state=job["rng_state"],
+            seed=job["seed"], errors=job["errors"], name=job["name"],
+            train=job["train"], dtype=job["dtype"],
+            target_epochs=job["target_epochs"])
+        snap.publish_snapshot(self.ckpt_dir, entry, seed=job["seed"],
+                              errors=job["errors"],
+                              keep_last=self.keep_last)
+
+    def flush(self) -> None:
+        """Block until every queued bundle is durably published;
+        re-raises the first writer failure."""
+        with self._lock:
+            fut = self._future
+            self._future = None
+        if fut is not None:
+            fut.result()
+
+    def record_final(self, kernel_path: str) -> None:
+        """After train_nn's final ``kernel.opt`` dump: flush pending
+        bundles, then stamp the manifest with the final kernel's path +
+        fingerprint (run_nn's staleness guard; watchers see the bump)."""
+        self.flush()
+        snap.record_final_kernel(self.ckpt_dir, kernel_path)

@@ -1,0 +1,395 @@
+"""On-disk snapshot format for the checkpoint subsystem.
+
+A checkpoint directory holds a flat set of snapshot bundles plus one
+manifest:
+
+    <ckpt-dir>/
+        manifest.json            latest tag, generation counter,
+                                 fingerprints, error trajectory,
+                                 retention policy, snapshot index
+        ep00000003/              one bundle per checkpointed epoch
+            kernel.opt           weights, reference text format
+                                 (io.kernel_io -- loadable by run_nn,
+                                 serve_nn and the compiled reference)
+            state.npz            bit-exact training state: float64
+                                 weights (w0..wN), BPM momentum buffers
+                                 (m0..mN), the 33-word glibc shuffle-RNG
+                                 state, epoch counter, effective seed
+            snapshot.json        per-bundle manifest (tag, epoch, seed,
+                                 fingerprint, mean error, topology)
+
+Two weight encodings on purpose: the text format is the framework's
+interop surface (``%17.15f`` quantizes -- fine for serving and for the
+reference's own restart cycle), while ``state.npz`` carries the raw
+float64 bits so ``train_nn --resume`` continues to a **byte-identical**
+``kernel.opt`` versus the uninterrupted run (the repo's parity guarantee
+extended across process death; pinned in tests/test_ckpt.py).
+
+Crash safety: every bundle is staged under a dot-tmp directory, each
+file fsync'd, then the DIRECTORY is renamed into place and the parent
+fsync'd -- readers (the serve hot-reload watcher, a concurrent
+``--resume``) see a complete bundle or none.  The manifest itself goes
+through the shared ``io.atomic`` tmp+fsync+rename writer, and its
+``generation`` counter increments on every publish, which is what the
+serving registry's manifest watcher keys reloads on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import shutil
+import time
+
+import numpy as np
+
+from ..io.atomic import atomic_write_text, fsync_dir
+from ..io.kernel_io import dumps_kernel, encode_kernel_text, load_kernel
+from ..models.kernel import Kernel
+
+MANIFEST = "manifest.json"
+SNAPSHOT_META = "snapshot.json"
+SNAPSHOT_STATE = "state.npz"
+SNAPSHOT_KERNEL = "kernel.opt"
+MANIFEST_VERSION = 1
+
+
+def snapshot_tag(epoch: int) -> str:
+    return f"ep{int(epoch):08d}"
+
+
+def fingerprint_bytes(data: bytes) -> str:
+    return "sha256:" + hashlib.sha256(data).hexdigest()
+
+
+def fingerprint_file(path: str) -> str | None:
+    try:
+        with open(path, "rb") as fp:
+            return fingerprint_bytes(fp.read())
+    except OSError:
+        return None
+
+
+@dataclasses.dataclass
+class SnapshotState:
+    """Everything ``train_nn --resume`` restores."""
+
+    weights: list[np.ndarray]          # float64, bit-exact
+    momentum: list[np.ndarray] | None  # BPM dw buffers (None for BP)
+    rng_state: list[int] | None        # glibc shuffle stream (33 words)
+    epoch: int
+    seed: int
+    errors: list[float]                # per-epoch mean final error
+    tag: str
+    path: str                          # bundle directory
+    fingerprint: str | None            # of kernel.opt in the bundle
+    target_epochs: int = 0             # the run's --epochs goal (0: unknown)
+
+    @property
+    def topology(self) -> list[int]:
+        return [int(self.weights[0].shape[1]),
+                *[int(w.shape[0]) for w in self.weights]]
+
+
+def _durable_write(path: str, data: bytes) -> None:
+    """Plain write + fsync (used INSIDE a staged tmp bundle, where the
+    directory rename provides the atomicity)."""
+    with open(path, "wb") as fp:
+        fp.write(data)
+        fp.flush()
+        os.fsync(fp.fileno())
+
+
+def _state_npz_bytes(weights, momentum, rng_state, epoch: int,
+                     seed: int) -> bytes:
+    arrays = {f"w{i}": np.asarray(w, dtype=np.float64)
+              for i, w in enumerate(weights)}
+    if momentum is not None:
+        arrays.update({f"m{i}": np.asarray(m, dtype=np.float64)
+                       for i, m in enumerate(momentum)})
+    if rng_state is not None:
+        arrays["rng"] = np.asarray(rng_state, dtype=np.int64)
+    arrays["meta"] = np.asarray([int(epoch), int(seed)], dtype=np.int64)
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
+                   rng_state, seed: int, errors, name: str = "(null)",
+                   train: str = "", dtype: str = "f64",
+                   target_epochs: int = 0) -> dict:
+    """Write one atomic bundle for ``epoch``; returns its index entry
+    (tag/epoch/mean_err/fingerprint) for the manifest.
+
+    Runs on the io_pool writer thread in production -- it must not
+    print (the caller owns the console stream's byte parity).
+    """
+    os.makedirs(ckpt_dir, exist_ok=True)
+    tag = snapshot_tag(epoch)
+    final = os.path.join(ckpt_dir, tag)
+    tmp = os.path.join(ckpt_dir, f".tmp.{tag}.{os.getpid()}")
+    if os.path.isdir(tmp):
+        shutil.rmtree(tmp)
+    os.makedirs(tmp)
+    try:
+        kernel_text = dumps_kernel(Kernel(name=name, weights=list(weights)))
+        kernel_bytes = encode_kernel_text(kernel_text)
+        fp_kernel = fingerprint_bytes(kernel_bytes)
+        _durable_write(os.path.join(tmp, SNAPSHOT_KERNEL), kernel_bytes)
+        _durable_write(os.path.join(tmp, SNAPSHOT_STATE),
+                       _state_npz_bytes(weights, momentum, rng_state,
+                                        epoch, seed))
+        errors = [None if e is None else float(e) for e in errors]
+        meta = {
+            "tag": tag,
+            "epoch": int(epoch),
+            "seed": int(seed),
+            "fingerprint": fp_kernel,
+            "mean_err": errors[-1] if errors else None,
+            "errors": errors,
+            "topology": [int(weights[0].shape[1]),
+                         *[int(w.shape[0]) for w in weights]],
+            "train": train,
+            "dtype": dtype,
+            "momentum": momentum is not None,
+            "target_epochs": int(target_epochs),
+            "created": time.time(),
+        }
+        _durable_write(os.path.join(tmp, SNAPSHOT_META),
+                       (json.dumps(meta, indent=1) + "\n").encode())
+        fsync_dir(tmp)
+        if os.path.isdir(final):  # re-snapshot of the same epoch
+            shutil.rmtree(final)
+        os.replace(tmp, final)
+    except BaseException:
+        with contextlib.suppress(OSError):
+            shutil.rmtree(tmp)
+        raise
+    fsync_dir(ckpt_dir)
+    return {"tag": tag, "epoch": int(epoch),
+            "mean_err": meta["mean_err"], "fingerprint": fp_kernel}
+
+
+# --- manifest ---------------------------------------------------------------
+
+def manifest_path(ckpt_dir: str) -> str:
+    return os.path.join(ckpt_dir, MANIFEST)
+
+
+def read_manifest(ckpt_dir: str) -> dict | None:
+    """The checkpoint directory's manifest, or None when absent or
+    unparseable (a half-created dir is not an error -- watchers poll)."""
+    try:
+        with open(manifest_path(ckpt_dir), "r") as fp:
+            m = json.load(fp)
+    except (OSError, json.JSONDecodeError):
+        return None
+    return m if isinstance(m, dict) else None
+
+
+def write_manifest(ckpt_dir: str, manifest: dict) -> None:
+    manifest = dict(manifest)
+    manifest["version"] = MANIFEST_VERSION
+    manifest["updated"] = time.time()
+    atomic_write_text(manifest_path(ckpt_dir),
+                      json.dumps(manifest, indent=1) + "\n")
+
+
+def publish_snapshot(ckpt_dir: str, entry: dict, *, seed: int, errors,
+                     keep_last: int = 0) -> dict:
+    """Fold one bundle's index entry into the manifest (generation bump)
+    and apply retention.  Returns the manifest written."""
+    prev = read_manifest(ckpt_dir) or {}
+    snaps = [s for s in prev.get("snapshots", [])
+             if s.get("tag") != entry["tag"]]
+    snaps.append(entry)
+    snaps.sort(key=lambda s: s.get("epoch", 0))
+    manifest = dict(prev)
+    manifest.update({
+        "generation": int(prev.get("generation", 0)) + 1,
+        "latest": entry["tag"],
+        "epoch": entry["epoch"],
+        "seed": int(seed),
+        "fingerprint": entry["fingerprint"],
+        "kernel": os.path.join(entry["tag"], SNAPSHOT_KERNEL),
+        "errors": [None if e is None else float(e) for e in errors],
+        "retention": {"keep_last": int(keep_last), "keep_best": True},
+        "snapshots": snaps,
+    })
+    manifest["snapshots"] = _apply_retention(ckpt_dir, snaps, keep_last)
+    write_manifest(ckpt_dir, manifest)
+    return manifest
+
+
+def record_final_kernel(ckpt_dir: str, kernel_path: str) -> None:
+    """Stamp the manifest with the path + fingerprint of the final
+    ``kernel.opt`` train_nn wrote, so ``run_nn`` (and ops tooling) can
+    detect a stale or hand-edited weights file (generation bump: a
+    watching server hot-reloads the finished kernel)."""
+    fp = fingerprint_file(kernel_path)
+    if fp is None:
+        return
+    manifest = read_manifest(ckpt_dir) or {}
+    manifest["generation"] = int(manifest.get("generation", 0)) + 1
+    manifest["final_kernel"] = os.path.abspath(kernel_path)
+    manifest["final_fingerprint"] = fp
+    write_manifest(ckpt_dir, manifest)
+
+
+def refresh_final_kernel(ckpt_dir: str, kernel_path: str) -> None:
+    """Keep the manifest honest across PLAIN (non-checkpointed)
+    retrains: when a manifest already tracks exactly this kernel file,
+    re-record its fingerprint after a fresh dump -- otherwise every
+    later ``run_nn`` would warn 'stale or modified weights' about a
+    kernel that is actually NEWER than the manifest, training users to
+    ignore the guard.  A no-op when no manifest tracks the file."""
+    manifest = read_manifest(ckpt_dir)
+    if not manifest:
+        return
+    if manifest.get("final_kernel") == os.path.abspath(kernel_path):
+        record_final_kernel(ckpt_dir, kernel_path)
+
+
+def _apply_retention(ckpt_dir: str, snaps: list[dict],
+                     keep_last: int) -> list[dict]:
+    """keep-last-N + best-by-error: the N most recent bundles always
+    survive, and so does the lowest-mean-error one (keep_last <= 0 keeps
+    everything).  Pruned bundles are deleted from disk."""
+    if keep_last <= 0 or len(snaps) <= keep_last:
+        return snaps
+    by_epoch = sorted(snaps, key=lambda s: s.get("epoch", 0))
+    keep = {s["tag"] for s in by_epoch[-keep_last:]}
+    scored = [s for s in snaps if s.get("mean_err") is not None]
+    if scored:
+        keep.add(min(scored, key=lambda s: s["mean_err"])["tag"])
+    kept = []
+    for s in by_epoch:
+        if s["tag"] in keep:
+            kept.append(s)
+            continue
+        with contextlib.suppress(OSError):
+            shutil.rmtree(os.path.join(ckpt_dir, s["tag"]))
+    return kept
+
+
+# --- resume ----------------------------------------------------------------
+
+def _resolve_bundle(path: str) -> str | None:
+    """Map a user-supplied ``--resume`` path to a bundle directory:
+    accepts the checkpoint dir (-> manifest's latest), a bundle dir, or
+    any file inside either."""
+    path = os.path.abspath(path)
+    if os.path.isfile(path):
+        path = os.path.dirname(path)
+    if not os.path.isdir(path):
+        return None
+    if os.path.isfile(os.path.join(path, SNAPSHOT_STATE)):
+        return path
+    manifest = read_manifest(path)
+    if manifest and manifest.get("latest"):
+        bundle = os.path.join(path, manifest["latest"])
+        if os.path.isfile(os.path.join(bundle, SNAPSHOT_STATE)):
+            return bundle
+    # no manifest (crashed before first publish?): newest complete bundle
+    tags = sorted(t for t in os.listdir(path)
+                  if t.startswith("ep") and os.path.isfile(
+                      os.path.join(path, t, SNAPSHOT_STATE)))
+    return os.path.join(path, tags[-1]) if tags else None
+
+
+def load_snapshot(path: str) -> SnapshotState | None:
+    """Load a bundle (or a checkpoint dir's latest bundle) back into
+    host state.  Weights come from ``state.npz`` -- bit-exact float64,
+    NOT the quantized text -- which is what makes resume byte-identical.
+    Returns None (with an NN(ERR) diagnostic) when nothing loadable is
+    found."""
+    from ..utils.nn_log import nn_error, nn_warn
+
+    bundle = _resolve_bundle(path)
+    if bundle is None:
+        nn_error(f"CKPT: no resumable snapshot at {path}\n")
+        return None
+    try:
+        with np.load(os.path.join(bundle, SNAPSHOT_STATE),
+                     allow_pickle=False) as z:
+            weights = [z[k] for k in sorted(
+                (k for k in z.files if k.startswith("w")),
+                key=lambda k: int(k[1:]))]
+            momentum = [z[k] for k in sorted(
+                (k for k in z.files if k.startswith("m") and k != "meta"),
+                key=lambda k: int(k[1:]))] or None
+            rng = [int(v) for v in z["rng"]] if "rng" in z.files else None
+            epoch, seed = (int(v) for v in z["meta"])
+    except (OSError, KeyError, ValueError) as exc:
+        nn_error(f"CKPT: unreadable snapshot state in {bundle}: {exc}\n")
+        return None
+    meta = {}
+    with contextlib.suppress(OSError, json.JSONDecodeError):
+        with open(os.path.join(bundle, SNAPSHOT_META)) as fp:
+            meta = json.load(fp)
+    errors = [e for e in meta.get("errors", [])]
+    fp_recorded = meta.get("fingerprint")
+    fp_actual = fingerprint_file(os.path.join(bundle, SNAPSHOT_KERNEL))
+    if fp_recorded and fp_actual and fp_recorded != fp_actual:
+        nn_warn(f"CKPT: {os.path.join(bundle, SNAPSHOT_KERNEL)} does not "
+                f"match its recorded fingerprint in "
+                f"{os.path.join(bundle, SNAPSHOT_META)} -- resuming from "
+                "state.npz anyway\n")
+    return SnapshotState(weights=weights, momentum=momentum,
+                         rng_state=rng, epoch=epoch, seed=seed,
+                         errors=errors, tag=os.path.basename(bundle),
+                         path=bundle, fingerprint=fp_actual,
+                         target_epochs=int(meta.get("target_epochs", 0)))
+
+
+def looks_like_checkpoint(path: str) -> bool:
+    """Is ``path`` plausibly a checkpoint dir/bundle/file?  The CLI's
+    ``--resume [PATH]`` grammar uses this to tell an optional resume
+    path from the trailing conf filename."""
+    if os.path.isdir(path):
+        return (os.path.isfile(os.path.join(path, MANIFEST))
+                or os.path.isfile(os.path.join(path, SNAPSHOT_STATE))
+                or any(t.startswith("ep") for t in os.listdir(path)))
+    return os.path.basename(path) in (MANIFEST, SNAPSHOT_META,
+                                      SNAPSHOT_STATE)
+
+
+def check_kernel_fingerprint(kernel_path: str | None,
+                             ckpt_dir: str) -> bool:
+    """``run_nn`` guard (satellite): when the checkpoint manifest has a
+    recorded fingerprint for this exact kernel file and the bytes on
+    disk no longer match, WARN with both paths instead of silently
+    evaluating stale/modified weights.  Returns False on mismatch."""
+    from ..utils.nn_log import nn_warn
+
+    if not kernel_path:
+        return True
+    manifest = read_manifest(ckpt_dir)
+    if not manifest:
+        return True
+    kp = os.path.abspath(kernel_path)
+    recorded = None
+    if manifest.get("final_kernel") == kp:
+        recorded = manifest.get("final_fingerprint")
+    elif manifest.get("kernel") and os.path.join(
+            os.path.abspath(ckpt_dir), manifest["kernel"]) == kp:
+        recorded = manifest.get("fingerprint")
+    if not recorded:
+        return True
+    actual = fingerprint_file(kp)
+    if actual is None or actual == recorded:
+        return True
+    nn_warn(f"kernel fingerprint mismatch: {kp} does not match the "
+            f"manifest {manifest_path(os.path.abspath(ckpt_dir))} "
+            "(stale or modified weights?)\n")
+    return False
+
+
+def load_bundle_kernel(bundle: str):
+    """The bundle's text-format kernel (what serve hot-reload swaps in)."""
+    return load_kernel(os.path.join(bundle, SNAPSHOT_KERNEL))

@@ -1,0 +1,141 @@
+"""Multi-epoch training driver with resumable, crash-safe state.
+
+The reference trains in 1+N *rounds*: each round is a fresh process
+that reloads ``kernel.opt`` and re-seeds the shuffle
+(``tutorials/mnist/tutorial.bash:125-197``).  ``train_nn --epochs K``
+runs the same per-sample convergence epochs **in one process**: the
+kernel stays host-resident between epochs and the seeded glibc shuffle
+stream CONTINUES across them (one ``srandom`` at the start, each
+epoch's shuffle consuming the next draws) -- deterministic, so the
+whole K-epoch trajectory is a pure function of (conf, corpus, seed).
+
+That determinism is what makes checkpoint/resume *bit-exact*: a bundle
+written at the epoch-k boundary (weights + BPM momentum + RNG words +
+epoch counter) fully determines epochs k+1..K, so an interrupted run
+resumed with ``--resume`` replays the identical console stream and
+lands on a byte-identical ``kernel.opt`` (tests/test_ckpt.py pins
+both, for BP and BPM).
+
+SIGTERM/SIGINT do not kill the run mid-epoch: the handler latches a
+stop flag, the loop finishes the in-flight device epoch, writes a
+final synchronous snapshot, and exits cleanly.  ``HPNN_CKPT_KILL_AT_EPOCH=k``
+drives that same handler path deterministically (the resume-parity
+tests send the signal from inside, at an exact epoch boundary).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+from ..utils.glibc_random import GlibcRandom
+from ..utils.nn_log import nn_out
+from .manager import CheckpointManager
+
+
+def _install_handlers(stop: threading.Event):
+    """Latch ``stop`` on SIGTERM/SIGINT; returns the previous handlers
+    (restored on exit).  Only the main thread may install -- elsewhere
+    (tests driving the loop from a worker) signals keep their default
+    behavior."""
+    if threading.current_thread() is not threading.main_thread():
+        return None
+
+    def handler(signum, frame):
+        stop.set()
+
+    prev = {}
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            prev[sig] = signal.signal(sig, handler)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+    return prev
+
+
+def _restore_handlers(prev) -> None:
+    if not prev:
+        return
+    for sig, old in prev.items():
+        try:
+            signal.signal(sig, old)
+        except (ValueError, OSError):  # pragma: no cover
+            pass
+
+
+def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
+               start_epoch: int = 0,
+               rng_state: list[int] | None = None) -> tuple[bool, bool]:
+    """Run epochs ``start_epoch+1 .. epochs``; returns
+    ``(trained_ok, interrupted)``.
+
+    ``rng_state`` (from a snapshot) restores the shuffle stream;
+    otherwise the stream starts fresh from ``conf.seed`` (seed 0 ->
+    time(), written back -- the reference's ``srandom`` semantics,
+    libhpnn.c:1218).  The per-epoch banner prints only on multi-epoch
+    or resumed runs, so a plain single-epoch ``train_nn`` stays
+    byte-identical to the reference stream.
+    """
+    from ..api import train_kernel
+
+    conf = nn.conf
+    if rng_state is not None:
+        nn.shuffle_rng = GlibcRandom.from_state(rng_state)
+    elif nn.shuffle_rng is None:
+        if conf.seed == 0:
+            conf.seed = int(time.time())
+        nn.shuffle_rng = GlibcRandom(conf.seed)
+
+    kill_at = int(os.environ.get("HPNN_CKPT_KILL_AT_EPOCH", "0") or 0)
+    banner = epochs > 1 or start_epoch > 0
+    stop = threading.Event()
+    prev_handlers = _install_handlers(stop)
+    interrupted = False
+    last_epoch = start_epoch
+    try:
+        for epoch in range(start_epoch + 1, epochs + 1):
+            last_epoch = epoch
+            if banner:
+                nn_out(f"EPOCH {epoch:8d}/{epochs:8d}\n")
+            if not train_kernel(nn):
+                return False, False
+            stats = getattr(nn, "last_epoch_stats", None)
+            mean_err = stats.get("mean_final") if stats else None
+            if manager is not None:
+                manager.epoch_done(nn, epoch, mean_err)
+            if kill_at and epoch == kill_at and epoch < epochs:
+                # exercise the REAL signal path at a deterministic
+                # boundary (test hook; see module docstring)
+                os.kill(os.getpid(), signal.SIGTERM)
+            if stop.is_set() and epoch < epochs:
+                interrupted = True
+                if manager is not None:
+                    # final snapshot, synchronous: the process is about
+                    # to exit, nothing may stay queued
+                    if manager.last_saved_epoch != epoch:
+                        manager.save(nn, epoch, sync=True)
+                    manager.flush()
+                    nn_out(f"CKPT: interrupted at epoch {epoch}/{epochs};"
+                           " state saved -- continue with train_nn "
+                           "--resume\n")
+                else:
+                    nn_out(f"CKPT: interrupted at epoch {epoch}/{epochs} "
+                           "(checkpointing off; partial state only in "
+                           "kernel.opt)\n")
+                break
+        if (not interrupted and manager is not None
+                and last_epoch > start_epoch
+                and manager.last_saved_epoch != last_epoch):
+            # clean completion off the --ckpt-every grid (incl. every=0):
+            # the FINAL epoch always gets a bundle, so the manifest's
+            # latest kernel is the finished model (what --watch-ckpt
+            # servers swap in) and a later --resume sees the true end
+            # state
+            manager.save(nn, last_epoch)
+    finally:
+        _restore_handlers(prev_handlers)
+        if manager is not None:
+            manager.flush()
+    return True, interrupted

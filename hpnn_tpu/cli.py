@@ -4,9 +4,12 @@ Flag-compatible rebuilds of the reference demo binaries
 (``/root/reference/tests/train_nn.c``, ``tests/run_nn.c``):
 
     train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n]
-             [--compile-cache DIR] [--corpus-cache DIR] [conf]
+             [--compile-cache DIR] [--corpus-cache DIR]
+             [--epochs N] [--ckpt-every N] [--ckpt-dir DIR]
+             [--ckpt-keep N] [--resume [PATH]] [conf]
     run_nn   [-h] [-v]... [-O n] [-B n] [-S n]
-             [--compile-cache DIR] [--corpus-cache DIR] [conf]
+             [--compile-cache DIR] [--corpus-cache DIR]
+             [--ckpt-dir DIR] [conf]
 
 * flags combine (``-vvv``) and -O/-B/-S accept attached (``-O4``) or
   separated (``-O 4``) values, like the reference parser
@@ -21,10 +24,11 @@ Flag-compatible rebuilds of the reference demo binaries
 
 from __future__ import annotations
 
+import os
 import sys
 
 from . import runtime
-from .api import configure, dump_kernel_def, run_kernel, train_kernel
+from .api import configure, run_kernel, train_kernel
 from .utils import nn_log
 
 
@@ -47,6 +51,22 @@ def _help_text(name: str, train: bool) -> str:
         "\t(cold rounds reload compiled programs instead of recompiling).",
         "--corpus-cache DIR \tpacked corpus cache location (default:",
         "\ta dotfile next to each sample dir; HPNN_NO_CORPUS_CACHE=1 off).",
+        "--ckpt-dir DIR \tcheckpoint directory (default ./ckpt).",
+    ]
+    if train:
+        lines += [
+            "--epochs N \ttrain N epochs in-process (default 1); the",
+            "\tseeded shuffle stream continues across epochs.",
+            "--ckpt-every N \tsnapshot every N epoch boundaries (atomic,",
+            "\twritten off the critical path; 0: only on exit/signal).",
+            "--ckpt-keep N \tretention: keep last N snapshots + the",
+            "\tbest-by-error one (0: keep all).",
+            "--resume [PATH] \tcontinue bit-exactly from the latest",
+            "\tsnapshot in PATH (a ckpt dir or bundle; default",
+            "\t--ckpt-dir): weights, BPM momentum, shuffle-RNG state",
+            "\tand epoch counter are restored.",
+        ]
+    lines += [
         "***********************************",
         "input:     neural network .def file",
         "contains the network definition and",
@@ -58,17 +78,27 @@ def _help_text(name: str, train: bool) -> str:
 
 
 _LONG_OPTS = {"--compile-cache": "compile_cache",
-              "--corpus-cache": "corpus_cache"}
+              "--corpus-cache": "corpus_cache",
+              "--ckpt-dir": "ckpt_dir"}
+# integer-valued long options, train_nn only (value validated like the
+# reference's numeric switches); min value enforced at parse time
+_LONG_INT_OPTS = {"--epochs": ("epochs", 1),
+                  "--ckpt-every": ("ckpt_every", 0),
+                  "--ckpt-keep": ("ckpt_keep", 0)}
 
 
 def _parse_args(argv: list[str], name: str, train: bool):
     """Reference-style parse; returns (filename, verbose, extras) or None
     on -h, raises SystemExit(-1) on syntax errors.  ``extras`` holds the
     long options this rebuild adds on top of the reference grammar
-    (--compile-cache/--corpus-cache, mirroring serve_nn); anything else
-    starting with ``--`` still errors like the reference parser."""
+    (--compile-cache/--corpus-cache/--ckpt-dir everywhere;
+    --epochs/--ckpt-every/--ckpt-keep/--resume for train_nn, mirroring
+    the checkpoint subsystem); anything else starting with ``--`` still
+    errors like the reference parser."""
     filename = None
     extras = {v: None for v in _LONG_OPTS.values()}
+    extras.update({v: None for v, _ in _LONG_INT_OPTS.values()})
+    extras["resume"] = None
     numeric = {"O": runtime.set_omp_threads, "B": runtime.set_omp_blas,
                "S": runtime.set_cuda_streams}
     i = 0
@@ -80,6 +110,49 @@ def _parse_args(argv: list[str], name: str, train: bool):
             i += 1
             continue
         key, eq, val = arg.partition("=")
+        if key == "--resume" and train:
+            # --resume [PATH]: the value is OPTIONAL (default: the ckpt
+            # dir).  A separated token is taken as the path only when it
+            # plausibly IS a checkpoint -- otherwise it is the trailing
+            # conf filename ("train_nn --resume nn.conf" resumes from
+            # ./ckpt and trains nn.conf).  --resume=PATH is explicit.
+            if eq:
+                if not val:
+                    sys.stderr.write(
+                        "syntax error: bad --resume parameter!\n")
+                    sys.stdout.write(_help_text(name, train))
+                    raise SystemExit(-1)
+                extras["resume"] = val
+            else:
+                from .ckpt import looks_like_checkpoint
+
+                nxt = argv[i + 1] if i + 1 < len(argv) else None
+                if nxt and not nxt.startswith("-") \
+                        and looks_like_checkpoint(nxt):
+                    extras["resume"] = nxt
+                    i += 1
+                else:
+                    extras["resume"] = True
+            i += 1
+            continue
+        if key in _LONG_INT_OPTS and train:
+            dest, floor = _LONG_INT_OPTS[key]
+            if not eq:
+                i += 1
+                val = argv[i] if i < len(argv) else ""
+            # GET_UINT-style: parse the leading digits (train_nn.c:124)
+            digits = ""
+            for ch in val:
+                if not ch.isdigit():
+                    break
+                digits += ch
+            if not digits or int(digits) < floor:
+                sys.stderr.write(f"syntax error: bad {key} parameter!\n")
+                sys.stdout.write(_help_text(name, train))
+                raise SystemExit(-1)
+            extras[dest] = int(digits)
+            i += 1
+            continue
         if key in _LONG_OPTS:
             if not eq:
                 i += 1
@@ -153,8 +226,21 @@ def _apply_extras(extras: dict) -> None:
         corpus.set_cache_dir(extras["corpus_cache"])
 
 
+def _dump_kernel_atomic(neural, path: str) -> None:
+    """kernel.tmp/kernel.opt writes go through the crash-safe tmp +
+    fsync + rename path (io.atomic) -- a kill mid-dump can no longer
+    truncate a previously good kernel file."""
+    from .io.kernel_io import dump_kernel_to_path
+
+    dump_kernel_to_path(neural.kernel, path)
+
+
 def train_nn_main(argv: list[str] | None = None) -> int:
-    """train_nn (tests/train_nn.c:59-255)."""
+    """train_nn (tests/train_nn.c:59-255), extended with the checkpoint
+    subsystem: ``--epochs N`` multi-epoch training, ``--ckpt-every`` /
+    ``--ckpt-dir`` / ``--ckpt-keep`` crash-safe snapshots off the
+    critical path, and ``--resume [PATH]`` bit-exact continuation
+    (hpnn_tpu/ckpt)."""
     from .utils.trace import phase
 
     argv = sys.argv[1:] if argv is None else argv
@@ -166,32 +252,117 @@ def train_nn_main(argv: list[str] | None = None) -> int:
         return 0
     filename, _verbose, extras = parsed
     _apply_extras(extras)
+    epochs = extras.get("epochs") or 1
+    epochs_given = extras.get("epochs") is not None
+    resume = extras.get("resume")
+    ckpt_on = bool(resume or extras.get("ckpt_dir")
+                   or extras.get("ckpt_every") is not None
+                   or extras.get("ckpt_keep") is not None)
+    ckpt_dir = extras.get("ckpt_dir") or "./ckpt"
+    every = (extras["ckpt_every"] if extras.get("ckpt_every") is not None
+             else 1)
+    keep = extras.get("ckpt_keep") or 0
     with phase("configure"):
         neural = configure(filename)
     if neural is None:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    snap = None
+    start_epoch = 0
+    if resume:
+        from .ckpt import load_snapshot
+
+        snap = load_snapshot(resume if isinstance(resume, str)
+                             else ckpt_dir)
+        if snap is None:
+            sys.stderr.write("FAILED to resume: no loadable snapshot! "
+                             "(ABORTING)\n")
+            runtime.deinit_all()
+            return -1
+        if snap.topology != list(neural.kernel.params):
+            sys.stderr.write(
+                f"FAILED to resume: snapshot topology {snap.topology} "
+                f"does not match the configured kernel "
+                f"{list(neural.kernel.params)}! (ABORTING)\n")
+            runtime.deinit_all()
+            return -1
+        # bit-exact restore: float64 weights from state.npz (NOT the
+        # quantized text), the effective seed, and the epoch counter;
+        # the shuffle-RNG words go to train_loop below.  BPM momentum
+        # buffers ride the bundle too, but the update rule re-zeroes
+        # them at every sample entry (ann_raz_momentum, ann.c:2391), so
+        # restoring them is a no-op by construction.
+        neural.kernel.weights = list(snap.weights)
+        neural.conf.seed = snap.seed
+        start_epoch = snap.epoch
+        if isinstance(resume, str) and not extras.get("ckpt_dir"):
+            # an explicit --resume PATH names the run's checkpoint
+            # home: continued snapshots go back THERE (the bundle's
+            # parent = the manifest's directory), not to ./ckpt --
+            # splitting one run's history across two dirs would strand
+            # any --watch-ckpt server pointed at PATH
+            ckpt_dir = os.path.dirname(snap.path)
+        if not epochs_given and snap.target_epochs:
+            # a bare --resume continues to the interrupted run's own
+            # --epochs goal (recorded in the bundle) instead of
+            # silently training zero epochs
+            epochs = snap.target_epochs
+        if start_epoch >= epochs:
+            sys.stderr.write(
+                f"CKPT: snapshot is already at epoch {start_epoch} of "
+                f"{epochs}; nothing left to train (pass --epochs N to "
+                "extend the run)\n")
     try:
-        with open("kernel.tmp", "w") as fp:
-            dump_kernel_def(neural, fp)
+        _dump_kernel_atomic(neural, "kernel.tmp")
     except OSError:
         sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
         runtime.deinit_all()
         return -1
-    with phase("train_kernel"):
-        trained = train_kernel(neural)
+    if epochs > 1 or ckpt_on or start_epoch:
+        from .ckpt import CheckpointManager, train_loop
+
+        mgr = None
+        if ckpt_on:
+            mgr = CheckpointManager(ckpt_dir, every=every, keep_last=keep,
+                                    target_epochs=epochs)
+            if snap is not None:
+                mgr.seed_errors(snap.errors)
+        with phase("train_kernel"):
+            trained, _interrupted = train_loop(
+                neural, epochs, manager=mgr, start_epoch=start_epoch,
+                rng_state=snap.rng_state if snap is not None else None)
+    else:
+        mgr = None
+        with phase("train_kernel"):
+            trained = train_kernel(neural)
     if not trained:
         sys.stderr.write("FAILED to train kernel!\n")
         runtime.deinit_all()
         return -1
     try:
-        with open("kernel.opt", "w") as fp:
-            dump_kernel_def(neural, fp)
+        _dump_kernel_atomic(neural, "kernel.opt")
     except OSError:
+        # the reference prints the kernel.tmp message on BOTH dump
+        # failures (tests/train_nn.c:243) -- quirk preserved
         sys.stderr.write("FAILED to open kernel.tmp for WRITE!\n")
         runtime.deinit_all()
         return -1
+    if mgr is not None:
+        try:
+            mgr.record_final("kernel.opt")
+        except Exception as exc:
+            sys.stderr.write(f"FAILED to publish checkpoint manifest: "
+                             f"{exc}\n")
+            runtime.deinit_all()
+            return -1
+    else:
+        # plain (reference-mode) retrain: if a manifest from an earlier
+        # checkpointed run tracks this exact kernel.opt, refresh its
+        # fingerprint so run_nn's staleness guard stays truthful
+        from .ckpt import refresh_final_kernel
+
+        refresh_final_kernel(ckpt_dir, "kernel.opt")
     runtime.deinit_all()
     return 0
 
@@ -215,6 +386,16 @@ def run_nn_main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    if neural.conf.f_kernel:
+        # staleness guard (checkpoint subsystem): when a manifest has a
+        # recorded fingerprint for this exact kernel file and the bytes
+        # no longer match, warn with both paths instead of silently
+        # evaluating stale/modified weights
+        ckpt_dir = extras.get("ckpt_dir") or "./ckpt"
+        if os.path.isdir(ckpt_dir):
+            from .ckpt import check_kernel_fingerprint
+
+            check_kernel_fingerprint(neural.conf.f_kernel, ckpt_dir)
     with phase("run_kernel"):
         run_kernel(neural)
     runtime.deinit_all()
@@ -282,6 +463,15 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     "warms before binding; 'off' skips warmup")
     ap.add_argument("--no-warmup", action="store_true",
                     help="alias for --warmup-mode off")
+    ap.add_argument("--watch-ckpt", action="append", default=[],
+                    metavar="[NAME=]DIR",
+                    help="watch a checkpoint directory's manifest "
+                    "(hpnn_tpu/ckpt) and hot-reload the named kernel on "
+                    "every generation bump; NAME defaults to the only "
+                    "registered kernel (repeatable)")
+    ap.add_argument("--watch-interval", type=float, default=2.0,
+                    metavar="S", help="manifest poll period in seconds "
+                    "(default 2.0)")
     args = ap.parse_args(argv)
 
     from .serve.server import ServeApp, make_server
@@ -319,6 +509,25 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         sys.stderr.write("no kernel could be registered (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    for spec in args.watch_ckpt:
+        wname, eq, wdir = spec.partition("=")
+        if not eq:
+            wname, wdir = "", wname
+        if not wname:
+            names = app.registry.names()
+            if len(names) != 1:
+                sys.stderr.write(
+                    f"--watch-ckpt {spec}: NAME= is required when "
+                    f"{len(names)} kernels are registered (ABORTING)\n")
+                runtime.deinit_all()
+                return -1
+            wname = names[0]
+        if app.registry.get(wname) is None:
+            sys.stderr.write(f"--watch-ckpt: unknown kernel '{wname}' "
+                             "(ABORTING)\n")
+            runtime.deinit_all()
+            return -1
+        app.watch_manifest(wname, wdir, interval_s=args.watch_interval)
     httpd = make_server(args.addr, args.port, app)
     host, port = httpd.server_address[:2]
     # unconditional: the bound port is the serving contract (with -p 0

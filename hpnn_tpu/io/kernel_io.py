@@ -76,9 +76,38 @@ def _dump_neurons(fp: IO[str], mat: np.ndarray) -> None:
         fp.write("\n")
 
 
+def dumps_kernel(kernel: Kernel) -> str:
+    """The reference text format as one string (what a kernel file's
+    bytes will be); the checkpoint fingerprint hashes exactly this."""
+    import io
+
+    buf = io.StringIO()
+    dump_kernel(kernel, buf)
+    return buf.getvalue()
+
+
+def encode_kernel_text(text: str) -> bytes:
+    """Kernel text -> file bytes.  latin-1 keeps byte parity with the
+    reference's fprintf (a name loaded from a kernel file is latin-1-
+    decoded raw bytes, so this is the identity on the round trip); a
+    name with characters above U+00FF (reachable via a utf-8 conf)
+    falls back to utf-8 instead of crashing -- those bytes re-decode
+    latin-1 as mojibake but round-trip stably, like the C would treat
+    any foreign byte sequence."""
+    try:
+        return text.encode("latin-1")
+    except UnicodeEncodeError:
+        return text.encode("utf-8")
+
+
 def dump_kernel_to_path(kernel: Kernel, path: str) -> None:
-    with open(path, "w") as fp:
-        dump_kernel(kernel, fp)
+    """Crash-safe kernel write: the full text is staged to a temp file,
+    fsync'd, then renamed over ``path`` (io.atomic) -- a crash mid-dump
+    can no longer truncate an existing ``kernel.opt``.  Shared with the
+    checkpoint snapshot writer (hpnn_tpu/ckpt)."""
+    from .atomic import atomic_write_bytes
+
+    atomic_write_bytes(path, encode_kernel_text(dumps_kernel(kernel)))
 
 
 def _i32(v: int) -> int:

@@ -18,7 +18,9 @@ This package keeps the compiled state resident and feeds it full batches:
   enforces per-request deadlines, rejects immediately when full
   (backpressure), and drains gracefully on shutdown;
 * :mod:`server`    -- a stdlib-only HTTP front-end (``ThreadingHTTPServer``):
-  ``POST /v1/kernels/<name>/infer``, ``GET /healthz``, ``GET /metrics``;
+  ``POST /v1/kernels/<name>/infer``, ``POST /v1/kernels/<name>/reload``
+  (hot weight swap under traffic, plus a checkpoint-manifest watcher --
+  see ``hpnn_tpu/ckpt``), ``GET /healthz``, ``GET /metrics``;
 * :mod:`metrics`   -- per-request latency histograms (p50/p99), queue
   depth, batch fill ratio, compile-cache hits/misses, reject/timeout
   counts, exported on ``/metrics``.

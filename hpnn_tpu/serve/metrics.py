@@ -112,6 +112,12 @@ class ServeMetrics:
         self.cache_hits = 0
         self.cache_misses = 0
         self._depth_fns: dict[str, Callable[[], int]] = {}
+        # model lifecycle: per-kernel generation (1 at registration,
+        # bumped by every hot reload) + last (re)load timestamp, and the
+        # reload outcome counters -- what ops/autoscaling watches to see
+        # weight swaps happen
+        self._model_info: dict[str, dict] = {}
+        self.reloads = {"ok": 0, "error": 0}
 
     # --- write side -----------------------------------------------------
     def count_request(self, outcome: str) -> None:
@@ -151,6 +157,19 @@ class ServeMetrics:
         with self._lock:
             self._depth_fns[name] = depth_fn
 
+    def set_model_info(self, name: str, generation: int,
+                       loaded_at: float) -> None:
+        """Record a kernel's model generation + last-(re)load time."""
+        with self._lock:
+            self._model_info[name] = {
+                "generation": int(generation),
+                "last_reload_ts": round(float(loaded_at), 3),
+            }
+
+    def count_reload(self, ok: bool) -> None:
+        with self._lock:
+            self.reloads["ok" if ok else "error"] += 1
+
     # --- read side ------------------------------------------------------
     def batch_fill_ratio(self) -> float:
         with self._lock:
@@ -183,6 +202,9 @@ class ServeMetrics:
                 "batches_total": self.batches_total,
                 "compile_cache": {"hits": self.cache_hits,
                                   "misses": self.cache_misses},
+                "models": {n: dict(v)
+                           for n, v in self._model_info.items()},
+                "reloads": dict(self.reloads),
                 # whether the native sample loader backs corpus ingestion
                 # (registration/warmup reload paths); "off" means the
                 # silent-fallback Python parser is doing the work
@@ -230,6 +252,30 @@ class ServeMetrics:
             "# TYPE hpnn_serve_native_io gauge",
             f"hpnn_serve_native_io "
             f"{1 if snap['native_io'] == 'on' else 0}",
+            "# HELP hpnn_serve_reloads_total Hot model reloads by result.",
+            "# TYPE hpnn_serve_reloads_total counter",
+            'hpnn_serve_reloads_total{result="ok"} '
+            f"{snap['reloads']['ok']}",
+            'hpnn_serve_reloads_total{result="error"} '
+            f"{snap['reloads']['error']}",
+            "# HELP hpnn_serve_model_generation Model weights generation "
+            "(1 at registration; +1 per hot reload).",
+            "# TYPE hpnn_serve_model_generation gauge",
+        ]
+        for name, info in sorted(snap["models"].items()):
+            lines.append(
+                f'hpnn_serve_model_generation{{kernel="{name}"}} '
+                f"{info['generation']}")
+        lines += [
+            "# HELP hpnn_serve_model_last_reload_timestamp_seconds "
+            "Unix time of the kernel's last weights (re)load.",
+            "# TYPE hpnn_serve_model_last_reload_timestamp_seconds gauge",
+        ]
+        for name, info in sorted(snap["models"].items()):
+            lines.append(
+                "hpnn_serve_model_last_reload_timestamp_seconds"
+                f'{{kernel="{name}"}} {info["last_reload_ts"]}')
+        lines += [
             "# HELP hpnn_serve_queue_depth Requests waiting per kernel.",
             "# TYPE hpnn_serve_queue_depth gauge",
         ]

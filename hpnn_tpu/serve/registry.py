@@ -48,6 +48,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time as _time
 
 import numpy as np
 
@@ -126,7 +127,19 @@ class _InFlight:
 
 class ServedModel:
     """One registered kernel: host weights + device-resident cast copies
-    and the per-bucket forward cache entry points."""
+    and the per-bucket forward cache entry points.
+
+    Hot reload (``swap_kernel``) replaces the device weights ATOMICALLY
+    under traffic: the cached forward callables capture a per-topology
+    weights HOLDER (a 1-element list) and read ``holder[0]`` per
+    dispatch -- a single reference store in CPython, so an in-flight
+    request sees the complete old weights or the complete new ones,
+    never a mix, and the jitted programs (keyed on shapes) are REUSED
+    when the topology is unchanged -- a reload never recompiles a
+    warmed bucket.  A topology-changing reload installs a FRESH holder
+    and purges this model's cache entries; callables fetched just
+    before the swap keep the old holder and finish on shape-consistent
+    old weights."""
 
     def __init__(self, name: str, nn, registry: "ModelRegistry"):
         from ..io.conf import NN_TYPE_ANN, NN_TYPE_SNN
@@ -140,10 +153,26 @@ class ServedModel:
                      else NN_TYPE_ANN)
         self.n_inputs = nn.kernel.n_inputs
         self.n_outputs = nn.kernel.n_outputs
-        self._weights = None              # cast lazily on first infer
+        self.generation = 1               # bumped by every swap_kernel
+        self.loaded_at = _time.time()
+        self.source = nn.conf.f_kernel    # where a bare reload re-reads
+        # device weights live behind one level of indirection PER
+        # TOPOLOGY: cached callables capture the holder (a 1-element
+        # list) / the mesh dict at creation.  A same-topology swap
+        # mutates holder[0] (atomic reference store -- in-flight
+        # dispatches see complete old or complete new weights); a
+        # topology change installs FRESH containers, so callables still
+        # holding the old ones keep serving shape-consistent old
+        # weights until the purge removes them.
+        self._holder: list | None = None  # [cast weights tuple]
         self._mesh_weights = {}           # mesh -> replicated device copies
         self._pool: _ScratchPool | None = None
         self._lock = threading.Lock()
+        # serializes whole reloads (disk read + swap): two concurrent
+        # reloads (manifest watcher racing a manual POST) must not
+        # interleave read-old/swap-new/swap-old -- the last reload to
+        # START is the one whose weights end up serving
+        self._reload_lock = threading.Lock()
 
     @property
     def dtype(self):
@@ -182,13 +211,22 @@ class ServedModel:
 
     def weights_nolock(self):
         """weights() body without re-taking the (non-reentrant) lock."""
-        if self._weights is None:
+        return self.weights_holder_nolock()[0]
+
+    def weights_holder_nolock(self) -> list:
+        if self._holder is None:
             import jax.numpy as jnp
 
-            self._weights = tuple(
+            self._holder = [tuple(
                 jnp.asarray(w, dtype=self.dtype)
-                for w in self.nn.kernel.weights)
-        return self._weights
+                for w in self.nn.kernel.weights)]
+        return self._holder
+
+    def weights_holder(self) -> list:
+        """The current topology's weights holder (see __init__): cached
+        callables capture it and read ``holder[0]`` per dispatch."""
+        with self._lock:
+            return self.weights_holder_nolock()
 
     def scratch_pool(self) -> _ScratchPool:
         with self._lock:
@@ -196,6 +234,67 @@ class ServedModel:
                 self._pool = _ScratchPool(self.n_inputs,
                                           np.dtype(self.dtype))
             return self._pool
+
+    def swap_kernel(self, kernel, source: str | None) -> dict:
+        """Atomically replace the served weights with ``kernel`` (hot
+        reload).  The new device copies (and replicated mesh copies for
+        every mesh already in use) are built OUTSIDE the lock, then
+        swapped in with plain reference assignments -- dispatches in
+        flight keep the old tuple, later ones get the new one, nobody
+        blocks on device transfers.  Same topology -> the per-bucket
+        compiled entries keep working untouched (they read the weights
+        through the model); a topology change purges this model's cache
+        entries so the next dispatch retraces at the new shapes."""
+        import jax
+        import jax.numpy as jnp
+
+        new_topo = tuple(int(p) for p in kernel.params)
+        changed = new_topo != self.topology
+        new_w = tuple(jnp.asarray(w, dtype=self.dtype)
+                      for w in kernel.weights)
+        from ..parallel.mesh import replicated
+
+        new_mesh = {
+            mesh: tuple(jax.device_put(w, replicated(mesh)) for w in new_w)
+            for mesh in list(self._mesh_weights)
+        }
+        with self._lock:
+            self.nn.kernel = kernel
+            if changed or self._holder is None:
+                # FRESH containers: callables compiled for the old
+                # topology keep the old holder/dict and finish their
+                # in-flight work on shape-consistent old weights
+                self._holder = [new_w]
+                self._mesh_weights = new_mesh
+            else:
+                # same topology: swap in place, every cached callable
+                # picks the new weights up on its next dispatch
+                self._holder[0] = new_w
+                # a mesh placed concurrently (first fast@mesh dispatch
+                # between our pre-lock snapshot and here) still holds
+                # the OLD weights: evict it, the next dispatch re-places
+                # from the new holder under this same lock
+                for mesh in [m for m in self._mesh_weights
+                             if m not in new_mesh]:
+                    del self._mesh_weights[mesh]
+                for mesh, rep in new_mesh.items():
+                    self._mesh_weights[mesh] = rep
+            if changed:
+                if kernel.n_inputs != self.n_inputs:
+                    self._pool = None  # scratch width no longer fits
+                self.n_inputs = kernel.n_inputs
+                self.n_outputs = kernel.n_outputs
+            self.generation += 1
+            self.loaded_at = _time.time()
+            if source:
+                self.source = source
+            gen = self.generation
+        if changed:
+            self.registry.purge_cache(self.name, keep_topology=new_topo)
+        return {"kernel": self.name, "generation": gen,
+                "topology_changed": changed,
+                "topology": list(new_topo),
+                "source": self.source}
 
     def infer(self, xs: np.ndarray) -> np.ndarray:
         """Batched forward for (rows, n_inputs) float64 inputs; returns
@@ -317,6 +416,8 @@ class ModelRegistry:
                          "registered!\n")
                 return None
             self._models[name] = model
+        self.metrics.set_model_info(name, model.generation,
+                                    model.loaded_at)
         nn_out(f"serve: registered kernel '{name}' "
                f"({'x'.join(str(p) for p in model.topology)}, "
                f"{model.dtype_name}, {model.kind}, "
@@ -326,6 +427,47 @@ class ModelRegistry:
     def get(self, name: str) -> ServedModel | None:
         with self._lock:
             return self._models.get(name)
+
+    # --- hot reload -----------------------------------------------------
+    def reload(self, name: str,
+               kernel_path: str | None = None) -> tuple[dict | None, str]:
+        """Re-read a model's weights from disk and swap them in under
+        traffic.  ``kernel_path`` defaults to the model's last source
+        (its conf's ``[init]`` kernel file, or whatever the previous
+        reload used).  Returns ``(result, "")`` or ``(None, reason)`` --
+        a failed load leaves the served weights UNTOUCHED."""
+        model = self.get(name)
+        if model is None:
+            return None, f"unknown kernel '{name}'"
+        src = kernel_path or model.source
+        if not src:
+            return None, (f"kernel '{name}' has no weights file to "
+                          "reload from (conf used [init] generate); "
+                          "pass an explicit kernel path")
+        from ..io.kernel_io import load_kernel
+
+        with model._reload_lock:  # see ServedModel.__init__
+            kernel = load_kernel(src)
+            if kernel is None:
+                return None, f"failed to load kernel from {src}"
+            result = model.swap_kernel(kernel, src)
+        self.metrics.set_model_info(name, model.generation,
+                                    model.loaded_at)
+        nn_out(f"serve: reloaded kernel '{name}' from {src} "
+               f"(generation {result['generation']}"
+               f"{', topology changed' if result['topology_changed'] else ''}"
+               ")\n")
+        return result, ""
+
+    def purge_cache(self, name: str, keep_topology: tuple | None) -> int:
+        """Drop a model's compiled entries whose topology no longer
+        matches (after a topology-changing reload); returns the count."""
+        with self._lock:
+            stale = [k for k in self._cache
+                     if k[0] == name and k[1] != keep_topology]
+            for k in stale:
+                del self._cache[k]
+        return len(stale)
 
     def names(self) -> list[str]:
         with self._lock:
@@ -382,29 +524,41 @@ class ModelRegistry:
             from .. import ops
 
             kind = model.kind
+            # entries capture the model's CURRENT-topology weight
+            # holder (not the weights tuple) and read holder[0] per
+            # dispatch -- a lock-free list indexing: that is what lets
+            # swap_kernel hot-swap same-topology weights under traffic
+            # while the compiled programs (keyed on shapes) are reused,
+            # and what keeps a topology-CHANGING swap from feeding
+            # new-shape weights to an in-flight old-shape dispatch
+            # (the old holder object stays with the old callables)
             if tier.startswith("fast@mesh"):
                 from ..parallel.dp import dp_eval_batch
 
                 mesh = self.mesh
                 xsh = self._batch_sharding(mesh)
-                wrep = model.mesh_weights(mesh)
+                model.mesh_weights(mesh)  # place + cache the copies now
+                mesh_dict = model._mesh_weights  # captured, see above
+
                 path = f"gemm+{tier.split('@')[1]}"
 
-                def fn(buf, _w=wrep, _k=kind, _m=mesh, _sh=xsh):
+                def fn(buf, _mo=model, _k=kind, _m=mesh, _sh=xsh,
+                       _md=mesh_dict):
                     import jax
 
-                    return dp_eval_batch(_w, jax.device_put(buf, _sh),
+                    w = _md.get(_m) or _mo.mesh_weights(_m)
+                    return dp_eval_batch(w, jax.device_put(buf, _sh),
                                          _k, _m)
             else:
                 run_batch_fn, path = ops.select_run_batch(
                     model.dtype,
                     parity="fast" if tier == "fast" else "strict")
-                weights = model.weights()
+                holder = model.weights_holder()
 
-                def fn(buf, _fn=run_batch_fn, _w=weights, _k=kind):
+                def fn(buf, _fn=run_batch_fn, _h=holder, _k=kind):
                     import jax.numpy as jnp
 
-                    return _fn(_w, jnp.asarray(buf), _k)
+                    return _fn(_h[0], jnp.asarray(buf), _k)
 
             self._cache[key] = fn
             self.metrics.count_cache(hit=False)

@@ -13,7 +13,13 @@ Endpoints:
   ``503 draining`` during shutdown), so load balancers admit traffic
   when the compile cache is hot.
 * ``GET /metrics``  -- Prometheus text; ``?format=json`` for the JSON
-  snapshot (what scripts/serve_bench.py consumes).
+  snapshot (what scripts/serve_bench.py consumes); includes per-kernel
+  model generation + last-reload-timestamp gauges and reload counters.
+* ``POST /v1/kernels/<name>/reload`` -- hot-swap the model's weights
+  from disk (optional body ``{"kernel": "<path>"}``) without dropping
+  in-flight traffic; same-topology swaps reuse every compiled batch
+  bucket.  The registry can also watch a checkpoint manifest
+  (``serve_nn --watch-ckpt``) and reload on every generation bump.
 
 Status mapping (distinct by failure class, so clients can react):
 
@@ -21,6 +27,7 @@ Status mapping (distinct by failure class, so clients can react):
   200   result
   400   malformed body / wrong input width / too many rows
   404   unknown kernel
+  409   reload failed (weights file unreadable; old weights serve on)
   429   queue full (backpressure -- retry later; Retry-After: 1)
   503   server draining (shutdown in progress)
   504   deadline exceeded (queued or computed past the timeout)
@@ -34,8 +41,10 @@ one touching the device -- the HTTP layer is pure coordination.
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
@@ -46,6 +55,7 @@ from .metrics import ServeMetrics
 from .registry import ModelRegistry
 
 _INFER_RE = re.compile(r"^/v1/kernels/([^/]+)/infer$")
+_RELOAD_RE = re.compile(r"^/v1/kernels/([^/]+)/reload$")
 
 
 class _HTTPError(Exception):
@@ -99,6 +109,7 @@ class ServeApp:
         self.warmup_workers = warmup_workers
         self._warming: set[str] = set()
         self._warming_lock = threading.Lock()
+        self._watchers: list[threading.Thread] = []
         self._closed = False
 
     def _warm(self, model) -> None:
@@ -165,6 +176,75 @@ class ServeApp:
         for b in self.batchers.values():
             b.close(drain=drain)
 
+    # --- model lifecycle (hot reload) ----------------------------------
+    def reload_model(self, name: str,
+                     kernel_path: str | None = None) -> dict:
+        """Swap a model's weights from disk under traffic (registry
+        ``reload``); raises KeyError for an unknown kernel, ValueError
+        when the weights file cannot be loaded (the served weights stay
+        untouched).  Counted into the reload metrics either way."""
+        result, reason = self.registry.reload(name, kernel_path)
+        if result is None:
+            self.metrics.count_reload(False)
+            if "unknown kernel" in reason:
+                raise KeyError(name)
+            raise ValueError(reason)
+        self.metrics.count_reload(True)
+        return result
+
+    def watch_manifest(self, name: str, ckpt_dir: str,
+                       interval_s: float = 2.0) -> threading.Thread:
+        """Poll a checkpoint directory's manifest (hpnn_tpu/ckpt) and
+        hot-reload ``name`` whenever its ``generation`` counter moves --
+        a training run checkpointing into that directory streams its
+        progress straight into serving, no restart.  The manifest (and
+        every bundle) is published by atomic rename, so a poll never
+        sees a half-written kernel."""
+        from ..ckpt import read_manifest
+
+        # baseline 0, NOT the manifest's current generation: a manifest
+        # that already exists when the watch starts (training finished
+        # before the server came up) must be loaded on the first poll,
+        # or the server would serve the conf's possibly-older kernel
+        # until the next training run
+        state = {"gen": 0}
+
+        def loop():
+            from ..utils.nn_log import nn_warn
+
+            while not self._closed:
+                time.sleep(interval_s)
+                m = read_manifest(ckpt_dir)
+                if not m:
+                    continue
+                gen = m.get("generation", 0)
+                if gen == state["gen"]:
+                    continue
+                rel = m.get("kernel")
+                if not rel:
+                    state["gen"] = gen
+                    continue
+                try:
+                    self.reload_model(name,
+                                      os.path.join(ckpt_dir, rel))
+                except Exception as exc:
+                    # do NOT mark the generation consumed: a transient
+                    # failure (mid-prune bundle, FS hiccup) on the
+                    # run's LAST bump would otherwise leave the server
+                    # stale forever; the next poll retries
+                    nn_warn(f"serve: watched reload of '{name}' from "
+                            f"{ckpt_dir} failed (will retry): {exc}\n")
+                else:
+                    state["gen"] = gen
+
+        t = threading.Thread(target=loop, daemon=True,
+                             name=f"hpnn-ckpt-watch-{name}")
+        t.start()
+        self._watchers.append(t)
+        nn_out(f"serve: watching {ckpt_dir} for '{name}' reloads "
+               f"(every {interval_s:g}s)\n")
+        return t
+
     # --- request handling (transport-independent) ----------------------
     def handle_infer(self, name: str, body: bytes) -> dict:
         b = self.batchers.get(name)
@@ -215,6 +295,34 @@ class ServeApp:
             "outputs": outs.tolist(),
             "argmax": [int(i) for i in np.argmax(outs, axis=1)],
         }
+
+    def handle_reload(self, name: str, body: bytes) -> dict:
+        """POST /v1/kernels/<name>/reload: optional JSON body
+        ``{"kernel": "<path>"}`` picks the weights file; default is the
+        model's last source.  409 when the file fails to load (the old
+        weights keep serving)."""
+        kernel_path = None
+        if body.strip():
+            try:
+                req = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+                raise _HTTPError(400, "bad_request", f"bad JSON: {exc}")
+            if not isinstance(req, dict):
+                raise _HTTPError(400, "bad_request",
+                                 "body must be an object")
+            kernel_path = req.get("kernel")
+            if kernel_path is not None and not isinstance(kernel_path,
+                                                          str):
+                raise _HTTPError(400, "bad_request",
+                                 "'kernel' must be a path string")
+        try:
+            return self.reload_model(name, kernel_path)
+        except KeyError:
+            raise _HTTPError(404, "not_found", f"unknown kernel '{name}'")
+        except ValueError as exc:
+            raise _HTTPError(409, "reload_failed", str(exc))
+        except Exception as exc:
+            raise _HTTPError(500, "error", f"{type(exc).__name__}: {exc}")
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -281,6 +389,16 @@ class _Handler(BaseHTTPRequestHandler):
             self.app.metrics.count_request("bad_request")
             self._reply(400, {"error": "bad Content-Length",
                               "reason": "bad_request"})
+            return
+        r = _RELOAD_RE.match(self.path)
+        if r is not None:
+            try:
+                out = self.app.handle_reload(r.group(1), body)
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+                return
+            self._reply(200, out)
             return
         m = _INFER_RE.match(self.path)
         if m is None:

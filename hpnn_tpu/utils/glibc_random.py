@@ -79,6 +79,31 @@ class GlibcRandom:
         self._r = r + 1 if r + 1 < _DEG else 0
         return val >> 1
 
+    # -- state capture (checkpoint/resume) ---------------------------------
+
+    def get_state(self) -> list[int]:
+        """The full generator state as 33 ints: the 31 state words then
+        the front/rear pointers.  Restoring it with :meth:`set_state`
+        continues the output stream bit-exactly -- the checkpoint
+        subsystem persists this so a resumed training run draws the SAME
+        shuffle orders the uninterrupted run would have."""
+        return [*self._state, self._f, self._r]
+
+    def set_state(self, state) -> None:
+        vals = [int(v) for v in state]
+        if len(vals) != _DEG + 2:
+            raise ValueError(
+                f"glibc RNG state must be {_DEG + 2} ints, got {len(vals)}")
+        self._state = [v & _M32 for v in vals[:_DEG]]
+        self._f = vals[_DEG] % _DEG
+        self._r = vals[_DEG + 1] % _DEG
+
+    @classmethod
+    def from_state(cls, state) -> "GlibcRandom":
+        rng = cls.__new__(cls)
+        rng.set_state(state)
+        return rng
+
     # -- bulk helpers ------------------------------------------------------
 
     def randoms(self, n: int) -> np.ndarray:

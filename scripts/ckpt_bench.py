@@ -1,0 +1,227 @@
+"""Generate CKPT_BENCH.json: checkpoint overhead + hot-reload latency.
+
+Two questions the checkpoint subsystem must answer with numbers:
+
+1. **Snapshot overhead** -- how long does the training loop stall per
+   epoch-boundary snapshot?  Measured as the wall time of
+   ``CheckpointManager.save`` (what the epoch loop actually pays) in
+   two modes on the same kernel:
+
+   * ``sync``  -- the bundle is formatted + fsync'd on the caller
+     thread (``use_pool=False``), the naive design;
+   * ``async`` -- the production default: state captured on the caller
+     thread, formatted/fsync'd on the shared ``io_pool`` executor, so
+     the save returns in capture time and the write overlaps the next
+     epoch's device work (``flush`` at the end pays whatever is left).
+
+2. **Hot-reload latency under load** -- a serving registry answering a
+   steady stream of infer requests while ``reload_model`` swaps a
+   same-topology kernel N times: per-reload wall time, plus request
+   latency percentiles DURING the reload storm vs a quiet baseline,
+   and the assertion that zero requests failed and zero buckets
+   recompiled (the swap reuses every compiled entry).
+
+Usage: python scripts/ckpt_bench.py [--topology 784x300x10]
+       [--snapshots 8] [--reloads 10] [--clients 4]
+       [--out CKPT_BENCH.json]
+
+Always exits 0 with one parseable JSON line on stdout (bench
+convention: rc!=0 only when nothing could be measured).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)  # f64 kernels, like init_all
+
+from hpnn_tpu import ckpt  # noqa: E402
+from hpnn_tpu.ckpt.manager import CheckpointManager  # noqa: E402
+from hpnn_tpu.io.kernel_io import dump_kernel_to_path  # noqa: E402
+from hpnn_tpu.models.kernel import generate_kernel  # noqa: E402
+from hpnn_tpu.utils.glibc_random import GlibcRandom  # noqa: E402
+
+
+class _NN:  # minimal NNDef stand-in for the manager's capture
+    pass
+
+
+def _mk_nn(topo):
+    k, _ = generate_kernel(11, topo[0], list(topo[1:-1]), topo[-1])
+    nn = _NN()
+    nn.kernel = k
+    nn.conf = type("C", (), {"train": "BPM", "seed": 11,
+                             "dtype": "f64"})()
+    nn.shuffle_rng = GlibcRandom(11)
+    return nn
+
+
+def bench_snapshots(topo, n, base) -> dict:
+    out = {}
+    for mode, use_pool in (("sync", False), ("async", True)):
+        nn = _mk_nn(topo)
+        ckdir = os.path.join(base, f"ck_{mode}")
+        mgr = CheckpointManager(ckdir, every=1, keep_last=3,
+                                use_pool=use_pool)
+        stalls = []
+        t0 = time.perf_counter()
+        for epoch in range(1, n + 1):
+            # a "new epoch result": replace the weight list like
+            # api.train_kernel does (the capture shares, never copies)
+            nn.kernel.weights = [w + 1e-9 for w in nn.kernel.weights]
+            nn.shuffle_rng.randoms(97)
+            s0 = time.perf_counter()
+            mgr.epoch_done(nn, epoch, 1.0 / epoch)
+            stalls.append(time.perf_counter() - s0)
+        f0 = time.perf_counter()
+        mgr.flush()
+        flush_s = time.perf_counter() - f0
+        total = time.perf_counter() - t0
+        out[mode] = {
+            "snapshots": n,
+            "save_stall_mean_ms": round(float(np.mean(stalls)) * 1e3, 3),
+            "save_stall_max_ms": round(float(np.max(stalls)) * 1e3, 3),
+            "final_flush_ms": round(flush_s * 1e3, 3),
+            "wall_s": round(total, 4),
+        }
+    s, a = out["sync"], out["async"]
+    out["caller_stall_reduction_x"] = round(
+        s["save_stall_mean_ms"] / max(a["save_stall_mean_ms"], 1e-6), 2)
+    return out
+
+
+def bench_reload(topo, reloads, clients, base) -> dict:
+    from hpnn_tpu.serve.server import ServeApp
+
+    k1, _ = generate_kernel(21, topo[0], list(topo[1:-1]), topo[-1])
+    k2, _ = generate_kernel(22, topo[0], list(topo[1:-1]), topo[-1])
+    kpath = os.path.join(base, "kernel.opt")
+    dump_kernel_to_path(k1, kpath)
+    conf = os.path.join(base, "serve.conf")
+    with open(conf, "w") as fp:
+        fp.write(f"[name] bench\n[type] ANN\n[init] {kpath}\n[seed] 1\n"
+                 f"[input] {topo[0]}\n"
+                 "[hidden] " + " ".join(str(h) for h in topo[1:-1]) + "\n"
+                 f"[output] {topo[-1]}\n[train] BP\n"
+                 f"[sample_dir] {base}\n[test_dir] {base}\n")
+    app = ServeApp(max_batch=16)
+    if app.add_model(conf, warmup=True) is None:
+        return {"error": "model registration failed"}
+    x = np.linspace(-1.0, 1.0, topo[0], dtype=np.float64).reshape(1, -1)
+
+    lat_quiet: list[float] = []
+    lat_storm: list[float] = []
+    sink = lat_quiet
+    errors: list[str] = []
+    stop = threading.Event()
+
+    def client():
+        while not stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                app.infer("bench", x)
+            except Exception as exc:  # noqa: BLE001
+                errors.append(repr(exc))
+                return
+            sink.append(time.perf_counter() - t0)
+
+    threads = [threading.Thread(target=client) for _ in range(clients)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # quiet baseline
+    misses_before = app.registry.cache_stats()["misses"]
+    sink = lat_storm
+    reload_times = []
+    alt = [k2, k1]
+    for i in range(reloads):
+        dump_kernel_to_path(alt[i % 2], kpath)
+        r0 = time.perf_counter()
+        app.reload_model("bench")
+        reload_times.append(time.perf_counter() - r0)
+        time.sleep(0.05)
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join()
+    misses_after = app.registry.cache_stats()["misses"]
+    app.close()
+
+    def pct(v, p):
+        return round(float(np.percentile(v, p)) * 1e3, 3) if v else None
+
+    return {
+        "reloads": reloads,
+        "clients": clients,
+        "reload_mean_ms": round(float(np.mean(reload_times)) * 1e3, 3),
+        "reload_p99_ms": pct(reload_times, 99),
+        "requests_quiet": len(lat_quiet),
+        "requests_during_reloads": len(lat_storm),
+        "request_errors": len(errors),
+        "recompiles_during_reloads": misses_after - misses_before,
+        "infer_quiet_p50_ms": pct(lat_quiet, 50),
+        "infer_quiet_p99_ms": pct(lat_quiet, 99),
+        "infer_storm_p50_ms": pct(lat_storm, 50),
+        "infer_storm_p99_ms": pct(lat_storm, 99),
+        "generation_final": app.metrics.snapshot()
+        ["models"]["bench"]["generation"],
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--topology", default="784x300x10",
+                    help="LxMxN kernel shape (default 784x300x10)")
+    ap.add_argument("--snapshots", type=int, default=8)
+    ap.add_argument("--reloads", type=int, default=10)
+    ap.add_argument("--clients", type=int, default=4)
+    ap.add_argument("--out", default=os.path.join(REPO, "CKPT_BENCH.json"))
+    args = ap.parse_args()
+    topo = tuple(int(v) for v in args.topology.split("x"))
+
+    import tempfile
+
+    base = tempfile.mkdtemp(prefix="ckpt_bench_")
+    result = {
+        "topology": list(topo),
+        "weights": int(sum(a * b for a, b in zip(topo[:-1], topo[1:]))),
+        "host_cpus": os.cpu_count(),
+        "snapshot": bench_snapshots(topo, args.snapshots, base),
+        "reload": bench_reload(topo, args.reloads, args.clients, base),
+    }
+    # sanity: the retention cap must have pruned the sync dir too
+    m = ckpt.read_manifest(os.path.join(base, "ck_async"))
+    result["snapshot"]["retained_bundles"] = \
+        len(m["snapshots"]) if m else None
+    with open(args.out, "w") as fp:
+        json.dump(result, fp, indent=1)
+        fp.write("\n")
+    print(json.dumps({
+        "snapshot_stall_sync_ms":
+            result["snapshot"]["sync"]["save_stall_mean_ms"],
+        "snapshot_stall_async_ms":
+            result["snapshot"]["async"]["save_stall_mean_ms"],
+        "caller_stall_reduction_x":
+            result["snapshot"]["caller_stall_reduction_x"],
+        "reload_mean_ms": result["reload"].get("reload_mean_ms"),
+        "request_errors": result["reload"].get("request_errors"),
+        "recompiles": result["reload"].get("recompiles_during_reloads"),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

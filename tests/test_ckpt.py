@@ -1,0 +1,669 @@
+"""Checkpoint & model-lifecycle subsystem tests (hpnn_tpu/ckpt).
+
+The acceptance pin: kill-at-epoch-k + ``train_nn --resume`` produces a
+byte-identical ``kernel.opt`` AND console stream versus the
+uninterrupted run, for BP and BPM (weights, BPM momentum semantics,
+shuffle-RNG state and epoch counter restored) -- the repo's parity
+guarantee extended across process death.  Plus: atomic snapshot bundles
+and kernel dumps, manifest retention, the run_nn fingerprint guard, and
+serve hot reload (swap under traffic, no recompile, manifest watcher).
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import cli
+from hpnn_tpu import ckpt
+from hpnn_tpu.ckpt.manager import CheckpointManager
+from hpnn_tpu.io.kernel_io import dump_kernel_to_path, dumps_kernel, load_kernel
+from hpnn_tpu.models.kernel import generate_kernel
+from hpnn_tpu.utils import nn_log
+from hpnn_tpu.utils.glibc_random import GlibcRandom
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+def _write_corpus(dirpath, rng, n):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n")
+            fp.write(" ".join(f"{v:7.5f}" for v in x) + "\n")
+            fp.write(f"[output] {N_OUT}\n")
+            fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+@pytest.fixture()
+def corpus(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    _write_corpus(tmp_path / "samples", rng, N_SAMP)
+    _write_corpus(tmp_path / "tests", rng, N_SAMP)
+    monkeypatch.chdir(tmp_path)
+    yield tmp_path
+    nn_log.set_verbosity(0)
+
+
+def _conf(tmp_path, train="BP", seed=1234):
+    text = (
+        "[name] tiny\n[type] ANN\n[init] generate\n"
+        f"[seed] {seed}\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        f"[train] {train}\n"
+        f"[sample_dir] {tmp_path}/samples\n[test_dir] {tmp_path}/tests\n")
+    path = tmp_path / f"nn_{train}.conf"
+    path.write_text(text)
+    return str(path)
+
+
+def _train(args, capsys, env=None):
+    """One in-process train_nn run with a FRESH verbosity of exactly 2
+    (the NN:/grammar level, below the wall-clock DBG lines), returning
+    (rc, stdout)."""
+    nn_log.set_verbosity(0)
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        rc = cli.train_nn_main(["-vv", *args])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rc, capsys.readouterr().out
+
+
+# --- the acceptance pin: kill at epoch k, resume, byte parity --------------
+
+@pytest.mark.parametrize("train", ["BP", "BPM"])
+def test_kill_and_resume_byte_parity(corpus, capsys, train):
+    conf = _conf(corpus, train=train)
+    epochs = 3
+
+    # uninterrupted reference run
+    os.makedirs("full")
+    os.chdir("full")
+    rc, out_full = _train([f"--epochs={epochs}", "--ckpt-every=1",
+                           "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    full_opt = open("kernel.opt", "rb").read()
+    os.chdir("..")
+
+    # same run, killed at the epoch-1 boundary through the REAL
+    # SIGTERM handler path (deterministic via the test hook)
+    os.makedirs("part")
+    os.chdir("part")
+    rc, out_kill = _train([f"--epochs={epochs}", "--ckpt-every=1",
+                           "--ckpt-dir=ck", conf], capsys,
+                          env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0  # clean exit, not a crash
+    assert f"CKPT: interrupted at epoch 1/{epochs}" in out_kill
+    assert "EPOCH        2/" not in out_kill  # really stopped
+
+    # resume: epochs 2..N replay bit-exactly
+    rc, out_res = _train([f"--epochs={epochs}", "--resume",
+                          "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    part_opt = open("kernel.opt", "rb").read()
+    os.chdir("..")
+
+    assert part_opt == full_opt  # byte-identical kernel.opt
+    # byte-identical console stream from the first resumed epoch on
+    mark = f"NN: EPOCH        2/{epochs:8d}\n"
+    assert mark in out_full and mark in out_res
+    assert out_res[out_res.index(mark):] == out_full[out_full.index(mark):]
+    # and the killed run's prefix matches the uninterrupted run's prefix
+    # (everything before the interruption message)
+    pre = out_kill[:out_kill.index("NN: CKPT: interrupted")]
+    assert out_full.startswith(pre)
+
+
+def test_resume_restores_error_trajectory_and_epoch(corpus, capsys):
+    conf = _conf(corpus)
+    rc, _ = _train(["--epochs=2", "--ckpt-every=1", "--ckpt-dir=ck",
+                    conf], capsys)
+    assert rc == 0
+    m1 = ckpt.read_manifest("ck")
+    assert m1["epoch"] == 2 and len(m1["errors"]) == 2
+    rc, out = _train(["--epochs=4", "--resume", "--ckpt-dir=ck", conf],
+                     capsys)
+    assert rc == 0
+    assert "NN: EPOCH        3/       4" in out
+    assert "NN: EPOCH        2/" not in out  # epochs 1-2 not re-run
+    m2 = ckpt.read_manifest("ck")
+    assert m2["epoch"] == 4
+    # the restored trajectory keeps the whole run's error curve
+    assert len(m2["errors"]) == 4
+    assert m2["errors"][:2] == m1["errors"]
+    assert m2["generation"] > m1["generation"]
+
+
+def test_bare_resume_continues_to_recorded_target(corpus, capsys):
+    """--resume without --epochs continues to the interrupted run's own
+    --epochs goal (recorded in every bundle) instead of silently
+    training zero epochs."""
+    conf = _conf(corpus)
+    os.makedirs("full")
+    os.chdir("full")
+    rc, _ = _train(["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck",
+                    conf], capsys)
+    assert rc == 0
+    full_opt = open("kernel.opt", "rb").read()
+    os.chdir("..")
+    os.makedirs("part")
+    os.chdir("part")
+    rc, _ = _train(["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck",
+                    conf], capsys, env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0
+    rc, out = _train(["--resume", "--ckpt-dir=ck", conf], capsys)  # bare
+    assert rc == 0
+    assert "NN: EPOCH        3/       3" in out
+    assert open("kernel.opt", "rb").read() == full_opt
+    # resuming a COMPLETED run trains nothing and says so
+    rc, _ = _train(["--resume", "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    os.chdir("..")
+
+
+def test_every_zero_still_bundles_final_epoch(corpus, capsys):
+    """--ckpt-every 0: no mid-run snapshots, but clean completion (and
+    signals) still write a final bundle -- the manifest's latest kernel
+    is always the finished model."""
+    conf = _conf(corpus)
+    rc, out = _train(["--epochs=2", "--ckpt-every=0", "--ckpt-dir=ck",
+                      conf], capsys)
+    assert rc == 0
+    assert "CKPT: snapshot ep00000001" not in out
+    assert "CKPT: snapshot ep00000002" in out
+    m = ckpt.read_manifest("ck")
+    assert m["latest"] == "ep00000002"
+    snap = ckpt.load_snapshot("ck")
+    assert snap.epoch == 2 and snap.target_epochs == 2
+
+
+def test_ckpt_keep_alone_enables_checkpointing(corpus, capsys):
+    conf = _conf(corpus)
+    rc, out = _train(["--epochs=2", "--ckpt-keep=5", conf], capsys)
+    assert rc == 0
+    assert "CKPT: snapshot" in out
+    assert ckpt.read_manifest("ckpt") is not None  # default ./ckpt
+
+
+def test_signal_snapshot_on_off_boundary(corpus, capsys):
+    """--ckpt-every 2 + kill at epoch 1: the signal path must still
+    write a final snapshot for the odd epoch."""
+    conf = _conf(corpus)
+    rc, out = _train(["--epochs=4", "--ckpt-every=2", "--ckpt-dir=ck",
+                      conf], capsys,
+                     env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0
+    assert "CKPT: snapshot ep00000001" in out
+    snap = ckpt.load_snapshot("ck")
+    assert snap is not None and snap.epoch == 1
+
+
+# --- bundle format / atomicity ---------------------------------------------
+
+def test_snapshot_round_trip_bit_exact(tmp_path):
+    k, _ = generate_kernel(42, 5, [4], 3)
+    k.weights = [w + np.pi * 1e-7 for w in k.weights]  # non-dumpable bits
+    rng = GlibcRandom(99)
+    rng.randoms(17)
+    entry = ckpt.write_snapshot(
+        str(tmp_path / "ck"), 3, weights=k.weights,
+        momentum=[np.zeros_like(w) for w in k.weights],
+        rng_state=rng.get_state(), seed=99, errors=[0.5, 0.25, 0.125],
+        name=k.name, train="BPM")
+    ckpt.publish_snapshot(str(tmp_path / "ck"), entry, seed=99,
+                          errors=[0.5, 0.25, 0.125])
+    snap = ckpt.load_snapshot(str(tmp_path / "ck"))
+    assert snap.epoch == 3 and snap.seed == 99
+    for a, b in zip(snap.weights, k.weights):
+        assert a.dtype == np.float64
+        np.testing.assert_array_equal(a, b)  # BIT exact, not allclose
+    assert snap.momentum is not None and len(snap.momentum) == 2
+    assert snap.rng_state == rng.get_state()
+    assert snap.errors == [0.5, 0.25, 0.125]
+    # the bundle's kernel.opt is the reference text format
+    k2 = load_kernel(os.path.join(snap.path, ckpt.SNAPSHOT_KERNEL))
+    assert k2 is not None and [int(p) for p in k2.params] == snap.topology
+    # fingerprint matches the bytes
+    assert snap.fingerprint == entry["fingerprint"]
+
+
+def test_snapshot_write_leaves_no_tmp_and_is_atomic(tmp_path):
+    ck = str(tmp_path / "ck")
+    k, _ = generate_kernel(1, 4, [3], 2)
+    for epoch in (1, 2):
+        ckpt.write_snapshot(ck, epoch, weights=k.weights, momentum=None,
+                            rng_state=None, seed=1, errors=[])
+    names = os.listdir(ck)
+    assert sorted(names) == ["ep00000001", "ep00000002"]
+    assert not any(n.startswith(".tmp") for n in names)
+    # a stale tmp dir from a crashed writer is cleaned up on rewrite
+    os.makedirs(os.path.join(ck, f".tmp.ep00000002.{os.getpid()}"))
+    ckpt.write_snapshot(ck, 2, weights=k.weights, momentum=None,
+                        rng_state=None, seed=1, errors=[])
+    assert not any(n.startswith(".tmp") for n in os.listdir(ck))
+
+
+def test_retention_keeps_last_n_plus_best(tmp_path):
+    ck = str(tmp_path / "ck")
+    k, _ = generate_kernel(1, 4, [3], 2)
+    errs = [0.5, 0.1, 0.4, 0.3]  # best at epoch 2
+    for epoch, e in enumerate(errs, start=1):
+        entry = ckpt.write_snapshot(ck, epoch, weights=k.weights,
+                                    momentum=None, rng_state=None,
+                                    seed=1, errors=errs[:epoch])
+        manifest = ckpt.publish_snapshot(ck, entry, seed=1,
+                                         errors=errs[:epoch], keep_last=2)
+    tags = sorted(t for t in os.listdir(ck) if t.startswith("ep"))
+    # last two (ep3, ep4) plus best-by-error (ep2); ep1 pruned
+    assert tags == ["ep00000002", "ep00000003", "ep00000004"]
+    assert [s["tag"] for s in manifest["snapshots"]] == tags
+    assert manifest["latest"] == "ep00000004"
+
+
+def test_atomic_kernel_dump(tmp_path):
+    k, _ = generate_kernel(5, 4, [3], 2)
+    path = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(k, path)
+    assert open(path).read() == dumps_kernel(k)
+    assert [f for f in os.listdir(tmp_path)] == ["kernel.opt"]  # no tmp
+
+
+def test_glibc_rng_state_round_trip():
+    a = GlibcRandom(1234)
+    a.randoms(1000)
+    state = a.get_state()
+    b = GlibcRandom.from_state(state)
+    assert [a.random() for _ in range(100)] == \
+           [b.random() for _ in range(100)]
+    with pytest.raises(ValueError):
+        GlibcRandom.from_state([1, 2, 3])
+
+
+def test_manager_async_writes_surface_failures(tmp_path, monkeypatch):
+    class NN:
+        pass
+
+    nn = NN()
+    nn.conf = type("C", (), {"train": "BP", "seed": 1, "dtype": "f64"})()
+    k, _ = generate_kernel(3, 4, [3], 2)
+    nn.kernel = k
+    nn.shuffle_rng = None
+    mgr = CheckpointManager(str(tmp_path / "nope" / "deep"), every=1)
+    # make the target un-creatable: a FILE where the dir should be
+    (tmp_path / "nope").write_text("in the way")
+    mgr.epoch_done(nn, 1, 0.5)
+    with pytest.raises(OSError):
+        mgr.flush()
+
+
+# --- resume CLI grammar ----------------------------------------------------
+
+def test_resume_path_grammar(tmp_path, monkeypatch, capsys):
+    """--resume [PATH]: a separated token is the resume path only when
+    it looks like a checkpoint; otherwise it is the conf filename."""
+    ck = tmp_path / "ck"
+    ck.mkdir()
+    (ck / "manifest.json").write_text("{}")
+    assert ckpt.looks_like_checkpoint(str(ck))
+    assert not ckpt.looks_like_checkpoint(str(tmp_path / "nn.conf"))
+    parsed = cli._parse_args(["--resume", str(ck), "some.conf"],
+                             "train_nn", train=True)
+    assert parsed[0] == "some.conf"
+    assert parsed[2]["resume"] == str(ck)
+    parsed = cli._parse_args(["--resume", "some.conf"], "train_nn",
+                             train=True)
+    assert parsed[0] == "some.conf"
+    assert parsed[2]["resume"] is True
+    parsed = cli._parse_args([f"--resume={ck}"], "train_nn", train=True)
+    assert parsed[2]["resume"] == str(ck)
+    with pytest.raises(SystemExit):
+        cli._parse_args(["--epochs", "0"], "train_nn", train=True)
+    with pytest.raises(SystemExit):
+        cli._parse_args(["--resume", "x"], "run_nn", train=False)
+
+
+def test_resume_without_snapshot_fails_loudly(corpus, capsys):
+    conf = _conf(corpus)
+    rc, _ = _train(["--resume", "--ckpt-dir=empty", conf], capsys)
+    assert rc == -1
+
+
+def test_resume_topology_mismatch_fails(corpus, capsys):
+    conf = _conf(corpus)
+    rc, _ = _train(["--epochs=1", "--ckpt-every=1", "--ckpt-dir=ck",
+                    conf], capsys)
+    assert rc == 0
+    other = str(corpus / "other.conf")
+    with open(conf) as fp:
+        text = fp.read()
+    with open(other, "w") as fp:
+        fp.write(text.replace(f"[hidden] {N_HID}", "[hidden] 5"))
+    rc, _ = _train(["--resume", "--ckpt-dir=ck", other], capsys)
+    assert rc == -1
+
+
+def test_explicit_resume_path_keeps_checkpoint_home(corpus, capsys):
+    """--resume PATH (no --ckpt-dir) continues snapshotting into PATH's
+    checkpoint directory, not ./ckpt -- one run, one history."""
+    conf = _conf(corpus)
+    rc, _ = _train(["--epochs=3", "--ckpt-every=1", "--ckpt-dir=home",
+                    conf], capsys, env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0
+    gen_before = ckpt.read_manifest("home")["generation"]
+    rc, _ = _train([f"--resume={corpus}/home", conf], capsys)
+    assert rc == 0
+    assert not os.path.isdir("ckpt")  # nothing leaked to the default
+    m = ckpt.read_manifest("home")
+    assert m["epoch"] == 3 and m["generation"] > gen_before
+
+
+# --- run_nn staleness guard ------------------------------------------------
+
+def test_run_nn_warns_on_fingerprint_mismatch(corpus, capsys):
+    conf = _conf(corpus)
+    rc, _ = _train(["--epochs=1", "--ckpt-every=1", "--ckpt-dir=ckpt",
+                    conf], capsys)
+    assert rc == 0
+    cont = str(corpus / "cont.conf")
+    with open(conf) as fp:
+        text = fp.read()
+    with open(cont, "w") as fp:
+        fp.write(text.replace("[init] generate", "[init] kernel.opt"))
+    # pristine kernel: no warning
+    nn_log.set_verbosity(0)
+    assert cli.run_nn_main(["-v", cont]) == 0
+    out = capsys.readouterr().out
+    assert "fingerprint mismatch" not in out
+    # doctor the weights file behind the manifest's back
+    with open("kernel.opt", "a") as fp:
+        fp.write("\n")
+    nn_log.set_verbosity(0)
+    assert cli.run_nn_main(["-v", cont]) == 0  # still evaluates...
+    out = capsys.readouterr().out
+    assert "kernel fingerprint mismatch" in out  # ...but says so
+    assert os.path.abspath("kernel.opt") in out  # both paths named
+    assert os.path.join(os.path.abspath("ckpt"), "manifest.json") in out
+    nn_log.set_verbosity(0)
+    # a PLAIN (non-checkpointed) retrain refreshes the tracked
+    # fingerprint -- the guard must not cry wolf about fresher weights
+    rc, _ = _train([conf], capsys)
+    assert rc == 0
+    nn_log.set_verbosity(0)
+    assert cli.run_nn_main(["-v", cont]) == 0
+    assert "fingerprint mismatch" not in capsys.readouterr().out
+    nn_log.set_verbosity(0)
+
+
+# --- serve hot reload ------------------------------------------------------
+
+def _serve_conf(tmp_path, kernel_path, name="hot"):
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(
+        f"[name] {name}\n[type] ANN\n[init] {kernel_path}\n[seed] 1\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        f"[train] BP\n[sample_dir] {tmp_path}\n[test_dir] {tmp_path}\n")
+    return str(conf)
+
+
+def test_hot_reload_swaps_without_recompile(tmp_path):
+    from hpnn_tpu.serve.server import ServeApp
+
+    k1, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    k2, _ = generate_kernel(22, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(k1, kpath)
+    app = ServeApp(max_batch=8)
+    model = app.add_model(_serve_conf(tmp_path, kpath), warmup=True)
+    assert model is not None and model.generation == 1
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    out1 = app.infer("hot", x)
+    misses = app.registry.cache_stats()["misses"]
+
+    dump_kernel_to_path(k2, kpath)  # retrain happened, same topology
+    result = app.reload_model("hot")
+    assert result["generation"] == 2
+    assert result["topology_changed"] is False
+    out2 = app.infer("hot", x)
+    assert not np.array_equal(out1, out2)  # new weights serve
+    # the bit-parity contract holds across the swap: serve == run path
+    from hpnn_tpu import ops
+    run_batch_fn, _ = ops.select_run_batch(model.dtype)
+    import jax.numpy as jnp
+    k2_disk = load_kernel(kpath)  # what the server actually reloaded:
+    # the text format quantizes at %17.15f, so parity is against the
+    # file's weights, exactly like run_nn would load them
+    expect = np.asarray(run_batch_fn(
+        tuple(jnp.asarray(w) for w in k2_disk.weights), jnp.asarray(x),
+        model.kind), dtype=np.float64)
+    np.testing.assert_array_equal(out2, expect)
+    # compiled buckets were REUSED: zero new cache misses
+    assert app.registry.cache_stats()["misses"] == misses
+    # metrics surface the swap
+    snap = app.metrics.snapshot()
+    assert snap["models"]["hot"]["generation"] == 2
+    assert snap["reloads"] == {"ok": 1, "error": 0}
+    prom = app.metrics.render_prometheus()
+    assert 'hpnn_serve_model_generation{kernel="hot"} 2' in prom
+    assert "hpnn_serve_model_last_reload_timestamp_seconds" in prom
+    app.close()
+
+
+def test_hot_reload_under_traffic_drops_nothing(tmp_path):
+    import threading
+
+    from hpnn_tpu.serve.server import ServeApp
+
+    k1, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    k2, _ = generate_kernel(22, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(k1, kpath)
+    app = ServeApp(max_batch=8)
+    app.add_model(_serve_conf(tmp_path, kpath), warmup=True)
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    stop = threading.Event()
+    errors: list = []
+    done = [0]
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                app.infer("hot", x)
+                done[0] += 1
+            except Exception as exc:  # pragma: no cover
+                errors.append(exc)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(0.1)
+        dump_kernel_to_path(k2, kpath)
+        for _ in range(3):  # repeated swaps under fire
+            app.reload_model("hot")
+        time.sleep(0.1)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert not errors
+    assert done[0] > 0
+    assert app.metrics.snapshot()["models"]["hot"]["generation"] == 4
+    app.close()
+
+
+def test_reload_failure_keeps_serving_old_weights(tmp_path, capsys):
+    from hpnn_tpu.serve.server import ServeApp
+
+    k1, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(k1, kpath)
+    app = ServeApp(max_batch=8)
+    app.add_model(_serve_conf(tmp_path, kpath), warmup=False)
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    out1 = app.infer("hot", x)
+    with pytest.raises(ValueError):
+        app.reload_model("hot", str(tmp_path / "missing.opt"))
+    with pytest.raises(KeyError):
+        app.reload_model("nope")
+    np.testing.assert_array_equal(app.infer("hot", x), out1)
+    assert app.metrics.snapshot()["reloads"]["error"] == 2
+    assert app.metrics.snapshot()["models"]["hot"]["generation"] == 1
+    app.close()
+
+
+def test_topology_change_reload_purges_and_reshapes(tmp_path):
+    from hpnn_tpu.serve.server import ServeApp
+
+    k1, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    k2, _ = generate_kernel(22, N_IN, [N_HID + 2], N_OUT)
+    kpath = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(k1, kpath)
+    app = ServeApp(max_batch=4)
+    model = app.add_model(_serve_conf(tmp_path, kpath), warmup=True)
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    app.infer("hot", x)
+    dump_kernel_to_path(k2, kpath)
+    result = app.reload_model("hot")
+    assert result["topology_changed"] is True
+    assert model.topology == (N_IN, N_HID + 2, N_OUT)
+    # stale-topology entries purged; new shape compiles and serves
+    assert all(key[1] == model.topology
+               for key in app.registry._cache if key[0] == "hot")
+    out = app.infer("hot", x)
+    assert out.shape == (1, N_OUT)
+    app.close()
+
+
+def test_manifest_watcher_reloads_on_generation_bump(tmp_path):
+    from hpnn_tpu.serve.server import ServeApp
+
+    k1, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    k2, _ = generate_kernel(22, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(k1, kpath)
+    ck = str(tmp_path / "ck")
+    app = ServeApp(max_batch=8)
+    app.add_model(_serve_conf(tmp_path, kpath), warmup=False)
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    out1 = app.infer("hot", x)
+    app.watch_manifest("hot", ck, interval_s=0.05)
+    # a training run publishes a snapshot bundle -> generation bump
+    entry = ckpt.write_snapshot(ck, 1, weights=k2.weights, momentum=None,
+                                rng_state=None, seed=1, errors=[0.1])
+    ckpt.publish_snapshot(ck, entry, seed=1, errors=[0.1])
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if app.registry.get("hot").generation >= 2:
+            break
+        time.sleep(0.02)
+    assert app.registry.get("hot").generation >= 2
+    out2 = app.infer("hot", x)
+    assert not np.array_equal(out1, out2)
+    app.close()  # stops the watcher loop
+
+
+def test_manifest_watcher_loads_preexisting_checkpoint(tmp_path):
+    """A manifest that already exists when the watch starts (training
+    finished before the server came up) is loaded on the first poll --
+    the server must not keep serving the conf's older kernel."""
+    from hpnn_tpu.serve.server import ServeApp
+
+    k1, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    k2, _ = generate_kernel(22, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "kernel.opt")
+    dump_kernel_to_path(k1, kpath)
+    ck = str(tmp_path / "ck")
+    entry = ckpt.write_snapshot(ck, 5, weights=k2.weights, momentum=None,
+                                rng_state=None, seed=1, errors=[0.1])
+    ckpt.publish_snapshot(ck, entry, seed=1, errors=[0.1])  # BEFORE serve
+    app = ServeApp(max_batch=8)
+    app.add_model(_serve_conf(tmp_path, kpath), warmup=False)
+    app.watch_manifest("hot", ck, interval_s=0.05)
+    deadline = time.time() + 5.0
+    while time.time() < deadline:
+        if app.registry.get("hot").generation >= 2:
+            break
+        time.sleep(0.02)
+    assert app.registry.get("hot").generation >= 2
+    app.close()
+
+
+def test_dump_kernel_non_latin1_name_does_not_crash(tmp_path):
+    """A kernel name above U+00FF (reachable via a utf-8 conf) must not
+    blow up the latin-1 dump; it falls back to utf-8 bytes and
+    round-trips stably through the latin-1 reader."""
+    k, _ = generate_kernel(1, 2, [2], 2)
+    k.name = "模型✓"
+    path = str(tmp_path / "k.opt")
+    dump_kernel_to_path(k, path)  # the old latin-1-only encode raised
+    k2 = load_kernel(path)  # loads; the C-exact SKIP_BLANK treats the
+    assert k2 is not None   # high bytes as blanks, so the name mangles
+    assert [int(p) for p in k2.params] == [2, 2, 2]
+    np.testing.assert_allclose(k2.weights[0], k.weights[0], atol=1e-15)
+    # and from the first reload on, the round trip is a fixed point
+    dump_kernel_to_path(k2, str(tmp_path / "k2.opt"))
+    k3 = load_kernel(str(tmp_path / "k2.opt"))
+    dump_kernel_to_path(k3, str(tmp_path / "k3.opt"))
+    assert open(str(tmp_path / "k2.opt"), "rb").read() == \
+        open(str(tmp_path / "k3.opt"), "rb").read()
+
+
+# --- subprocess e2e: real process death ------------------------------------
+
+@pytest.mark.slow
+def test_process_death_resume_e2e(tmp_path):
+    """The full contract with REAL process death: a SIGTERM'd train_nn
+    process (via the deterministic epoch hook) resumes in a fresh
+    process to the identical kernel.opt."""
+    rng = np.random.default_rng(7)
+    _write_corpus(str(tmp_path / "samples"), rng, N_SAMP)
+    _write_corpus(str(tmp_path / "tests"), rng, N_SAMP)
+    conf = _conf(tmp_path, train="BPM")
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH="/root/repo" + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+
+    def run(cwd, args, **extra):
+        e = dict(env)
+        e.update(extra)
+        return subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(__file__), "..", "apps",
+                          "train_nn.py"), "-vv", *args],
+            cwd=cwd, env=e, capture_output=True, text=True, timeout=300)
+
+    full = tmp_path / "full"
+    part = tmp_path / "part"
+    full.mkdir()
+    part.mkdir()
+    r = run(full, ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf])
+    assert r.returncode == 0, r.stderr
+    r = run(part, ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf],
+            HPNN_CKPT_KILL_AT_EPOCH="1")
+    assert r.returncode == 0, r.stderr
+    assert "CKPT: interrupted at epoch 1/3" in r.stdout
+    r2 = run(part, ["--epochs=3", "--resume", "--ckpt-dir=ck", conf])
+    assert r2.returncode == 0, r2.stderr
+    assert (part / "kernel.opt").read_bytes() == \
+        (full / "kernel.opt").read_bytes()
+    assert "NN: EPOCH        2/       3\n" in r2.stdout

@@ -228,3 +228,54 @@ def test_load_kernel_superscript_digit_not_fatal(tmp_path, capsys):
     k = load_kernel(str(p))  # '2<B2>' parses as 2: load succeeds
     assert k is not None
     np.testing.assert_allclose(k.weights[0], [[0.1, 0.2], [0.3, 0.4]])
+
+
+def test_dump_load_dump_byte_identity_fuzz(tmp_path):
+    """Property-style round-trip pin (checkpoint satellite): for any
+    kernel, dump -> load -> dump reproduces the FIRST dump byte-for-byte
+    -- the %17.15f text is a fixed point of the parse, across
+    topologies, value scales, and dtype-derived weight grids (f32/bf16
+    casts, the values a [dtype] training run materializes).  Seeds
+    pinned, so failures are reproducible."""
+    from hpnn_tpu.io.kernel_io import dumps_kernel
+
+    try:
+        import ml_dtypes
+
+        bf16 = np.dtype(ml_dtypes.bfloat16)
+    except ImportError:  # pragma: no cover
+        bf16 = np.float32
+    topologies = [(1, [1], 1), (4, [3], 2), (8, [6, 5], 3),
+                  (2, [31], 7), (16, [1, 1, 1], 2)]
+    casts = [None, np.float32, bf16]
+    rng = np.random.default_rng(20260803)
+    scales = [1.0, 1e-9, 1e6, np.pi]
+    case = 0
+    for n_in, hiddens, n_out in topologies:
+        for cast in casts:
+            scale = scales[case % len(scales)]
+            case += 1
+            dims = [n_in, *hiddens, n_out]
+            weights = []
+            for m, n in zip(dims[:-1], dims[1:]):
+                w = (rng.standard_normal((n, m)) * scale)
+                if cast is not None:
+                    w = w.astype(cast).astype(np.float64)
+                weights.append(w)
+            # sprinkle exact edge values the formatter must keep stable
+            weights[0].flat[0] = 0.0
+            weights[0].flat[-1] = -0.0
+            weights[-1].flat[0] = 1.0
+            k = Kernel(name="fuzz", weights=weights)
+            text1 = dumps_kernel(k)
+            p = tmp_path / f"k_{case}.opt"
+            p.write_text(text1, encoding="latin-1")
+            k2 = load_kernel(str(p))
+            assert k2 is not None, (n_in, hiddens, n_out, cast)
+            text2 = dumps_kernel(k2)
+            assert text2 == text1, (n_in, hiddens, n_out, cast, scale)
+            # and a SECOND round trip stays at the fixed point
+            p.write_text(text2, encoding="latin-1")
+            k3 = load_kernel(str(p))
+            for a, b in zip(k2.weights, k3.weights):
+                np.testing.assert_array_equal(a, b)

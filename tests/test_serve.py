@@ -804,3 +804,46 @@ def test_keep_alive_connection_survives_error_replies(served):
     assert r2.status == 200
     assert len(json.loads(r2.read())["outputs"]) == 1
     conn.close()
+
+
+def test_http_reload_endpoint_and_model_gauges(served, tmp_path):
+    """POST /v1/kernels/<name>/reload hot-swaps the weights file under
+    the live HTTP server: 200 with a generation bump, /metrics model
+    gauges move, unknown kernel 404s, unreadable file 409s (and keeps
+    serving the old weights)."""
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    base, app, model, kern = served
+    kpath = str(tmp_path / "kernel.opt")  # the conf's [init] file
+    x = np.linspace(-1.0, 1.0, N_IN).reshape(1, N_IN)
+    st, before = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()})
+    assert st == 200
+    k2, _ = generate_kernel(4321, N_IN, [N_HID], N_OUT)
+    dump_kernel_to_path(k2, kpath)
+    st, body = serve_bench.http_json(base + "/v1/kernels/tiny/reload", {})
+    assert st == 200 and body["generation"] == 2
+    assert body["topology_changed"] is False
+    st, after = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()})
+    assert st == 200 and after["outputs"] != before["outputs"]
+    m = serve_bench.fetch_metrics(base)
+    assert m["models"]["tiny"]["generation"] == 2
+    assert m["reloads"] == {"ok": 1, "error": 0}
+    with urllib.request.urlopen(base + "/metrics") as resp:
+        prom = resp.read().decode()
+    assert 'hpnn_serve_model_generation{kernel="tiny"} 2' in prom
+    assert "hpnn_serve_model_last_reload_timestamp_seconds" in prom
+    assert 'hpnn_serve_reloads_total{result="ok"} 1' in prom
+    # error paths: unknown kernel, unreadable weights file
+    st, body = serve_bench.http_json(
+        base + "/v1/kernels/nope/reload", {})
+    assert st == 404 and body["reason"] == "not_found"
+    st, body = serve_bench.http_json(
+        base + "/v1/kernels/tiny/reload",
+        {"kernel": str(tmp_path / "missing.opt")})
+    assert st == 409 and body["reason"] == "reload_failed"
+    st, again = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()})
+    assert st == 200 and again["outputs"] == after["outputs"]
