@@ -19,7 +19,7 @@ FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
 MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_pallas_convergence.py tests/test_cli_e2e.py
 SERVE_TESTS = tests/test_serve.py
-CKPT_TESTS = tests/test_ckpt.py
+CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
@@ -32,8 +32,9 @@ serve-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(SERVE_TESTS) -q
 
 # checkpoint tier: snapshot atomicity/retention units, serve hot reload,
-# and the resume-parity e2e (kill-at-epoch-k + --resume == uninterrupted,
-# byte-for-byte, in-process AND across real process death)
+# the resume-parity e2e (kill-at-epoch-k + --resume == uninterrupted,
+# byte-for-byte, in-process AND across real process death), and the
+# epoch-pipeline parity pins (pipeline on == HPNN_NO_EPOCH_PIPELINE=1)
 ckpt-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(CKPT_TESTS) -q
 
@@ -69,5 +70,12 @@ serve-bench:
 io-bench:
 	env JAX_PLATFORMS=cpu python scripts/io_bench.py --out IO_BENCH.json
 
+# multi-epoch input pipeline: device-resident corpus + permutation-only
+# H2D vs HPNN_NO_EPOCH_PIPELINE=1 restaging, 10k and 60k rows; emits
+# EPOCH_BENCH.json, rc!=0 if the H2D/stall floors miss (the device
+# epoch is stubbed on CPU hosts -- pass --real on chip rounds)
+epoch-bench:
+	python scripts/epoch_bench.py --out EPOCH_BENCH.json
+
 .PHONY: check check-all serve-check ckpt-check ckpt-bench native bench \
-    serve-bench io-bench
+    serve-bench io-bench epoch-bench

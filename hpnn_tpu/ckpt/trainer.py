@@ -77,8 +77,19 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
     libhpnn.c:1218).  The per-epoch banner prints only on multi-epoch
     or resumed runs, so a plain single-epoch ``train_nn`` stays
     byte-identical to the reference stream.
+
+    Epoch pipeline (ISSUE 5): when ``train_kernel`` activates the
+    device-resident pipeline, this loop becomes its join-point driver --
+    per-sample console lines and the stats readback for epoch k are
+    rendered on the io_pool while epoch k+1 runs on device, and the
+    queue drains (in byte order: lines, banners, CKPT messages) only at
+    snapshot boundaries, interruption, or run end -- exactly where the
+    float64 host weights are needed anyway.  The drained epoch
+    summaries feed the manager's error trajectory in epoch order, so
+    the manifest is indistinguishable from the unpipelined run.
     """
-    from ..api import train_kernel
+    from ..api import (pipeline_active, pipeline_defer_out, pipeline_join,
+                       train_kernel)
 
     conf = nn.conf
     if rng_state is not None:
@@ -94,23 +105,54 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
     prev_handlers = _install_handlers(stop)
     interrupted = False
     last_epoch = start_epoch
+    # epochs whose (deferred) summaries have not reached the manager yet
+    pending: list[int] = []
+
+    def drain() -> None:
+        """Join the pipeline's deferred epochs in order: console bytes
+        replay, host weights sync, manager trajectory/saves catch up."""
+        sums = pipeline_join(nn)
+        for ep, summary in zip(pending, sums):
+            if manager is not None:
+                manager.epoch_done(nn, ep,
+                                   summary.get("mean_final")
+                                   if summary else None)
+        del pending[:]
+
+    nn._pipeline_defer = True  # train_kernel leaves joins to this loop
     try:
         for epoch in range(start_epoch + 1, epochs + 1):
             last_epoch = epoch
             if banner:
-                nn_out(f"EPOCH {epoch:8d}/{epochs:8d}\n")
+                text = f"EPOCH {epoch:8d}/{epochs:8d}\n"
+                if not pipeline_defer_out(nn, text):
+                    nn_out(text)
             if not train_kernel(nn):
+                drain()
                 return False, False
-            stats = getattr(nn, "last_epoch_stats", None)
-            mean_err = stats.get("mean_final") if stats else None
-            if manager is not None:
-                manager.epoch_done(nn, epoch, mean_err)
+            if pipeline_active(nn):
+                pending.append(epoch)
+                # join only where the unpipelined loop would need the
+                # host state: a due snapshot, the final epoch, a latched
+                # signal, or the deterministic kill hook about to fire
+                due = (manager is not None and manager.every
+                       and epoch % manager.every == 0)
+                if (due or epoch == epochs or stop.is_set()
+                        or (kill_at and epoch == kill_at)):
+                    drain()
+            else:
+                stats = getattr(nn, "last_epoch_stats", None)
+                mean_err = stats.get("mean_final") if stats else None
+                if manager is not None:
+                    manager.epoch_done(nn, epoch, mean_err)
             if kill_at and epoch == kill_at and epoch < epochs:
                 # exercise the REAL signal path at a deterministic
                 # boundary (test hook; see module docstring)
                 os.kill(os.getpid(), signal.SIGTERM)
             if stop.is_set() and epoch < epochs:
                 interrupted = True
+                drain()  # a signal may land between the join check and
+                # here: the final snapshot below must see synced weights
                 if manager is not None:
                     # final snapshot, synchronous: the process is about
                     # to exit, nothing may stay queued
@@ -135,6 +177,8 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
             # state
             manager.save(nn, last_epoch)
     finally:
+        drain()  # safety net: no deferred bytes/weights may outlive the run
+        nn._pipeline_defer = False
         _restore_handlers(prev_handlers)
         if manager is not None:
             manager.flush()

@@ -51,12 +51,17 @@ def _help_text(name: str, train: bool) -> str:
         "\t(cold rounds reload compiled programs instead of recompiling).",
         "--corpus-cache DIR \tpacked corpus cache location (default:",
         "\ta dotfile next to each sample dir; HPNN_NO_CORPUS_CACHE=1 off).",
+        "--corpus-cache-max-mb N \tLRU size cap on the --corpus-cache",
+        "\tdir: least-recently-used packs past the cap are evicted (the",
+        "\tin-flight run's pack never is; 0: no cap).",
         "--ckpt-dir DIR \tcheckpoint directory (default ./ckpt).",
     ]
     if train:
         lines += [
             "--epochs N \ttrain N epochs in-process (default 1); the",
-            "\tseeded shuffle stream continues across epochs.",
+            "\tseeded shuffle stream continues across epochs, and the",
+            "\tcorpus + weights stay device-resident between them",
+            "\t(HPNN_NO_EPOCH_PIPELINE=1 restages per epoch instead).",
             "--ckpt-every N \tsnapshot every N epoch boundaries (atomic,",
             "\twritten off the critical path; 0: only on exit/signal).",
             "--ckpt-keep N \tretention: keep last N snapshots + the",
@@ -80,11 +85,14 @@ def _help_text(name: str, train: bool) -> str:
 _LONG_OPTS = {"--compile-cache": "compile_cache",
               "--corpus-cache": "corpus_cache",
               "--ckpt-dir": "ckpt_dir"}
-# integer-valued long options, train_nn only (value validated like the
-# reference's numeric switches); min value enforced at parse time
+# integer-valued long options (value validated like the reference's
+# numeric switches); min value enforced at parse time.  Most are
+# train_nn-only; _SHARED_INT_OPTS also parse for run_nn.
 _LONG_INT_OPTS = {"--epochs": ("epochs", 1),
                   "--ckpt-every": ("ckpt_every", 0),
-                  "--ckpt-keep": ("ckpt_keep", 0)}
+                  "--ckpt-keep": ("ckpt_keep", 0),
+                  "--corpus-cache-max-mb": ("corpus_cache_max_mb", 0)}
+_SHARED_INT_OPTS = frozenset(("--corpus-cache-max-mb",))
 
 
 def _parse_args(argv: list[str], name: str, train: bool):
@@ -135,7 +143,7 @@ def _parse_args(argv: list[str], name: str, train: bool):
                     extras["resume"] = True
             i += 1
             continue
-        if key in _LONG_INT_OPTS and train:
+        if key in _LONG_INT_OPTS and (train or key in _SHARED_INT_OPTS):
             dest, floor = _LONG_INT_OPTS[key]
             if not eq:
                 i += 1
@@ -224,6 +232,10 @@ def _apply_extras(extras: dict) -> None:
         from .io import corpus
 
         corpus.set_cache_dir(extras["corpus_cache"])
+    if extras.get("corpus_cache_max_mb") is not None:
+        from .io import corpus
+
+        corpus.set_cache_max_mb(extras["corpus_cache_max_mb"])
 
 
 def _dump_kernel_atomic(neural, path: str) -> None:

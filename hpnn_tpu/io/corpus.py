@@ -38,14 +38,25 @@ byte level: pack-cache replay reconstructs the exact diagnostic strings
 codes, and a file whose diagnostics do not match a replayable pattern
 simply makes the dir unpackable (correctness first, cache second).
 
+Built on top of the pack, the **device-resident epoch pipeline** (ISSUE
+5): :func:`load_resident` hands the multi-epoch training driver ONE
+listing-order copy of the corpus (rows + per-file status codes), so
+every later epoch is a host-computed permutation + an on-device gather
+instead of a re-walk/re-stage -- see ``api._EpochPipeline``.  The
+per-epoch console bytes (headers in shuffle order, skip diagnostics)
+are reconstructed from the status codes by :meth:`ResidentCorpus.
+epoch_events`, the same replay rule the warm pack path uses.
+
 Env knobs: ``HPNN_IO_THREADS`` (pool width; default min(32, cpus)),
 ``HPNN_NO_PARALLEL_IO=1`` (serial reads), ``HPNN_NO_CORPUS_CACHE=1``
 (no pack read/write/prefetch), ``HPNN_CORPUS_CACHE=DIR`` (pack
-location), plus samples.py's ``HPNN_NO_NATIVE_IO``/``HPNN_IO_LIB``.
+location), ``HPNN_CORPUS_CACHE_MAX_MB`` (LRU cap on the shared cache
+dir), plus samples.py's ``HPNN_NO_NATIVE_IO``/``HPNN_IO_LIB``.
 """
 
 from __future__ import annotations
 
+import contextlib
 import glob
 import hashlib
 import json
@@ -74,6 +85,26 @@ _ST_DIM = -4       # driver-level "dimension mismatch, skipped!"
 _LOADED = "loaded"
 
 _cache_dir_override: str | None = None
+_cache_max_mb_override: int | None = None
+# packs in active use by THIS process (loaded or built for a live run):
+# the corpus-cache GC never evicts them, whatever their LRU age.  The
+# registry is insertion-ordered and BOUNDED: a long-lived process (a
+# server warm-loading many corpora over months) must not accumulate an
+# exemption for every pack it ever touched, or the LRU cap silently
+# stops evicting -- protection is per-run best-effort, and losing it for
+# an ancient pack merely costs that pack a rebuild on its next load.
+_ACTIVE_PACKS_MAX = 16
+_active_packs: dict[str, None] = {}
+
+
+def _note_active(path: str) -> None:
+    ap = os.path.abspath(path)
+    _active_packs.pop(ap, None)          # re-insertion refreshes the age
+    _active_packs[ap] = None
+    while len(_active_packs) > _ACTIVE_PACKS_MAX:
+        _active_packs.pop(next(iter(_active_packs)))
+
+
 _pool = None
 _pool_lock = threading.Lock()
 
@@ -93,6 +124,111 @@ def set_cache_dir(path: str | None) -> None:
 
 def _cache_dir() -> str | None:
     return _cache_dir_override or os.environ.get("HPNN_CORPUS_CACHE") or None
+
+
+def set_cache_max_mb(mb: int | None) -> None:
+    """LRU size cap for the shared corpus-cache dir (the CLI's
+    ``--corpus-cache-max-mb``); wins over HPNN_CORPUS_CACHE_MAX_MB.
+    0/None disables the cap."""
+    global _cache_max_mb_override
+    _cache_max_mb_override = None if mb is None else int(mb)
+
+
+def _cache_max_bytes() -> int:
+    if _cache_max_mb_override is not None:
+        return _cache_max_mb_override << 20
+    env = os.environ.get("HPNN_CORPUS_CACHE_MAX_MB")
+    try:
+        return (int(env) << 20) if env else 0
+    except ValueError:
+        return 0
+
+
+def gc_cache(protect: tuple[str, ...] = ()) -> list[str]:
+    """Evict least-recently-used packs from the shared cache dir until it
+    fits under the configured cap (0 = no cap = no-op).  LRU age is the
+    pack mtime -- warm loads bump it (:func:`_try_load_pack`), so a pack
+    in steady use never goes stale.  Packs named in ``protect`` or
+    registered by this process's live runs (``_active_packs``) are never
+    evicted; sibling dotfile packs (no shared cache dir) are out of
+    scope, there is no one place to enumerate them.  Returns the evicted
+    paths (for the dbg line and the tests)."""
+    cap = _cache_max_bytes()
+    cdir = _cache_dir()
+    if not cap or not cdir or not os.path.isdir(cdir):
+        return []
+    entries = []
+    for p in glob.glob(os.path.join(cdir, "corpus-*.pack")):
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        entries.append((st.st_mtime_ns, st.st_size, os.path.abspath(p)))
+    total = sum(e[1] for e in entries)
+    keep = set(os.path.abspath(p) for p in protect) | set(_active_packs)
+    evicted = []
+    for mtime, size, path in sorted(entries):
+        if total <= cap:
+            break
+        if path in keep:
+            continue
+        try:
+            os.unlink(path)
+        except OSError:
+            continue
+        # the pack's flock sibling goes with it (benign if another
+        # process holds it right now: the worst case is one duplicate
+        # build, and a leaked lock would otherwise outlive its pack
+        # forever in a capped cache dir)
+        try:
+            os.unlink(path + ".lock")
+        except OSError:
+            pass
+        total -= size
+        evicted.append(path)
+    if evicted:
+        nn_dbg(f"corpus cache: evicted {len(evicted)} LRU pack(s) "
+               f"over the {cap >> 20} MB cap\n")
+    return evicted
+
+
+@contextlib.contextmanager
+def _pack_build_lock(dirpath: str):
+    """flock-guarded critical section for building ``dirpath``'s pack:
+    two processes cold-loading the same corpus dir serialize here, and
+    the waiter re-probes the winner's pack (fingerprint-checked) instead
+    of re-reading every file.  Yields True when the lock is held; any
+    OS-level failure degrades to the old unlocked behavior (a duplicate
+    build is wasteful, never wrong -- pack writes are atomic replaces).
+    The lock file rides next to the pack; a crashed holder's lock is
+    released by the kernel with its fd."""
+    path = pack_path(dirpath) + ".lock"
+    fd = None
+    try:
+        import fcntl
+
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+        fcntl.flock(fd, fcntl.LOCK_EX)
+    except Exception:
+        if fd is not None:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        yield False
+        return
+    try:
+        yield True
+    finally:
+        try:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        except OSError:
+            pass
+        try:
+            os.close(fd)
+        except OSError:
+            pass
 
 
 def io_threads() -> int:
@@ -228,6 +364,15 @@ def _try_load_pack(dirpath: str, names: list[str], n_in: int, n_out: int,
         return None
     if probe_only:
         return True
+    # LRU bookkeeping for the cache GC: a served pack is a recently-used
+    # pack (content is fingerprinted by the header, not the mtime, so
+    # the bump cannot stale-serve anything); registration protects the
+    # in-flight run's pack from eviction
+    try:
+        os.utime(path)
+    except OSError:
+        pass
+    _note_active(path)
     if n_rows == 0:
         return status, None, None
     X = np.memmap(path, dtype=np.float64, mode="r", offset=data_off,
@@ -238,13 +383,19 @@ def _try_load_pack(dirpath: str, names: list[str], n_in: int, n_out: int,
     return status, X, T
 
 
-def _assemble_pack(dirpath, names, order, header, status, X, T):
-    """Replay a pack in shuffle order: identical events, rows and
-    diagnostic bytes to what the per-file path produces."""
+def _order_events(dirpath, names, order, header, status,
+                  lines: list[str] | None = None):
+    """Shuffle-order replay of per-file status codes: the header events
+    and skip diagnostics, byte-identical to what the per-file read path
+    emits.  Returns (events, sel) where sel holds the PACKED row index
+    of each loaded file in shuffle order.  ``lines`` optionally supplies
+    pre-formatted header lines (listing order) -- the resident pipeline
+    caches them across epochs."""
     rows, events = [], []
     for idx in order:
         name = names[idx]
-        line = f"{header} FILE: {name[:16]:>16}\t"
+        line = (lines[idx] if lines is not None
+                else f"{header} FILE: {name[:16]:>16}\t")
         st = status[idx]
         if st >= 0:
             events.append((line, len(rows)))
@@ -259,9 +410,15 @@ def _assemble_pack(dirpath, names, order, header, status, X, T):
         elif st == _ST_DIM:
             nn_error(f"sample {name} dimension mismatch, skipped!\n")
         events.append((line, None))
-    if not rows:
+    return events, np.asarray(rows, dtype=np.int32)
+
+
+def _assemble_pack(dirpath, names, order, header, status, X, T):
+    """Replay a pack in shuffle order: identical events, rows and
+    diagnostic bytes to what the per-file path produces."""
+    events, sel = _order_events(dirpath, names, order, header, status)
+    if sel.size == 0:
         return events, None, None
-    sel = np.asarray(rows, dtype=np.int64)
     # fancy indexing a memmap copies just the selected pages into fresh
     # host arrays -- the "stream pack slices" handoff point
     return events, np.asarray(X[sel]), np.asarray(T[sel])
@@ -404,6 +561,8 @@ def _save_pack(dirpath, names, n_in, n_out, results, stats) -> bool:
         except OSError:
             pass
         return False
+    _note_active(path)
+    gc_cache(protect=(path,))
     return True
 
 
@@ -430,13 +589,28 @@ def load_ordered(dirpath: str, names: list[str], order: list[int],
             mode = "pack"
     if mode is None:
         packing = cache_enabled() and n_in > 0 and n_out > 0
-        # fingerprint BEFORE the reads (see _save_pack's stale-write note)
-        stats = _stat_listing(dirpath, names) if packing else None
-        results, mode = _read_results(dirpath, names, n_in, n_out)
-        out = _assemble_results(dirpath, names, order, header,
-                                n_in, n_out, results)
-        if packing:
-            _save_pack(dirpath, names, n_in, n_out, results, stats)
+        with _pack_build_lock(dirpath) if packing \
+                else contextlib.nullcontext(False) as locked:
+            if locked:
+                # a concurrent builder may have won the lock first:
+                # re-probe, and mmap ITS pack instead of re-reading the
+                # whole dir (fingerprint still checked against the
+                # current dir state)
+                got = _try_load_pack(dirpath, names, n_in, n_out)
+                if got is not None:
+                    status, X, T = got
+                    out = _assemble_pack(dirpath, names, order, header,
+                                         status, X, T)
+                    mode = "pack"
+            if mode is None:
+                # fingerprint BEFORE the reads (see _save_pack's
+                # stale-write note)
+                stats = _stat_listing(dirpath, names) if packing else None
+                results, mode = _read_results(dirpath, names, n_in, n_out)
+                out = _assemble_results(dirpath, names, order, header,
+                                        n_in, n_out, results)
+                if packing:
+                    _save_pack(dirpath, names, n_in, n_out, results, stats)
     events, X, T = out
     # load-stats line (dbg level: the -vv console stream is a byte-parity
     # surface across ingestion modes, so the mode name cannot print there)
@@ -445,6 +619,111 @@ def load_ordered(dirpath: str, names: list[str], order: list[int],
            f"{time.perf_counter() - t0:.3f}s ({mode}; "
            f"native_io: {samples.native_io_status()})\n")
     return events, X, T
+
+
+class ResidentCorpus:
+    """One listing-order copy of a corpus, loaded ONCE per run for the
+    device-resident epoch pipeline (``api._EpochPipeline``).
+
+    ``X``/``T`` hold the loaded rows in PACKED (listing) order -- the
+    pack's own layout, shuffle-seed independent -- and ``status`` maps
+    each listing index to its packed row (>= 0) or skip class (< 0).
+    Every epoch's console bytes and device gather indices derive from
+    these via :meth:`epoch_events`, so after the first load no epoch
+    touches the corpus files again."""
+
+    def __init__(self, dirpath: str, names: list[str], status: list[int],
+                 X, T, header: str = "TRAINING"):
+        self.dirpath = dirpath
+        self.names = names
+        self.status = status
+        self.X = X            # (n_rows, n_in) f64, listing order (or None)
+        self.T = T
+        self.header = header
+        self._n_rows = 0 if X is None else int(X.shape[0])
+        # header lines are epoch-invariant: format the 60k strings once
+        self._lines = [f"{header} FILE: {n[:16]:>16}\t" for n in names]
+
+    @property
+    def n_rows(self) -> int:
+        return self._n_rows
+
+    def release_rows(self) -> None:
+        """Drop the host row arrays once a device-resident copy exists
+        (epoch replay needs only names/status/headers); sharded mode
+        keeps them -- it gathers every epoch's shards from here."""
+        self.X = None
+        self.T = None
+
+    def epoch_events(self, order: list[int]):
+        """(events, sel) for one epoch's shuffle order; emits the skip
+        diagnostics (stderr) exactly like the per-file load would."""
+        return _order_events(self.dirpath, self.names, order, self.header,
+                             self.status, lines=self._lines)
+
+
+def _classify_results(dirpath, names, n_in, n_out, results):
+    """(status, X, T) in listing order from fresh read results, or None
+    when any file's diagnostics are non-replayable (the corpus is then
+    not residency-capable -- correctness first)."""
+    status, rows_x, rows_t = [], [], []
+    for idx, name in enumerate(names):
+        vec_in, vec_out, diags = results[idx]
+        st = _classify(dirpath, name, vec_in, vec_out, diags, n_in, n_out)
+        if st is None:
+            return None
+        if st is _LOADED:
+            status.append(len(rows_x))
+            rows_x.append(np.ascontiguousarray(vec_in[:n_in], np.float64))
+            rows_t.append(np.ascontiguousarray(vec_out[:n_out], np.float64))
+        else:
+            status.append(st)
+    if not rows_x:
+        return status, None, None
+    return status, np.stack(rows_x), np.stack(rows_t)
+
+
+def load_resident(dirpath: str, names: list[str], n_in: int,
+                  n_out: int, header: str = "TRAINING"):
+    """Load a corpus ONCE in listing order for device residency.
+
+    Pack-cache fast path first (mmap, no file walk); a cold load reads
+    every file under the flock build guard, classifies the per-file
+    diagnostics into replayable status codes, and writes the pack for
+    the next run.  Returns a :class:`ResidentCorpus`, or None when the
+    dir has a file with non-replayable diagnostics (the caller falls
+    back to the per-epoch ``load_ordered`` path, which replays captured
+    diagnostics verbatim).  Emits NO console output of its own beyond a
+    dbg summary -- the per-epoch skip diagnostics are reconstructed by
+    ``epoch_events`` each epoch, exactly like a warm pack load.
+    """
+    if n_in <= 0 or n_out <= 0:
+        return None
+    t0 = time.perf_counter()
+    got = None
+    if cache_enabled():
+        got = _try_load_pack(dirpath, names, n_in, n_out)
+    if got is None:
+        with _pack_build_lock(dirpath) as locked:
+            if locked and cache_enabled():
+                got = _try_load_pack(dirpath, names, n_in, n_out)
+            if got is None:
+                stats = _stat_listing(dirpath, names)
+                results, _mode = _read_results(dirpath, names, n_in, n_out)
+                classified = _classify_results(dirpath, names, n_in, n_out,
+                                               results)
+                if classified is None:
+                    nn_dbg("resident corpus: non-replayable diagnostics; "
+                           "falling back to per-epoch loads\n")
+                    return None
+                if cache_enabled() and stats is not None:
+                    _save_pack(dirpath, names, n_in, n_out, results, stats)
+                got = classified
+    status, X, T = got
+    rc = ResidentCorpus(dirpath, names, status, X, T, header=header)
+    nn_dbg(f"resident corpus: {len(names)} file(s), {rc.n_rows} row(s) "
+           f"staged once in {time.perf_counter() - t0:.3f}s\n")
+    return rc
 
 
 class LoadHandle:
@@ -503,9 +782,16 @@ def prefetch_pack_async(dirpath: str, n_in: int,
                               probe_only=True):
                 return  # already warm
             with nn_log.capture():  # a prefetch never prints
-                stats = _stat_listing(dirpath, names)
-                results, _ = _read_results(dirpath, names, n_in, n_out)
-                _save_pack(dirpath, names, n_in, n_out, results, stats)
+                with _pack_build_lock(dirpath):
+                    # the build may have raced a foreground loader (or
+                    # another process): once the lock is ours, a valid
+                    # pack means the winner already did the work
+                    if _try_load_pack(dirpath, names, n_in, n_out,
+                                      probe_only=True):
+                        return
+                    stats = _stat_listing(dirpath, names)
+                    results, _ = _read_results(dirpath, names, n_in, n_out)
+                    _save_pack(dirpath, names, n_in, n_out, results, stats)
         except Exception:
             pass  # prefetch is an optimization, never fatal
 

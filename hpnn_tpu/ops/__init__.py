@@ -39,7 +39,7 @@ def _use_pallas(dtype=None) -> bool:
         jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
 
 
-def select_train_epoch(dtype=None):
+def select_train_epoch(dtype=None, donate=False, defer_stats=False):
     """Pick the convergence-epoch implementation for the current backend.
 
     Returns ``(fn, name)`` where fn is call-compatible with
@@ -47,26 +47,47 @@ def select_train_epoch(dtype=None):
     The Pallas VMEM-persistent kernel (convergence_pallas) is the f32/bf16
     throughput path on TPU -- the production analog of the reference's
     fused CUDA hot loop (``/root/reference/src/cuda_ann.cu:77-148``).
-    """
-    from .convergence import _chunk_override, chunked_epoch
 
+    ``donate=True`` (the epoch pipeline's device-resident weight carry)
+    hands out the input-donating variants on accelerator backends -- the
+    caller promises its weight arrays are dead after the call, so XLA
+    aliases them to the outputs instead of reallocating; on CPU (where
+    donation is a warning no-op) the plain variants come back.
+    ``defer_stats=True`` asks for lazily-readable stats (device slices,
+    no built-in host sync) where the implementation would otherwise pull
+    them -- bit-identical values either way.
+    """
+    import functools
+
+    import jax
+
+    from .convergence import (_chunk_override, chunked_epoch,
+                              train_epoch_donated)
+
+    on_tpu = jax.default_backend() == "tpu"
     if _use_pallas(dtype):
         from .convergence_pallas import (train_epoch_pallas,
                                          train_epoch_pallas_watchdog)
 
         if _chunk_override() is not None:
             # expert fixed-size chunking (HPNN_EPOCH_CHUNK)
-            return chunked_epoch(train_epoch_pallas), "pallas"
+            fn = (functools.partial(train_epoch_pallas, donate=True)
+                  if donate else train_epoch_pallas)
+            return chunked_epoch(fn), "pallas"
         # the default: iteration-budgeted launches resumed in ONE
         # compiled program per epoch shape -- device time per launch is
         # bounded by construction, not by host-side sizing
+        if donate or defer_stats:
+            return functools.partial(train_epoch_pallas_watchdog,
+                                     donate=donate,
+                                     defer_stats=defer_stats), "pallas"
         return train_epoch_pallas_watchdog, "pallas"
-    import jax
-
-    if jax.default_backend() == "tpu":
+    donated_ok = donate and jax.default_backend() != "cpu"
+    base = train_epoch_donated if donated_ok else train_epoch
+    if on_tpu:
         # the XLA scan path hits the same ~60 s launch watchdog at scale
-        return chunked_epoch(train_epoch), "xla"
-    return train_epoch, "xla"
+        return chunked_epoch(base), "xla"
+    return base, "xla"
 
 
 def select_run_batch(dtype=None, parity="strict"):

@@ -138,9 +138,8 @@ def train_sample(weights, x, t, kind: str, momentum: bool,
     return w, SampleStats(init_err, first_ok, n_iter, dep, success)
 
 
-@functools.partial(jax.jit, static_argnames=("kind", "momentum"))
-def train_epoch(weights, xs, ts, kind: str, momentum: bool,
-                alpha=0.2, delta=-1.0):
+def _train_epoch(weights, xs, ts, kind: str, momentum: bool,
+                 alpha=0.2, delta=-1.0):
     """One full epoch: scan `train_sample` over pre-shuffled sample arrays.
 
     xs (S, n_in), ts (S, n_out).  Replaces the reference's per-file loop
@@ -157,6 +156,18 @@ def train_epoch(weights, xs, ts, kind: str, momentum: bool,
         return w, stats
 
     return lax.scan(step, weights, (xs, ts))
+
+
+train_epoch = jax.jit(_train_epoch, static_argnames=("kind", "momentum"))
+# The donated sibling: the epoch-pipeline driver carries weights on
+# device from epoch to epoch (and launch to launch), so the input weight
+# buffers are dead the moment the epoch is dispatched -- donation lets
+# XLA reuse their memory for the outputs instead of holding both copies
+# live.  Accelerator-only hand-out (ops.select_train_epoch): on CPU
+# donation is a no-op that warns.  Bit-identical results either way.
+train_epoch_donated = jax.jit(_train_epoch,
+                              static_argnames=("kind", "momentum"),
+                              donate_argnums=(0,))
 
 
 @functools.partial(jax.jit, static_argnames=("kind",))

@@ -326,13 +326,9 @@ def _train_one(x, t, dtype, npl, col, out_mask, w_out, dw, stats_ref,
     stats_ref[0] = srow
 
 
-@functools.partial(
-    jax.jit,
-    static_argnames=("kind", "momentum", "alpha", "delta", "lr", "interpret",
-                     "precision", "budgeted"))
-def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
-                      alpha, delta, lr, interpret, precision,
-                      budgeted=False, ctrl=None, stats_prev=None):
+def _train_epoch_core_impl(weights, xs, ts, kind: str, momentum: bool,
+                           alpha, delta, lr, interpret, precision,
+                           budgeted=False, ctrl=None, stats_prev=None):
     """Jitted core: returns the final weight arrays + raw stats rows.
 
     ``precision`` is a required static argument here -- the env-var
@@ -452,9 +448,31 @@ def _train_epoch_core(weights, xs, ts, kind: str, momentum: bool,
     return tuple(out[:n_layers]), out[n_layers][:, 0, :]
 
 
+_CORE_STATIC = ("kind", "momentum", "alpha", "delta", "lr", "interpret",
+                "precision", "budgeted")
+_train_epoch_core = jax.jit(_train_epoch_core_impl,
+                            static_argnames=_CORE_STATIC)
+# Donated launch carry (epoch pipeline): across resumed budgeted
+# launches AND across epochs, the incoming weights / momentum scratch /
+# stats record are dead once the launch is dispatched -- donation lets
+# XLA alias them to the outputs, so no weight buffer is reallocated or
+# copied between launches.  TPU-only hand-out (donation warns and
+# no-ops on CPU); results are bit-identical to the undonated core.
+_train_epoch_core_donated = jax.jit(_train_epoch_core_impl,
+                                    static_argnames=_CORE_STATIC,
+                                    donate_argnames=("weights",
+                                                     "stats_prev"))
+
+
+def _core(donate: bool):
+    return (_train_epoch_core_donated
+            if donate and jax.default_backend() == "tpu"
+            else _train_epoch_core)
+
+
 def train_epoch_pallas(weights, xs, ts, kind: str, momentum: bool,
                        alpha=0.2, delta=-1.0, lr=None, interpret=False,
-                       precision=None):
+                       precision=None, donate=False):
     """Drop-in for ``ops.train_epoch`` on the f32/bf16 throughput path.
 
     weights: tuple of (N_l, M_l); xs (S, n_in); ts (S, n_out).
@@ -465,7 +483,7 @@ def train_epoch_pallas(weights, xs, ts, kind: str, momentum: bool,
     """
     if precision is None:
         precision = _precision()
-    new_w, st = _train_epoch_core(
+    new_w, st = _core(donate)(
         weights, xs, ts, kind, momentum, alpha=alpha, delta=delta, lr=lr,
         interpret=interpret, precision=precision)
     stats = SampleStats(
@@ -501,9 +519,18 @@ def use_budgeted(shapes) -> bool:
 
 def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
                                 alpha=0.2, delta=-1.0, lr=None,
-                                interpret=False, precision=None):
+                                interpret=False, precision=None,
+                                donate=False, defer_stats=False):
     """The production TPU epoch: iteration-budgeted launches with host
     resume, exact under the runtime's ~60 s single-program watchdog.
+
+    ``donate=True`` (epoch pipeline) routes through the donated core:
+    the carry (weights, momentum scratch, stats record) is aliased
+    launch-to-launch instead of reallocated -- the caller must treat its
+    input weights as consumed.  ``defer_stats=True`` skips the end-of-
+    epoch host pull and returns SampleStats as lazy device slices, so
+    the D2H readback happens wherever the caller consumes them (the
+    pipeline does it on the io_pool, overlapped with the next epoch).
 
     Each launch carries (start_idx, iter_budget) as scalar-prefetch
     operands into ONE compiled program per epoch shape; the kernel stops
@@ -536,7 +563,8 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
         # closed-over numpy corpora) at zero transfer cost.
         return train_epoch_pallas(weights, xs, ts, kind, momentum,
                                   alpha=alpha, delta=delta, lr=lr,
-                                  interpret=interpret, precision=precision)
+                                  interpret=interpret, precision=precision,
+                                  donate=donate)
     if not use_budgeted([w.shape for w in weights]):
         # tiny topology: the plain kernel via host-side adaptive chunking
         # (see _BUDGET_MIN_PARAMS above)
@@ -544,12 +572,14 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
 
         return chunked_epoch(train_epoch_pallas)(
             weights, xs, ts, kind, momentum, alpha=alpha, delta=delta,
-            lr=lr, interpret=interpret, precision=precision)
+            lr=lr, interpret=interpret, precision=precision,
+            donate=donate)
     # the chunker serves as the persistent conservative RATE tracker
     # (pessimistic start, slowdowns believed, speedups damped 2x); its
     # sample-count sizing is unused here -- the budget is in iterations
     tracker = _get_chunker([w.shape for w in weights], kind, momentum,
                            route="pallas_budget")
+    core = _core(donate)
     start = 0
     w = weights
     st = None    # (S, LANE) record, device-resident across launches
@@ -564,7 +594,7 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
         budget = max(1, int(min(tracker.rate * _WATCHDOG_SAFE_S,
                                 2**31 - 1)) - tracker.worst)
         t0 = time.perf_counter()
-        w, st = _train_epoch_core(
+        w, st = core(
             w, xs, ts, kind, momentum, alpha=alpha, delta=delta, lr=lr,
             interpret=interpret, precision=precision,
             budgeted=True,
@@ -579,6 +609,17 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
         assert new_start > start, "budgeted launch made no progress"
         tracker.observe(new_iters - cum_iters, dt)
         start, cum_iters = new_start, new_iters
+    if defer_stats:
+        # lazy device slices: the caller pulls them where it wants the
+        # D2H to happen (the epoch pipeline: on the io_pool, overlapped
+        # with the next epoch's device work)
+        return w, SampleStats(
+            init_err=st[:, 0],
+            first_ok=st[:, 1] > 0.5,
+            n_iter=st[:, 2].astype(jnp.int32),
+            final_dep=st[:, 3],
+            success=st[:, 4] > 0.5,
+        )
     # one fixed-shape pull for the whole epoch record
     rows = np_.asarray(st[:, :5])
     stats = SampleStats(

@@ -94,7 +94,7 @@ def capture(into: list | None = None):
 def replay(entries) -> None:
     """Emit captured entries through the normal gated functions."""
     fns = {"dbg": nn_dbg, "out": nn_out, "cout": nn_cout,
-           "warn": nn_warn, "error": nn_error}
+           "warn": nn_warn, "error": nn_error, "raw": nn_raw}
     for level, text in entries:
         fns[level](text)
 
@@ -140,3 +140,15 @@ def nn_error(text: str) -> None:
     if _capture("error", text):
         return
     _emit(sys.stderr, "NN(ERR): " + text)
+
+
+def nn_raw(text: str) -> None:
+    """Pre-rendered stdout block: prefixes AND the verbosity gate were
+    already applied when the text was formatted (the vectorized
+    training-line renderer snapshots the verbosity at format time), so
+    emission is a single ungated write.  Byte-identical to emitting the
+    pieces through nn_out/nn_cout/nn_dbg one call at a time."""
+    if _capture("raw", text):
+        return
+    if text:
+        _emit(sys.stdout, text)

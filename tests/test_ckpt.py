@@ -628,6 +628,41 @@ def test_dump_kernel_non_latin1_name_does_not_crash(tmp_path):
         open(str(tmp_path / "k3.opt"), "rb").read()
 
 
+# --- epoch-pipeline interplay (ISSUE 5) ------------------------------------
+
+def test_ckpt_runs_engage_epoch_pipeline(corpus, capsys):
+    """Checkpointed multi-epoch runs train through the device-resident
+    epoch pipeline by default; kernel.opt bytes AND the manifest's error
+    trajectory match the HPNN_NO_EPOCH_PIPELINE=1 escape hatch exactly
+    (the deferred epoch summaries reach the manager in epoch order)."""
+    import hpnn_tpu.api as api
+
+    conf = _conf(corpus)
+    os.makedirs("on")
+    os.chdir("on")
+    api.reset_epoch_metrics()
+    rc, _ = _train(["--epochs=3", "--ckpt-every=2", "--ckpt-dir=ck",
+                    conf], capsys)
+    assert rc == 0
+    assert api.EPOCH_METRICS["mode"] == "resident"  # pipeline engaged
+    k_on = open("kernel.opt", "rb").read()
+    m_on = ckpt.read_manifest("ck")
+    os.chdir("..")
+    os.makedirs("off")
+    os.chdir("off")
+    api.reset_epoch_metrics()
+    rc, _ = _train(["--epochs=3", "--ckpt-every=2", "--ckpt-dir=ck",
+                    conf], capsys, env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert rc == 0
+    assert api.EPOCH_METRICS["mode"] == "restage"   # escape hatch honored
+    k_off = open("kernel.opt", "rb").read()
+    m_off = ckpt.read_manifest("ck")
+    os.chdir("..")
+    assert k_on == k_off
+    assert m_on["errors"] == m_off["errors"]
+    assert m_on["epoch"] == m_off["epoch"] == 3
+
+
 # --- subprocess e2e: real process death ------------------------------------
 
 @pytest.mark.slow

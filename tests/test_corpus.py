@@ -199,7 +199,9 @@ def test_corpus_cache_dir_relocates_pack(tmp_path, capsys):
         a, _ = _load(d, capsys)
         default = os.path.join(str(tmp_path), ".samples.hpnn.pack")
         assert not os.path.exists(default)
-        packs = os.listdir(cdir)
+        # the flock build guard leaves a .lock sibling; the pack itself
+        # must be the only actual payload
+        packs = [p for p in os.listdir(cdir) if not p.endswith(".lock")]
         assert len(packs) == 1 and packs[0].endswith(".pack")
         b, _ = _load(d, capsys)  # warm from the relocated pack
         _assert_same(a, b)

@@ -1,0 +1,405 @@
+"""Device-resident epoch pipeline (ISSUE 5): byte-parity pins + units.
+
+The acceptance contract: multi-epoch ``train_nn`` console streams
+(stdout AND stderr at the -vv grammar level) and ``kernel.opt`` bytes
+are identical with the pipeline on (cold pack, warm pack, forced shard
+mode) vs ``HPNN_NO_EPOCH_PIPELINE=1``, for BP and BPM, and across a
+kill-at-epoch-k ``--resume``.  Plus units for the vectorized line
+renderer, the corpus-cache LRU GC, the flock-guarded pack build, and
+the H2D accounting that scripts/epoch_bench.py reads.
+"""
+
+import os
+import threading
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import hpnn_tpu.api as api
+from hpnn_tpu import cli
+from hpnn_tpu.io import corpus, samples
+from hpnn_tpu.utils import nn_log
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+def _write(path, text):
+    with open(path, "w") as fp:
+        fp.write(text)
+
+
+def _write_corpus(dirpath, rng, n, with_skips=True):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        _write(os.path.join(dirpath, f"s{i:03d}"),
+               f"[input] {N_IN}\n"
+               + " ".join(f"{v:7.5f}" for v in x) + "\n"
+               + f"[output] {N_OUT}\n"
+               + " ".join(f"{v:.1f}" for v in t) + "\n")
+    if with_skips:
+        # one of each replayable skip class rides in the shuffle, so the
+        # per-epoch event/diagnostic reconstruction is actually exercised
+        _write(os.path.join(dirpath, "bad_zero"),
+               "[input] 0\n\n[output] 3\n1 0 0\n")
+        _write(os.path.join(dirpath, "short_dim"),
+               "[input] 2\n1 2\n[output] 3\n1 0 0\n")
+
+
+@pytest.fixture()
+def corpus_dir(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    _write_corpus(str(tmp_path / "samples"), rng, N_SAMP)
+    _write_corpus(str(tmp_path / "tests"), rng, N_SAMP)
+    monkeypatch.chdir(tmp_path)
+    # hermetic vs the one-time native-IO fallback warning (test_corpus
+    # idiom): it must not diverge the compared streams
+    monkeypatch.setattr(samples, "_native_warned", True)
+    yield tmp_path
+    nn_log.set_verbosity(0)
+
+
+def _conf(tmp_path, train="BP", name="nn"):
+    path = tmp_path / f"{name}_{train}.conf"
+    path.write_text(
+        f"[name] tiny\n[type] ANN\n[init] generate\n[seed] 1234\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        f"[train] {train}\n"
+        f"[sample_dir] {tmp_path}/samples\n[test_dir] {tmp_path}/tests\n")
+    return str(path)
+
+
+def _train(args, capsys, env=None):
+    nn_log.set_verbosity(0)
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        rc = cli.train_nn_main(["-vv", *args])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cap = capsys.readouterr()
+    opt = b""
+    if os.path.exists("kernel.opt"):
+        with open("kernel.opt", "rb") as fp:
+            opt = fp.read()
+    return rc, cap.out, cap.err, opt
+
+
+# --- the acceptance pin: stream + kernel.opt parity, all modes -------------
+
+@pytest.mark.parametrize("train", ["BP", "BPM"])
+def test_multi_epoch_byte_parity_on_off_warm_shard(corpus_dir, capsys,
+                                                   train):
+    conf = _conf(corpus_dir, train=train)
+    args = ["--epochs=2", conf]
+    base = _train(args, capsys, env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert base[0] == 0
+    cold = _train(args, capsys)  # builds the pack + resident corpus
+    warm = _train(args, capsys)  # warm pack -> resident corpus
+    shard = _train(args, capsys, env={"HPNN_EPOCH_SHARD_ROWS": "3"})
+    for tag, got in (("cold", cold), ("warm", warm), ("shard", shard)):
+        assert got[0] == 0, tag
+        assert got[1] == base[1], f"stdout diverges ({tag})"
+        assert got[2] == base[2], f"stderr diverges ({tag})"
+        assert got[3] == base[3], f"kernel.opt diverges ({tag})"
+    # the streams actually carried the grammar + skip diagnostics
+    assert base[1].count("TRAINING FILE:") == 2 * (N_SAMP + 2)
+    assert "input read failed" in base[2]
+    assert "dimension mismatch" in base[2]
+
+
+def test_pipeline_engages_and_h2d_shrinks(corpus_dir, capsys):
+    conf = _conf(corpus_dir)
+    api.reset_epoch_metrics()
+    rc, *_ = _train(["--epochs=3", conf], capsys,
+                    env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert rc == 0
+    off = dict(api.EPOCH_METRICS)
+    assert off["mode"] == "restage" and off["epochs"] == 3
+
+    api.reset_epoch_metrics()
+    rc, *_ = _train(["--epochs=3", conf], capsys)
+    assert rc == 0
+    on = dict(api.EPOCH_METRICS)
+    assert on["mode"] == "resident" and on["epochs"] == 3
+    # per-epoch H2D is the int32 permutation vector only
+    assert on["h2d_bytes"] == 3 * 4 * N_SAMP
+    assert on["h2d_bytes"] < off["h2d_bytes"]
+    # the one-time residency upload happened and was accounted separately
+    assert on["setup_h2d_bytes"] > 0
+
+
+def test_kill_resume_cross_mode_parity(corpus_dir, capsys):
+    """Pipeline-on killed-and-resumed == pipeline-off uninterrupted,
+    byte for byte (kernel.opt and the resumed console tail)."""
+    conf = _conf(corpus_dir, train="BPM")
+    os.makedirs("off")
+    os.chdir("off")
+    rc, o_off, _, k_off = _train(
+        ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf], capsys,
+        env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert rc == 0
+    os.chdir("..")
+    os.makedirs("part")
+    os.chdir("part")
+    rc, o_kill, _, _ = _train(
+        ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf], capsys,
+        env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0
+    assert "CKPT: interrupted at epoch 1/3" in o_kill
+    rc, o_res, _, k_res = _train(
+        ["--epochs=3", "--resume", "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    os.chdir("..")
+    assert k_res == k_off
+    mark = "NN: EPOCH        2/       3\n"
+    assert o_res[o_res.index(mark):] == o_off[o_off.index(mark):]
+    # and the killed run's prefix matches the uninterrupted stream
+    pre = o_kill[:o_kill.index("NN: CKPT: interrupted")]
+    assert o_off.startswith(pre)
+
+
+def test_sparse_ckpt_defers_emission_across_epochs(corpus_dir, capsys):
+    """--ckpt-every=2: the pipeline joins only at snapshot boundaries,
+    and the drained stream is still byte-identical to pipeline-off."""
+    conf = _conf(corpus_dir)
+    args = ["--epochs=4", "--ckpt-every=2", "--ckpt-dir=ck", conf]
+    os.makedirs("a")
+    os.chdir("a")
+    base = _train(args, capsys, env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    os.chdir("..")
+    os.makedirs("b")
+    os.chdir("b")
+    on = _train(args, capsys)
+    os.chdir("..")
+    assert base[0] == on[0] == 0
+    assert on[1] == base[1] and on[2] == base[2] and on[3] == base[3]
+    # snapshots landed on the every-2 grid in both
+    assert base[1].count("CKPT: snapshot") == 2
+
+
+# --- vectorized line renderer ----------------------------------------------
+
+def _legacy_render(events, stats, kind, momentum, verbosity):
+    """The pre-vectorization per-sample loop, kept here as the oracle."""
+    out = []
+    init_err = np.asarray(stats.init_err, dtype=np.float64)
+    first_ok = np.asarray(stats.first_ok)
+    n_iter = np.asarray(stats.n_iter)
+    final_dep = np.asarray(stats.final_dep, dtype=np.float64)
+    success = np.asarray(stats.success)
+    snn_bp = kind == "SNN" and not momentum
+
+    def cout(t):
+        if verbosity > 1:
+            out.append(t)
+
+    for line, i in events:
+        if verbosity > 1:
+            out.append("NN: " + line)
+        if i is None:
+            continue
+        cout(f" init={init_err[i]:15.10f}")
+        cout(" OK" if first_ok[i] else " NO")
+        cout(f" N_ITER={int(n_iter[i]):8d}")
+        if snn_bp:
+            cout(f" final={final_dep[i]:15.10f}\n")
+        else:
+            cout(f" final={final_dep[i]:15.10f}")
+            cout(" SUCCESS!\n" if success[i] else " FAIL!\n")
+        if final_dep[i] > 0.1 and verbosity > 2:
+            out.append("NN(DBG): bad optimization!\n")
+    return "".join(out)
+
+
+@pytest.mark.parametrize("kind,momentum", [("ANN", False), ("ANN", True),
+                                           ("SNN", False), ("SNN", True)])
+@pytest.mark.parametrize("verbosity", [0, 2, 3])
+def test_render_matches_legacy_loop(kind, momentum, verbosity):
+    rng = np.random.default_rng(3)
+    n = 17
+    stats = SimpleNamespace(
+        init_err=rng.uniform(0, 2, n),
+        first_ok=rng.integers(0, 2, n).astype(bool),
+        n_iter=rng.integers(1, 102400, n).astype(np.int32),
+        final_dep=np.where(rng.integers(0, 2, n) > 0,
+                           rng.uniform(0, 1e-6, n),
+                           rng.uniform(0.1, 0.9, n)),  # triggers the dbg line
+        success=rng.integers(0, 2, n).astype(bool),
+    )
+    events, row = [], 0
+    for i in range(n + 4):
+        if i % 5 == 3:
+            events.append((f"TRAINING FILE: {'skip%03d' % i:>16}\t", None))
+        elif row < n:
+            events.append((f"TRAINING FILE: {'s%03d' % i:>16}\t", row))
+            row += 1
+    text, summary = api._render_training_lines(events, stats, kind,
+                                               momentum, verbosity)
+    assert text == _legacy_render(events, stats, kind, momentum, verbosity)
+    assert summary["samples"] == n
+    assert summary["success"] == int(np.sum(stats.success))
+    np.testing.assert_allclose(summary["mean_final"],
+                               float(np.mean(stats.final_dep)))
+    if verbosity == 0:
+        assert text == ""
+
+
+def test_render_empty_epoch():
+    stats = SimpleNamespace(init_err=np.zeros(0), first_ok=np.zeros(0, bool),
+                            n_iter=np.zeros(0, np.int32),
+                            final_dep=np.zeros(0),
+                            success=np.zeros(0, bool))
+    events = [("TRAINING FILE:             skip\t", None)]
+    text, summary = api._render_training_lines(events, stats, "ANN", False, 2)
+    assert text == "NN: TRAINING FILE:             skip\t"
+    assert summary == {"samples": 0, "mean_final": None, "success": 0}
+
+
+# --- corpus-cache GC -------------------------------------------------------
+
+def test_cache_gc_evicts_lru_but_not_active(tmp_path, capsys):
+    cdir = str(tmp_path / "cache")
+    os.makedirs(cdir)
+    d = str(tmp_path / "samples")
+    rng = np.random.default_rng(1)
+    _write_corpus(d, rng, 6, with_skips=False)
+    corpus.set_cache_dir(cdir)
+    corpus.set_cache_max_mb(1)  # 1 MB cap; tiny packs -> fits
+    try:
+        # two stale packs from "earlier runs" (not registered active),
+        # aged apart so LRU order is deterministic
+        old1 = os.path.join(cdir, "corpus-" + "a" * 20 + ".pack")
+        old2 = os.path.join(cdir, "corpus-" + "b" * 20 + ".pack")
+        with open(old1, "wb") as fp:
+            fp.write(b"\0" * (600 << 10))
+        with open(old2, "wb") as fp:
+            fp.write(b"\0" * (600 << 10))
+        now = time.time()
+        os.utime(old1, (now - 200, now - 200))
+        os.utime(old2, (now - 100, now - 100))
+        from hpnn_tpu.utils.glibc_random import GlibcRandom, shuffled_indices
+        names = samples.list_sample_dir(d)
+        order = shuffled_indices(GlibcRandom(1), len(names))
+        corpus.load_ordered(d, names, order, "TRAINING", N_IN, N_OUT)
+        capsys.readouterr()
+        # the oldest stale pack went first; the just-built one survives
+        assert not os.path.exists(old1)
+        assert os.path.exists(corpus.pack_path(d))
+        assert os.path.abspath(corpus.pack_path(d)) in corpus._active_packs
+        # an ACTIVE pack is never evicted, whatever its age
+        corpus._note_active(old2)
+        os.utime(old2, (now - 500, now - 500))
+        assert corpus.gc_cache() == []  # old2 protected, cap now met
+        assert os.path.exists(old2)
+    finally:
+        corpus.set_cache_dir(None)
+        corpus.set_cache_max_mb(None)
+        corpus._active_packs.clear()
+
+
+def test_cache_gc_noop_without_cap_or_dir(tmp_path):
+    corpus.set_cache_max_mb(None)
+    assert corpus.gc_cache() == []  # no cap -> no-op
+    corpus.set_cache_max_mb(1)
+    try:
+        assert corpus.gc_cache() == []  # no cache dir -> no-op
+    finally:
+        corpus.set_cache_max_mb(None)
+
+
+def test_cli_parses_corpus_cache_max_mb():
+    parsed = cli._parse_args(["--corpus-cache-max-mb=64", "x.conf"],
+                             "train_nn", train=True)
+    assert parsed[2]["corpus_cache_max_mb"] == 64
+    parsed = cli._parse_args(["--corpus-cache-max-mb", "32", "x.conf"],
+                             "run_nn", train=False)
+    assert parsed[2]["corpus_cache_max_mb"] == 32
+    with pytest.raises(SystemExit):
+        cli._parse_args(["--corpus-cache-max-mb", "nope"], "train_nn",
+                        train=True)
+
+
+# --- flock-guarded pack build ----------------------------------------------
+
+def test_concurrent_cold_builds_read_corpus_once(tmp_path, monkeypatch):
+    """Two racing cold loads of the same dir: the flock serializes the
+    build, the waiter adopts the winner's pack, and every sample file
+    is read exactly once between them."""
+    d = str(tmp_path / "samples")
+    rng = np.random.default_rng(2)
+    _write_corpus(d, rng, 8, with_skips=False)
+    from hpnn_tpu.utils.glibc_random import GlibcRandom, shuffled_indices
+    names = samples.list_sample_dir(d)
+    order = shuffled_indices(GlibcRandom(9), len(names))
+
+    calls = []
+    real = corpus.read_sample_fast
+
+    def counting(path, n_in, n_out):
+        calls.append(path)
+        time.sleep(0.01)  # widen the race window
+        return real(path, n_in, n_out)
+
+    monkeypatch.setattr(corpus, "read_sample_fast", counting)
+    results = {}
+
+    def load(tag):
+        results[tag] = corpus.load_ordered(d, names, order, "TRAINING",
+                                           N_IN, N_OUT)
+
+    with nn_log.capture():
+        t1 = threading.Thread(target=load, args=("a",))
+        t2 = threading.Thread(target=load, args=("b",))
+        t1.start()
+        t2.start()
+        t1.join()
+        t2.join()
+    assert os.path.exists(corpus.pack_path(d))
+    assert len(calls) == len(names), \
+        "both racers re-read the corpus: the build lock did not serialize"
+    (_, xa, ta), (_, xb, tb) = results["a"], results["b"]
+    np.testing.assert_array_equal(xa, xb)
+    np.testing.assert_array_equal(ta, tb)
+
+
+def test_standalone_train_kernel_with_pipeline_joins_inline(corpus_dir,
+                                                            capsys):
+    """api.train_kernel WITHOUT the trainer loop (no deferral flag):
+    the pipeline still engages, but output and host weights come back
+    at every call -- same contract as before."""
+    from hpnn_tpu.utils.glibc_random import GlibcRandom
+
+    conf = _conf(corpus_dir)
+    nn_log.set_verbosity(2)
+    try:
+        nn = api.configure(conf)
+        assert nn is not None
+        nn.shuffle_rng = GlibcRandom(nn.conf.seed)
+        capsys.readouterr()
+        assert api.train_kernel(nn)
+        out1 = capsys.readouterr().out
+        assert out1.count("TRAINING FILE:") == N_SAMP + 2
+        assert api.pipeline_active(nn)
+        assert nn.last_epoch_stats is not None
+        w1 = [w.copy() for w in nn.kernel.weights]
+        assert api.train_kernel(nn)  # second epoch, device-resident carry
+        out2 = capsys.readouterr().out
+        assert out2.count("TRAINING FILE:") == N_SAMP + 2
+        assert any(not np.array_equal(a, b)
+                   for a, b in zip(w1, nn.kernel.weights))
+    finally:
+        nn_log.set_verbosity(0)
