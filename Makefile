@@ -17,7 +17,8 @@ FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
              tests/test_native_io.py tests/test_corpus.py \
              tests/test_scale_scripts.py tests/test_bench_probe.py
 MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
-             tests/test_pallas_convergence.py tests/test_cli_e2e.py
+             tests/test_pallas_convergence.py tests/test_cli_e2e.py \
+             tests/test_tile_convergence.py
 SERVE_TESTS = tests/test_serve.py
 CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
 
@@ -57,12 +58,16 @@ bench:
 # load-generates against a self-hosted fast-parity server AND emits the
 # strict-vs-fast-vs-mesh comparison (single-device + sharded rows in one
 # JSON line; --mesh -1 shards over every local device, so the same
-# target captures a chip topology or the virtual CPU mesh)
+# target captures a chip topology or the virtual CPU mesh).  The ULP
+# envelope row (strict-vs-fast-vs-Pallas, PARITY_ULP.md) rides along so
+# a chip round re-captures the Mosaic-codegen envelope next to the
+# throughput rows (`make serve-bench REAL=1` for a full chip capture).
 serve-bench:
 	python scripts/serve_bench.py --conf nn.conf --requests 256 \
 	    --rows 3,5,7 --concurrency 16 --parity fast \
 	    --fast-threshold 256 --max-batch 512 --mesh -1 \
 	    --compare-buckets 256,512 --out SERVE_BENCH.json
+	python scripts/fuzz_parity.py --ulp 36 --out-doc PARITY_ULP.md
 
 # corpus-ingestion throughput: serial vs parallel cold load vs warm
 # pack-cache load on a generated 10k-file corpus (parity asserted on
@@ -73,9 +78,20 @@ io-bench:
 # multi-epoch input pipeline: device-resident corpus + permutation-only
 # H2D vs HPNN_NO_EPOCH_PIPELINE=1 restaging, 10k and 60k rows; emits
 # EPOCH_BENCH.json, rc!=0 if the H2D/stall floors miss (the device
-# epoch is stubbed on CPU hosts -- pass --real on chip rounds)
+# epoch is stubbed on CPU hosts -- `make epoch-bench REAL=1` on chip
+# rounds runs true convergence epochs instead)
 epoch-bench:
-	python scripts/epoch_bench.py --out EPOCH_BENCH.json
+	python scripts/epoch_bench.py --out EPOCH_BENCH.json \
+	    $(if $(REAL),--real)
+
+# batched-tile epoch MFU sweep (ISSUE 6): {tile} x {storage} x {route}
+# cells + per-sample baseline + convergence-trajectory envelope; emits
+# MFU_BENCH.json, rc!=0 when the winner misses the >=5x-over-r05 floor.
+# CPU hosts measure the XLA route and stub the Pallas cells; `make
+# mfu-bench REAL=1` on a chip measures them
+mfu-bench:
+	python scripts/mfu_bench.py --out MFU_BENCH.json \
+	    $(if $(REAL),--real)
 
 .PHONY: check check-all serve-check ckpt-check ckpt-bench native bench \
-    serve-bench io-bench epoch-bench
+    serve-bench io-bench epoch-bench mfu-bench

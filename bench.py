@@ -95,6 +95,27 @@ def _mxu_precision_name() -> str:
     return "highest" if _precision() == lax.Precision.HIGHEST else "default"
 
 
+def _route_precision(path: str, dtype_str: str, storage=None) -> str:
+    """The matmul route+precision actually taken by a row -- NEVER null
+    (ISSUE 6 satellite: BENCH_r05 carried mxu_precision: null on every
+    row because only the Pallas path filled it in).
+
+    Grammar: "<engine>-<resident dtype>[-<mxu mode>]", e.g.
+    "xla-f64" (the parity path), "pallas-f32-default" (bf16-native MXU
+    passes), "pallas-bf16-storage-default", "xla-f32-f64acc" (the
+    mixed-precision storage cells).
+    """
+    if storage == "bf16":
+        dt = "bf16-storage"
+    elif storage == "f32":
+        dt = "f32-f64acc"
+    else:
+        dt = dtype_str
+    if "pallas" in path:
+        return f"pallas-{dt}-{_mxu_precision_name()}"
+    return f"xla-{dt}"
+
+
 def _measure_sync_rtt():
     """One-round-trip cost of the scalar sync itself (reported in JSON).
 
@@ -207,12 +228,64 @@ def _convergence_flops_per_iter(dims, momentum):
     return total
 
 
+def _aligned_rate_corpus(dims, weights, n, seed=20260803):
+    """Bounded-trajectory rate corpus: targets aligned with the net's
+    INITIAL argmax, so under a huge delta + iteration cap every lane
+    runs a bounded trajectory and a timed cell measures kernel math
+    rate, never corpus convergence luck.  THE shared protocol of the
+    tiled_epoch bench row and scripts/mfu_bench.py -- both import this
+    builder so the two artifacts cannot silently desynchronize."""
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(0, 1, (n, dims[0]))
+    v = xs
+    for w in weights:
+        v = np.tanh(v @ np.asarray(w, np.float64).T)
+    ts = -np.ones((n, dims[-1]))
+    ts[np.arange(n), v.argmax(axis=1)] = 1.0
+    return xs, ts
+
+
+def _lockstep_iters(n_iter, tile):
+    """Executed lockstep rounds of a tiled epoch: per group, the loop
+    runs until the slowest lane exits (dead lanes ride the masked
+    GEMMs, so executed work is lockstep rounds x tile lanes)."""
+    n = len(n_iter)
+    g = -(-n // tile)
+    return sum(int(n_iter[i * tile:(i + 1) * tile].max())
+               for i in range(g))
+
+
+def _measure_tiled_rate(dims, weights, xs, ts, tile, storage, route, cap,
+                        repeats):
+    """One bounded-trajectory tiled cell, median of ``repeats``:
+    returns (wall_s, n_iter array, lockstep_iters, executed_tflops)."""
+    from hpnn_tpu.ops.convergence_tile import train_epoch_tiled
+
+    def run():
+        t0 = time.perf_counter()
+        _, st = train_epoch_tiled(weights, xs, ts, "ANN", False,
+                                  tile=tile, storage=storage, route=route,
+                                  delta=1e9, max_iter=cap)
+        ni = np.asarray(st.n_iter, np.int64)
+        return time.perf_counter() - t0, ni
+
+    run()  # compile + warm
+    walls, ni = [], None
+    for _ in range(repeats):
+        dt, ni = run()
+        walls.append(dt)
+    dt = statistics.median(walls)
+    lock = _lockstep_iters(ni, tile)
+    fpi = _convergence_flops_per_iter(dims, False)
+    return dt, ni, lock, lock * tile * fpi / dt / 1e12
+
+
 def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
-                       dtype_str, repeats=REPEATS):
+                       dtype_str, repeats=REPEATS, tile=0, storage=None):
     import jax.numpy as jnp
 
     from hpnn_tpu.models.kernel import generate_kernel
-    from hpnn_tpu.ops import select_train_epoch
+    from hpnn_tpu.ops import autotune, select_train_epoch
 
     dtype = {"f32": jnp.float32, "f64": jnp.float64,
              "bf16": jnp.bfloat16}[dtype_str]
@@ -222,7 +295,8 @@ def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
     jxs = jnp.asarray(xs, dtype=dtype)
     jts = jnp.asarray(ts, dtype=dtype)
 
-    train_epoch, path = select_train_epoch(dtype)
+    train_epoch, path = select_train_epoch(dtype, tile=tile,
+                                           storage=storage)
     # compile/warmup at the exact timed shapes
     w, stats = train_epoch(weights, jxs, jts, kind, momentum)
     _sync((w, stats.n_iter))
@@ -255,12 +329,21 @@ def _bench_convergence(name, dims, kind, momentum, n_samples, corpus_fn,
         "tflops_effective": round(tflops, 4),
         "mfu_vs_bf16_peak": round(tflops / PEAK_TFLOPS_BF16, 6),
         "path": path,
-        # MXU matmul precision of the Pallas path: "default" = bf16-native
-        # passes (throughput mode; convergence fires earlier than exact-f32
-        # math, every SUCCESS still argmax-verified), "highest" = exact-f32
-        # (HPNN_PALLAS_PRECISION=highest, ~3x slower per iteration).
-        # Resolved by the same helper the kernel uses.
-        "mxu_precision": _mxu_precision_name() if path == "pallas" else None,
+        # batched-tile engine group size (0 = per-sample) and the matmul
+        # route+precision ACTUALLY taken -- populated on EVERY row (the
+        # r05 schema gap: null unless the Pallas path served the row)
+        "tile": int(tile),
+        "mxu_precision": _route_precision(path, dtype_str, storage),
+        # the topology autotuner's routing record for this shape -- the
+        # tile-decision record on tiled rows, the epoch-route record on
+        # per-sample rows (neither describe ever triggers a
+        # measurement: bench rows report routing, never perturb it)
+        "autotuner_decision": (
+            autotune.describe_tile([tuple(w.shape) for w in weights],
+                                   dtype, kind, momentum)
+            if tile else
+            autotune.describe([tuple(w.shape) for w in weights],
+                              kind, momentum)),
         # When a third or more of the corpus runs to the 102399-iteration
         # ceiling, the samples/sec value measures the MAX_ITER budget, not
         # convergence -- the compiled reference shows the same pathology on
@@ -339,6 +422,12 @@ def _bench_stress():
         "mfu_vs_bf16_peak": round(tflops / PEAK_TFLOPS_BF16, 4),
         "path": f"dispatch(xla>={_XLA_TAKEOVER_DIM},"
                 f"pallas<{_XLA_TAKEOVER_DIM})",
+        # schema: tile = batched-tile ENGINE group size; this row is a
+        # batched forward, not the tiled convergence engine (batch size
+        # lives in the "batch" field)
+        "tile": 0,
+        "mxu_precision": f"pallas+xla-bf16-{_mxu_precision_name()}",
+        "autotuner_decision": {"source": "n/a-forward-dispatch"},
         "tflops_all_pallas_kernel": round(tflops_pallas, 3),
         "mfu_all_pallas_kernel": round(tflops_pallas / PEAK_TFLOPS_BF16, 4),
         # the one-sync cost subtracted from each timed wall (auditable:
@@ -421,6 +510,13 @@ def _bench_dp(bsz: int = 256, n: int = 16384, chain: int = 256):
         "tflops_effective": round(tflops, 4),
         "mfu_vs_bf16_peak": round(tflops / PEAK_TFLOPS_BF16, 6),
         "path": "xla",
+        # schema: tile = batched-tile ENGINE group size; this row is
+        # minibatch SGD (its batch size is in the metric name and
+        # "minibatch" field), not the tiled convergence engine
+        "tile": 0,
+        "minibatch": bsz,
+        "mxu_precision": _route_precision("xla", "f32"),
+        "autotuner_decision": {"source": "n/a-minibatch-sgd"},
     }
 
 
@@ -535,11 +631,86 @@ def _bench_epoch_pipeline(fallback: bool) -> dict:
     return {"metric": "epoch_pipeline_10k",
             "value": cfg["ratios"]["host_stall_speedup"],
             "unit": "host_stall_speedup_x",
+            "tile": 0,
+            "mxu_precision": ("stub" if data["train_stub"]
+                              else _route_precision("xla", "f64")),
+            "autotuner_decision": {"source": "n/a-staging-bench"},
             "train_stub": data["train_stub"],
             "floors_ok": data["ok"],
             "ratios": cfg["ratios"],
             "pipelined": cfg["pipelined"],
             "unpipelined": cfg["unpipelined"]}
+
+
+def _bench_tiled_epoch(fallback: bool) -> dict:
+    """The MFU_BENCH winner cell as a bench row (ISSUE 6): the batched-
+    tile epoch at the autotuned/swept winner {tile, storage}, measured
+    with the same bounded-trajectory protocol scripts/mfu_bench.py uses
+    (aligned targets + iteration cap: a RATE measurement -- one
+    saturated lane would otherwise drag its whole group through ~1e5
+    lockstep rounds and measure the pathology, not the kernel)."""
+    import os
+
+    import jax.numpy as jnp
+
+    from hpnn_tpu.models.kernel import generate_kernel
+    from hpnn_tpu.ops import autotune
+    from hpnn_tpu.ops.convergence_tile import _pallas_ok, resolve_route
+
+    tile, storage, win_route = 8192, None, None
+    mfu_json = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "MFU_BENCH.json")
+    try:
+        with open(mfu_json) as fp:
+            win = json.load(fp)["winner"]
+        tile = int(win["tile"])
+        storage = None if win["storage"] in ("native-f32", None) \
+            else win["storage"]
+        win_route = win.get("route")
+    except (OSError, KeyError, TypeError, ValueError):
+        pass  # no sweep artifact yet: the default winner shape
+    dims = [784, 300, 10]
+    cap = 64
+    n = min(2 * tile, 4096 if fallback else 16384)
+    tile = min(tile, n)
+    kern, _ = generate_kernel(10958, dims[0], dims[1:-1], dims[-1])
+    weights = tuple(jnp.asarray(w, jnp.float32) for w in kern.weights)
+    xs, ts = _aligned_rate_corpus(dims, kern.weights, n)
+    jxs = jnp.asarray(xs, jnp.float32)
+    jts = jnp.asarray(ts, jnp.float32)
+    # the route the engine will ACTUALLY take for this (dtype, storage)
+    # -- start from the winner cell's MEASURED route (a chip sweep can
+    # elect an XLA cell; re-deriving from the backend would benchmark a
+    # different, unmeasured cell), dropped when this backend cannot run
+    # it, then resolve_route applies the same demotions train_epoch_tiled
+    # does (f32 storage and over-VMEM tiles are XLA-only), so the row
+    # never labels an XLA run as Pallas
+    want = win_route if win_route == "xla" or _pallas_ok(jnp.float32) \
+        else None
+    route = resolve_route(jnp.float32, storage, want, tile=tile,
+                          shapes=[tuple(w.shape) for w in weights])
+    dt, ni, lock, exec_tflops = _measure_tiled_rate(
+        dims, weights, jxs, jts, tile, storage, route, cap, REPEATS)
+    return {
+        "metric": f"tiled_epoch_winner_tile{tile}",
+        "value": round(lock * tile / dt, 1),
+        "unit": "lane_iters/sec/chip",
+        "seconds": round(dt, 4),
+        "n_samples": n,
+        "lockstep_iters": lock,
+        "useful_iters": int(ni.sum()),
+        "tflops_executed": round(exec_tflops, 4),
+        "mfu_vs_bf16_peak": round(exec_tflops / PEAK_TFLOPS_BF16, 6),
+        "path": f"tile-{route}",
+        "tile": tile,
+        "mxu_precision": _route_precision(route, "f32", storage),
+        "autotuner_decision": autotune.describe_tile(
+            [tuple(w.shape) for w in weights], jnp.float32, "ANN", False),
+        # rate proxy, not a convergence claim: bounded trajectory, the
+        # same protocol (and winner cell) as MFU_BENCH.json
+        "bounded_iteration_proxy": True,
+        "bounded_iteration_cap": cap,
+    }
 
 
 def main() -> int:
@@ -611,6 +782,10 @@ def main() -> int:
             "mnist_784-20-2_snn_bp_2class", [784, 20, 2], "SNN",
             False, cs(64), _mnist_corpus_2class, "f32"),
         "stress_8x4096": _bench_stress,
+        # the batched-tile engine at the MFU_BENCH winner cell (ISSUE 6)
+        # -- the row that tracks the "close the MFU gap" tentpole round
+        # over round; bounded-trajectory rate protocol, see the helper
+        "tiled_epoch": lambda: _bench_tiled_epoch(fallback),
         # input-pipeline row (ISSUE 5): multi-epoch staging, pipelined
         # vs restaged -- chip rounds capture it with real convergence
         # epochs, CPU fallback with the staging stub

@@ -203,6 +203,66 @@ def _dtype_of(conf: NNConf):
             "bf16": jnp.bfloat16}.get(conf.dtype, jnp.float64)
 
 
+def _tile_request(conf: NNConf) -> int:
+    """Batched-tile engine request: HPNN_TILE env (int or "auto") wins
+    over the conf's ``[tile]`` / the CLI's ``--tile``.  0 = off (the
+    per-sample engines), >0 = explicit group size, -1 = autotuned."""
+    env = os.environ.get("HPNN_TILE")
+    if env:
+        if env.strip().lower() == "auto":
+            return -1
+        try:
+            return max(0, int(env))
+        except ValueError:
+            nn_warn(f"HPNN_TILE={env!r} is not an integer or 'auto'; "
+                    "tile engine off\n")
+            return 0
+    return conf.tile
+
+
+def _tile_storage_env() -> str | None:
+    """HPNN_TILE_STORAGE, validated: bf16/f32/f64 pass through,
+    anything else warns and is ignored -- the same lenient contract as
+    ``_tile_request``'s HPNN_TILE handling (a bad env knob must not
+    abort a training run with a traceback from deep inside the
+    kernel)."""
+    env = os.environ.get("HPNN_TILE_STORAGE")
+    if not env:
+        return None
+    v = env.strip().lower()
+    if v in ("bf16", "f32", "f64"):
+        return v
+    nn_warn(f"HPNN_TILE_STORAGE={env!r} is not bf16/f32/f64; legacy "
+            "storage used\n")
+    return None
+
+
+def _resolve_tile(conf: NNConf, weights, dtype, kind: str,
+                  momentum: bool) -> tuple[int, str | None, str | None]:
+    """Concrete (tile, storage, route) for a non-zero tile request:
+    explicit values pass through (route auto-resolved downstream),
+    ``auto`` asks the measured autotuner (ops.autotune; heuristic
+    default when autotuning is off) and its route decision is APPLIED,
+    not just logged.  ``HPNN_TILE_STORAGE`` is an operator override on
+    BOTH branches -- when set it beats the autotuner's storage choice."""
+    req = _tile_request(conf)
+    env_storage = _tile_storage_env()
+    if req > 0:
+        return req, env_storage, None
+    from .ops import autotune
+
+    dec = autotune.decide_tile([tuple(w.shape) for w in weights], dtype,
+                               kind, momentum)
+    storage = env_storage if env_storage is not None else dec["storage"]
+    nn_dbg(f"autotune: tile={dec['tile']} route={dec['route']} "
+           f"storage={storage}"
+           + (" (HPNN_TILE_STORAGE override)"
+              if env_storage is not None and env_storage != dec["storage"]
+              else "")
+           + f" ({dec['source']})\n")
+    return int(dec["tile"]), storage, dec["route"]
+
+
 def _shuffle_order(conf: NNConf, n: int, rng=None) -> list[int]:
     """Seeded shuffle of n files (libhpnn.c:1218-1229); seed 0 -> time()
     written back into the conf, as the reference mutates _CONF.seed.
@@ -354,8 +414,17 @@ class _EpochPipeline:
 
         t0 = time.perf_counter()
         if self.train_fn is None:
-            self.train_fn, _ = ops.select_train_epoch(
-                self.dtype, donate=True, defer_stats=True)
+            if _tile_request(nn.conf):
+                # the batched-tile engine rides the pipeline unchanged:
+                # same epoch-fn contract, donated carry, lazy stats
+                tile, tstorage, troute = _resolve_tile(
+                    nn.conf, nn.kernel.weights, self.dtype, kind, momentum)
+                self.train_fn, _ = ops.select_train_epoch(
+                    self.dtype, donate=True, defer_stats=True,
+                    tile=tile, storage=tstorage, route=troute)
+            else:
+                self.train_fn, _ = ops.select_train_epoch(
+                    self.dtype, donate=True, defer_stats=True)
         if self.weights is None:
             # first epoch (or post-resume) staging from the float64 host
             # weights; afterwards the carry never leaves the device
@@ -717,7 +786,7 @@ def train_kernel(nn: NNDef) -> bool:
         # GEMMs are exactly what XLA tiles best.
         with phase("train_epoch_dp"):
             ok = _train_kernel_dp(nn, weights, xs, ts, kind, momentum,
-                                  finish, model_shards)
+                                  finish, model_shards, events)
     elif model_shards > 1:
         # [model] N / -S N: the reference's intra-layer row sharding
         # (its ONLY distributed strategy, ann.c:913-936 dispatched from
@@ -728,8 +797,16 @@ def train_kernel(nn: NNDef) -> bool:
     else:
         # the Pallas VMEM-persistent kernel serves f32/bf16 on TPU, the
         # XLA path serves fp64 parity and other backends
-        # (ops.select_train_epoch)
-        train_epoch_fn, _ = ops.select_train_epoch(dtype)
+        # (ops.select_train_epoch); --tile S opts into the batched-tile
+        # engine (groups of S to convergence, GEMM-shaped -- documented
+        # trajectory divergence for S>1, per-sample grammar unchanged)
+        if _tile_request(conf):
+            tile, tstorage, troute = _resolve_tile(conf, weights, dtype,
+                                                   kind, momentum)
+            train_epoch_fn, _ = ops.select_train_epoch(
+                dtype, tile=tile, storage=tstorage, route=troute)
+        else:
+            train_epoch_fn, _ = ops.select_train_epoch(dtype)
         t_up = time.perf_counter()
         xs_dev = jnp.asarray(xs, dtype=dtype)
         ts_dev = jnp.asarray(ts, dtype=dtype)
@@ -877,8 +954,15 @@ def _train_kernel_tp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
 
 
 def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
-                     finish, model_shards: int = 1) -> bool:
+                     finish, model_shards: int = 1, events=None) -> bool:
     """Data-parallel minibatch epoch ([batch] B conf extension).
+
+    With a tile request ([tile]/--tile/HPNN_TILE, ISSUE 6) the route
+    swaps its engine: instead of one SGD step per batch, every
+    [batch]-sized group trains TO CONVERGENCE in lockstep through the
+    batched-tile kernel (``parallel.dp.dp_tiled_epoch`` -- lanes sharded
+    over the mesh's data axis, per-lane masking), and the per-sample
+    console grammar returns because ``SampleStats`` are exact again.
 
     Uses the reference's per-family learning rates and the BPM update
     order.  Every sample trains: batches are padded up to a multiple of
@@ -906,6 +990,16 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     from .parallel.mesh import replicated as replicated_sharding
 
     conf = nn.conf
+    if _tile_request(conf):
+        if jax.process_count() > 1:
+            nn_warn("[tile] engine is single-controller; multi-process "
+                    "[batch] runs keep minibatch DP\n")
+        elif model_shards > 1:
+            nn_warn("[tile] + [model] hybrid is not supported; minibatch "
+                    "DP keeps the hybrid mesh\n")
+        else:
+            return _train_kernel_dp_tiled(nn, weights, xs, ts, kind,
+                                          momentum, finish, events)
     lr = ops.bpm_learn_rate(kind) if momentum else ops.bp_learn_rate(kind)
     s = xs.shape[0]
     # (rank-divergence is handled by train_kernel's agreement gate, which
@@ -1009,6 +1103,54 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
                            "mean_final": float(np.mean(errs)),
                            "success": 0}
     nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_weights]
+    return finish()
+
+
+def _train_kernel_dp_tiled(nn: NNDef, weights, xs, ts, kind: str,
+                           momentum: bool, finish, events) -> bool:
+    """[batch] + [tile]: batched-tile convergence engine on the DP route
+    (ISSUE 6 tentpole wiring).  The [batch] value is the convergence
+    GROUP (the S lanes of each GEMM-shaped step); a positive [tile]
+    value sets how many groups ride one device launch -- execution
+    granularity only, SampleStats identical for ANY launch tiling
+    (pinned in tests/test_tile_convergence.py).  Lane rows shard over
+    the data mesh when more than one device is visible."""
+    import jax
+    import jax.numpy as jnp
+
+    from .parallel import make_mesh
+    from .parallel.dp import dp_tiled_epoch
+
+    conf = nn.conf
+    dtype = _dtype_of(conf)
+    s = xs.shape[0]
+    group = min(conf.batch, s) if conf.batch > 0 else s
+    req = _tile_request(conf)
+    if req < 0:
+        nn_warn("[tile] auto on the [batch] route: the group size IS "
+                "the minibatch and [tile] only sets launch granularity "
+                "(results identical for any value) -- the autotuner "
+                "does not apply; default launch sizing used\n")
+    launch_groups = req if req > 0 else 0
+    storage = _tile_storage_env()
+    ndev = jax.device_count()
+    mesh = make_mesh(n_data=ndev, n_model=1) if ndev > 1 else None
+    pad_to = mesh.shape["data"] if mesh is not None else 1
+    eff = -(-group // pad_to) * pad_to
+    nn_out(f"DP: batched-tile convergence engine (group={group}"
+           + (f" -> {eff} over {pad_to} data-shard(s)" if eff != group
+              else "")
+           + (f", mesh={ndev}" if mesh is not None else "")
+           + (f", storage={storage}" if storage else "") + ")\n")
+    new_w, stats = dp_tiled_epoch(
+        weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
+        kind, momentum, group, alpha=0.2, mesh=mesh,
+        launch_groups=launch_groups, storage=storage)
+    # per-sample grammar again: load order == stats order, exactly like
+    # the sequential routes
+    nn.last_epoch_stats = _emit_training_lines(events or [], stats, kind,
+                                               momentum)
+    nn.kernel.weights = [np.asarray(w, dtype=np.float64) for w in new_w]
     return finish()
 
 

@@ -58,6 +58,12 @@ def _help_text(name: str, train: bool) -> str:
     ]
     if train:
         lines += [
+            "--tile S \tbatched-tile convergence engine: train groups",
+            "\tof S samples per GEMM-shaped step (per-lane convergence",
+            "\tmasking; documented trajectory divergence vs per-sample",
+            "\ttraining for S>1).  'auto' asks the topology autotuner",
+            "\t(HPNN_NO_AUTOTUNE=1 disables; HPNN_AUTOTUNE_CACHE=DIR",
+            "\trelocates the decision cache); 0 keeps per-sample mode.",
             "--epochs N \ttrain N epochs in-process (default 1); the",
             "\tseeded shuffle stream continues across epochs, and the",
             "\tcorpus + weights stay device-resident between them",
@@ -91,7 +97,8 @@ _LONG_OPTS = {"--compile-cache": "compile_cache",
 _LONG_INT_OPTS = {"--epochs": ("epochs", 1),
                   "--ckpt-every": ("ckpt_every", 0),
                   "--ckpt-keep": ("ckpt_keep", 0),
-                  "--corpus-cache-max-mb": ("corpus_cache_max_mb", 0)}
+                  "--corpus-cache-max-mb": ("corpus_cache_max_mb", 0),
+                  "--tile": ("tile", 0)}
 _SHARED_INT_OPTS = frozenset(("--corpus-cache-max-mb",))
 
 
@@ -148,6 +155,11 @@ def _parse_args(argv: list[str], name: str, train: bool):
             if not eq:
                 i += 1
                 val = argv[i] if i < len(argv) else ""
+            if key == "--tile" and val.strip().lower() == "auto":
+                # --tile auto: the measured autotuner decision
+                extras[dest] = -1
+                i += 1
+                continue
             # GET_UINT-style: parse the leading digits (train_nn.c:124)
             digits = ""
             for ch in val:
@@ -280,6 +292,9 @@ def train_nn_main(argv: list[str] | None = None) -> int:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    if extras.get("tile") is not None:
+        # the CLI flag wins over a [tile] conf keyword
+        neural.conf.tile = extras["tile"]
     snap = None
     start_epoch = 0
     if resume:

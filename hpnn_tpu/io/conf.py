@@ -59,6 +59,11 @@ class NNConf:
     model: int = 0        # [model] N -> N-way tensor (row) sharding -- the
     #                       reference's MPI/stream strategy (ann.c:913-936),
     #                       reachable from the conf; 0 = -S knob / off
+    tile: int = 0         # [tile] N|auto -> batched-tile convergence engine
+    #                       (ops.convergence_tile): groups of N samples per
+    #                       GEMM-shaped step; -1 = autotuned; 0 = off.  On
+    #                       the [batch] route the batch is the group and
+    #                       the value sets launch granularity.
 
 
 def _clean(value: str) -> str:
@@ -181,6 +186,18 @@ def parse_conf(fp: IO[str]) -> NNConf | None:
                 nn_error(f"[model] value: {_after(line, '[model').strip()}\n")
                 return None
             conf.model = v
+        if "[tile" in line:
+            rest = _after(line, "[tile")
+            if _clean(rest).lower() == "auto":
+                conf.tile = -1  # autotuned (ops.autotune.decide_tile)
+            else:
+                v = _get_uint(rest)
+                if v is None:
+                    nn_error("Malformed NN configuration file!\n")
+                    nn_error("[tile] value: "
+                             f"{rest.strip()}\n")
+                    return None
+                conf.tile = v
     if conf.type == NN_TYPE_UKN:
         nn_error("Malformed NN configuration file!\n")
         nn_error("[type] unknown or missing...\n")

@@ -39,7 +39,9 @@ def _use_pallas(dtype=None) -> bool:
         jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16))
 
 
-def select_train_epoch(dtype=None, donate=False, defer_stats=False):
+def select_train_epoch(dtype=None, donate=False, defer_stats=False,
+                       tile=0, storage=None, topology=None,
+                       kind="ANN", momentum=False, route=None):
     """Pick the convergence-epoch implementation for the current backend.
 
     Returns ``(fn, name)`` where fn is call-compatible with
@@ -47,6 +49,23 @@ def select_train_epoch(dtype=None, donate=False, defer_stats=False):
     The Pallas VMEM-persistent kernel (convergence_pallas) is the f32/bf16
     throughput path on TPU -- the production analog of the reference's
     fused CUDA hot loop (``/root/reference/src/cuda_ann.cu:77-148``).
+
+    ``tile`` (ISSUE 6) selects the batched-tile engine: groups of
+    ``tile`` samples train to convergence in lockstep with per-lane
+    masking (``ops.convergence_tile``) so every layer op is GEMM-shaped.
+    ``tile > 1`` is the opt-in throughput mode (documented trajectory
+    divergence); ``tile == 1`` is the per-sample semantics through the
+    batched kernel (bitwise-equal to the per-sample Pallas program);
+    ``tile < 0`` asks the autotuner for the measured winner {tile,
+    route, storage} for ``topology`` (weight shapes; required then) --
+    ``kind``/``momentum`` key that decision, so pass the workload's
+    real values or the cache fills under the wrong family.
+    ``storage`` overrides the resident weight dtype on the tiled engine
+    ("bf16"/"f32" mixed-precision storage, quantified ULP envelope);
+    ``route`` pins "pallas"/"xla" (autotuner decisions carry one).  The
+    returned name reports the route the engine will ACTUALLY take
+    (``convergence_tile.resolve_route`` -- e.g. f32 storage demotes
+    Pallas to XLA), so bench rows never label an XLA run as Pallas.
 
     ``donate=True`` (the epoch pipeline's device-resident weight carry)
     hands out the input-donating variants on accelerator backends -- the
@@ -63,6 +82,28 @@ def select_train_epoch(dtype=None, donate=False, defer_stats=False):
 
     from .convergence import (_chunk_override, chunked_epoch,
                               train_epoch_donated)
+
+    if tile:
+        from .convergence_tile import resolve_route, train_epoch_tiled
+
+        if route is None:
+            route = "pallas" if _use_pallas(dtype) else "xla"
+        if tile < 0:
+            from . import autotune
+
+            if topology is None:
+                raise ValueError("tile<0 (autotuned) needs topology=")
+            dec = autotune.decide_tile(topology, dtype or "float32",
+                                       kind, momentum)
+            tile = dec["tile"]
+            storage = storage if storage is not None else dec["storage"]
+            route = dec["route"]
+        route = resolve_route(dtype, storage, route, tile=tile,
+                              shapes=topology)
+        fn = functools.partial(train_epoch_tiled, tile=int(tile),
+                               storage=storage, route=route,
+                               donate=donate, defer_stats=defer_stats)
+        return fn, f"tile-{route}"
 
     on_tpu = jax.default_backend() == "tpu"
     if _use_pallas(dtype):

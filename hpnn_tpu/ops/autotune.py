@@ -1,0 +1,377 @@
+"""Measured topology autotuner for the convergence hot path.
+
+PR 1 hardcoded a ``< 2**16``-params opt-out that routed tiny topologies
+off the iteration-budgeted Pallas epoch (a measured 166x regression on
+784-20-2 -- BENCH_r03).  A constant guard is the wrong tool: the
+crossover moves with the chip generation, the dtype, and the Mosaic
+version.  This module replaces it with a MEASURED decision, and extends
+the same machinery to the batched-tile epoch's knobs:
+
+* ``budgeted_decision(shapes, kind, momentum)`` -- iteration-budgeted
+  watchdog program vs the plain host-chunked kernel, per topology;
+* ``decide_tile(shapes, dtype, kind, momentum)`` -- {tile size, Pallas
+  vs XLA route, weight-storage dtype} for ``--tile auto``.
+
+Protocol: at FIRST compile of a given (topology, dtype, backend) the
+candidates are micro-benchmarked on a tiny synthetic corpus (one
+warm-up + one timed epoch each -- seconds on a chip, where the real
+epoch would run minutes) and the winner is cached as JSON next to the
+compile cache, so the second run is a CACHE HIT with zero
+re-measurement.  Decisions are keyed on the backend, so a cache file
+shared between a CPU smoke host and a chip never cross-contaminates.
+
+Knobs:
+
+* ``HPNN_AUTOTUNE_CACHE=DIR``  -- cache location (default: the JAX
+  compilation cache dir when one is configured, else
+  ``~/.cache/hpnn_tpu``);
+* ``HPNN_NO_AUTOTUNE=1``       -- escape hatch: never measure, never
+  read the cache; every decision falls back to today's heuristics
+  (the 2**16-params routing table, the default tile) so behavior is
+  exactly the pre-autotuner one;
+* ``HPNN_AUTOTUNE=1``          -- force measurement on non-TPU backends
+  (tests; by default only the TPU backend measures -- CPU interpret-mode
+  Pallas timings would be meaningless and slow).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+_MEM_CACHE: dict = {}          # per-process memo over the JSON file
+_DEFAULT_TILES = (8, 32, 128, 512)
+_DEFAULT_TILE = 32             # heuristic when measurement is disabled
+# budgeted-decision probe: samples per candidate epoch.  Small on
+# purpose -- that probe runs UNCAPPED convergence, so it must stay far
+# inside the TPU watchdog even when every sample saturates MAX_ITER.
+_PROBE_SAMPLES = 8
+# tile-decision probe: the corpus must hold >= 2 FULL groups of the
+# LARGEST candidate tile, or every tile above the sample count trains
+# the same few live lanes plus pure masked padding and the measurement
+# systematically elects a small tile.  Cells run a bounded-iteration
+# trajectory (the mfu_bench rate-proxy protocol), so even the capped
+# worst case (n * _PROBE_MAX_ITER lane-iterations) is watchdog-safe by
+# construction.
+_PROBE_MAX_ITER = 64
+_PROBE_MAX_SAMPLES = 4096
+
+
+def enabled() -> bool:
+    """Measurement policy (see module docstring)."""
+    if os.environ.get("HPNN_NO_AUTOTUNE"):
+        return False
+    if os.environ.get("HPNN_AUTOTUNE"):
+        return True
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def cache_dir() -> str:
+    d = os.environ.get("HPNN_AUTOTUNE_CACHE")
+    if d:
+        return d
+    try:
+        import jax
+
+        d = jax.config.jax_compilation_cache_dir
+        if d:
+            return d
+    except Exception:
+        pass
+    return os.path.join(os.path.expanduser("~"), ".cache", "hpnn_tpu")
+
+
+def _cache_path() -> str:
+    return os.path.join(cache_dir(), "autotune.json")
+
+
+def _key(knob: str, shapes, kind: str, momentum: bool, dtype=None) -> str:
+    import jax
+
+    topo = "x".join(f"{int(n)}.{int(m)}" for n, m in shapes)
+    dt = "" if dtype is None else str(jax.numpy.dtype(dtype))
+    return (f"{jax.default_backend()}|{knob}|{kind}|"
+            f"{'BPM' if momentum else 'BP'}|{dt}|{topo}")
+
+
+def _load() -> dict:
+    path = _cache_path()
+    try:
+        with open(path) as fp:
+            return json.load(fp)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store(key: str, entry: dict) -> None:
+    """Merge one decision into the JSON cache (atomic replace; racing
+    processes re-measure at worst, they never corrupt the file)."""
+    from ..io.atomic import atomic_write_bytes
+
+    d = cache_dir()
+    try:
+        os.makedirs(d, exist_ok=True)
+        data = _load()
+        data[key] = entry
+        atomic_write_bytes(_cache_path(),
+                           (json.dumps(data, indent=1) + "\n").encode())
+    except OSError as exc:  # the cache is an optimization, never fatal
+        from ..utils.nn_log import nn_warn
+
+        nn_warn(f"autotune cache not writable ({exc}); decision will be "
+                "re-measured next run\n")
+
+
+def _lookup(key: str):
+    if key in _MEM_CACHE:
+        return _MEM_CACHE[key]
+    entry = _load().get(key)
+    if entry is not None:
+        _MEM_CACHE[key] = entry
+    return entry
+
+
+def clear_memo() -> None:
+    """Drop the in-process memo (tests simulate a fresh process)."""
+    _MEM_CACHE.clear()
+
+
+def _probe_problem(shapes, dtype, n=_PROBE_SAMPLES):
+    """Tiny synthetic corpus shaped like the topology (seeded -- every
+    candidate measures the identical workload)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    n_in = int(shapes[0][1])
+    n_out = int(shapes[-1][0])
+    rng = np.random.default_rng(20260803)
+    weights = tuple(
+        jnp.asarray(rng.uniform(-0.1, 0.1, (int(n_), int(m))), dtype)
+        for n_, m in shapes)
+    xs = jnp.asarray(rng.uniform(0, 1, (n, n_in)), dtype)
+    ts = -np.ones((n, n_out))
+    ts[np.arange(n), rng.integers(0, n_out, n)] = 1.0
+    return weights, xs, jnp.asarray(ts, dtype)
+
+
+def _time_epoch(fn, weights, xs, ts, kind, momentum) -> tuple[float, float]:
+    """(iters_per_s, wall_s) of one epoch, after one warm-up pass (the
+    warm-up pays compile; the timed pass is steady-state)."""
+    import numpy as np
+
+    _, st = fn(weights, xs, ts, kind, momentum)
+    float(np.asarray(st.n_iter, dtype=np.int64).sum())  # sync
+    t0 = time.perf_counter()
+    _, st = fn(weights, xs, ts, kind, momentum)
+    iters = float(np.asarray(st.n_iter, dtype=np.int64).sum())
+    dt = max(time.perf_counter() - t0, 1e-9)
+    return iters / dt, dt
+
+
+def budgeted_decision(shapes, kind: str, momentum: bool) -> tuple[bool, str]:
+    """Should this topology use the iteration-budgeted watchdog program
+    (vs the plain host-chunked kernel)?  Returns ``(budgeted, source)``
+    with source in {"heuristic", "cache", "measured"}.
+
+    With autotuning off (HPNN_NO_AUTOTUNE=1, or a non-TPU backend
+    without HPNN_AUTOTUNE=1) this is exactly PR 1's routing table --
+    the escape hatch preserves today's route selection bit-for-bit.
+    """
+    from .convergence_pallas import use_budgeted
+
+    if not enabled():
+        return use_budgeted(shapes), "heuristic"
+    key = _key("epoch_route", shapes, kind, momentum)
+    entry = _lookup(key)
+    if entry is not None:
+        return bool(entry["budgeted"]), "cache"
+    budgeted, rates = _measure_budgeted(shapes, kind, momentum)
+    entry = {"budgeted": budgeted, "iters_per_s": rates,
+             "heuristic": use_budgeted(shapes),
+             "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                          time.gmtime())}
+    _MEM_CACHE[key] = entry
+    _store(key, entry)
+    return budgeted, "measured"
+
+
+def _measure_budgeted(shapes, kind, momentum):
+    """Time the budgeted program vs the plain chunked kernel on the
+    probe corpus; ties go to the budgeted program (exact device-side
+    watchdog bounding beats host-side sizing at equal speed)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from . import convergence_pallas as cp
+    from .convergence import chunked_epoch
+
+    interpret = jax.default_backend() != "tpu"
+    weights, xs, ts = _probe_problem(shapes, jnp.float32)
+    plain = chunked_epoch(
+        functools.partial(cp.train_epoch_pallas, interpret=interpret))
+
+    def budgeted_fn(w, x, t, k, m):
+        return cp._train_epoch_core(
+            w, x, t, k, m, alpha=0.2, delta=-1.0, lr=None,
+            interpret=interpret, precision=cp._precision(),
+            budgeted=True)
+
+    def budgeted_wrap(w, x, t, k, m):
+        neww, st = budgeted_fn(w, x, t, k, m)
+        return neww, cp.SampleStats(
+            init_err=st[:, 0], first_ok=st[:, 1] > 0.5,
+            n_iter=st[:, 2].astype(jnp.int32), final_dep=st[:, 3],
+            success=st[:, 4] > 0.5)
+
+    rate_plain, _ = _time_epoch(plain, weights, xs, ts, kind, momentum)
+    rate_budget, _ = _time_epoch(budgeted_wrap, weights, xs, ts, kind,
+                                 momentum)
+    rates = {"plain": round(rate_plain, 1), "budgeted": round(rate_budget, 1)}
+    return rate_budget >= rate_plain, rates
+
+
+def decide_tile(shapes, dtype, kind: str, momentum: bool,
+                tiles=None, storages=(None, "bf16")) -> dict:
+    """Pick {tile, route, storage} for the batched-tile epoch on this
+    (topology, dtype, backend).  Returns a decision dict::
+
+        {"tile": int, "route": "pallas"|"xla", "storage": None|"bf16",
+         "source": "heuristic"|"cache"|"measured",
+         "cells": {label: iters_per_s, ...}}   # measured runs only
+
+    The winner maximizes measured lane-iterations/s on the probe
+    corpus (sized to >= 2 full groups of the largest candidate tile,
+    every lane bounded to ``_PROBE_MAX_ITER`` iterations -- a rate
+    measurement, never convergence luck).  On a TPU backend BOTH
+    routes are candidates per (tile,
+    storage) cell -- a topology where XLA beats Pallas (the regression
+    class that motivated this module) gets routed away from Pallas by
+    measurement, and the decision's ``route`` is applied by
+    ``select_train_epoch``/``api._resolve_tile``.  Off-TPU only the XLA
+    route is measured (interpret-mode Pallas timings are meaningless).
+    With autotuning disabled the heuristic default (tile=32,
+    backend-native route, legacy storage) comes back.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    route_default = ("pallas"
+                     if jax.default_backend() == "tpu"
+                     and jnp.dtype(dtype) in (jnp.dtype(jnp.float32),
+                                              jnp.dtype(jnp.bfloat16))
+                     else "xla")
+    if not enabled():
+        return {"tile": _DEFAULT_TILE, "route": route_default,
+                "storage": None, "source": "heuristic"}
+    key = _key("tile", shapes, kind, momentum, dtype)
+    entry = _lookup(key)
+    if entry is not None:
+        return {**entry, "source": "cache"}
+    entry = _measure_tile(shapes, dtype, kind, momentum,
+                          tiles or _DEFAULT_TILES, storages,
+                          route_default)
+    _MEM_CACHE[key] = entry
+    _store(key, entry)
+    return {**entry, "source": "measured"}
+
+
+def _measure_tile(shapes, dtype, kind, momentum, tiles, storages,
+                  route_default):
+    import functools
+
+    import jax
+
+    from .convergence_tile import resolve_route, train_epoch_tiled
+
+    interpret = jax.default_backend() != "tpu"
+    # probe sizing: >= 2 full groups of the LARGEST candidate tile (see
+    # _PROBE_MAX_ITER comment -- an 8-sample probe can never observe a
+    # large tile's throughput gain, only its padding overhead), bounded
+    # per-lane by _PROBE_MAX_ITER so every cell measures math rate
+    n = min(max(2 * max(tiles), _PROBE_SAMPLES), _PROBE_MAX_SAMPLES)
+    weights, xs, ts = _probe_problem(shapes, dtype, n)
+    # the route axis is MEASURED where both routes exist: on TPU every
+    # (tile, storage) cell runs under Pallas AND XLA; off-TPU the only
+    # real route is XLA (interpret-mode Pallas timings mean nothing)
+    routes = ("pallas", "xla") if route_default == "pallas" else ("xla",)
+    cells = {}
+    best = (None, -1.0)
+    for route in routes:
+        for tile in tiles:
+            for storage in storages:
+                if storage == "bf16" and route == "xla" \
+                        and str(jax.numpy.dtype(dtype)) == "float64":
+                    continue  # bf16 storage under f64 parity: no sense
+                if route == "pallas" and storage not in (None, "", "bf16"):
+                    continue  # Mosaic has no f64 accumulate
+                if route == "pallas" and resolve_route(
+                        dtype, storage, "pallas", tile=tile,
+                        shapes=shapes) != "pallas":
+                    # the engine would demote this cell to XLA (VMEM
+                    # budget) -- measuring it would time XLA under a
+                    # pallas label
+                    cells[f"tile{tile}-{storage or 'native'}-pallas"] = \
+                        "skipped: exceeds VMEM budget"
+                    continue
+                if tile > n:
+                    cells[f"tile{tile}-{storage or 'native'}-{route}"] = \
+                        "skipped: tile exceeds probe corpus"
+                    continue
+                fn = functools.partial(train_epoch_tiled, tile=int(tile),
+                                       storage=storage, route=route,
+                                       interpret=interpret,
+                                       max_iter=_PROBE_MAX_ITER)
+                label = f"tile{tile}-{storage or 'native'}-{route}"
+                try:
+                    rate, _ = _time_epoch(fn, weights, xs, ts, kind,
+                                          momentum)
+                except Exception as exc:  # a failed candidate loses, only
+                    cells[label] = f"error: {type(exc).__name__}"
+                    continue
+                cells[label] = round(rate, 1)
+                if rate > best[1]:
+                    best = ((int(tile), storage, route), rate)
+    if best[0] is None:
+        return {"tile": _DEFAULT_TILE, "route": route_default,
+                "storage": None, "cells": cells}
+    (tile, storage, route), _ = best
+    return {"tile": tile, "route": route, "storage": storage,
+            "cells": cells}
+
+
+def describe(shapes, kind: str, momentum: bool) -> dict:
+    """Bench-row annotation: the epoch-route decision WITHOUT triggering
+    a measurement (bench rows must report routing, not perturb it)."""
+    from .convergence_pallas import use_budgeted
+
+    if not enabled():
+        return {"source": "off" if os.environ.get("HPNN_NO_AUTOTUNE")
+                else "heuristic",
+                "budgeted": use_budgeted(shapes)}
+    entry = _lookup(_key("epoch_route", shapes, kind, momentum))
+    if entry is None:
+        return {"source": "unmeasured", "budgeted": use_budgeted(shapes)}
+    return {"source": "cache", "budgeted": bool(entry["budgeted"])}
+
+
+def describe_tile(shapes, dtype, kind: str, momentum: bool) -> dict:
+    """Bench-row annotation for the TILED engine: the cached {tile,
+    route, storage} decision WITHOUT triggering a measurement (the
+    ``epoch_route`` twin is :func:`describe` -- a tiled bench row
+    annotated with that knob would report the budgeted-vs-plain
+    per-sample dispatch, which says nothing about the engine the row
+    actually ran)."""
+    if not enabled():
+        return {"source": "off" if os.environ.get("HPNN_NO_AUTOTUNE")
+                else "heuristic",
+                "tile": _DEFAULT_TILE, "storage": None}
+    entry = _lookup(_key("tile", shapes, kind, momentum, dtype))
+    if entry is None:
+        return {"source": "unmeasured"}
+    return {"source": "cache",
+            **{k: entry[k] for k in ("tile", "route", "storage")}}

@@ -496,24 +496,26 @@ def train_epoch_pallas(weights, xs, ts, kind: str, momentum: bool,
     return new_w, stats
 
 
-# Tiny-topology routing (VERDICT round 5): on the 2-class SNN shape
-# (784-20-2, ~15.7k params) the budgeted program ran ~166x slower than
-# the plain chunked one (271.9 vs 45,146.7 iters/s, BENCH_r03.json) --
-# at sub-microsecond iteration cost the budgeted kernel's per-grid-step
-# machinery (scalar-prefetch control reads, stats carry copy-through,
-# SMEM counter) dominates the math.  Models below this parameter count
-# route to the plain kernel under the host-side adaptive chunker (the
-# pre-round-5 proven path; watchdog-safe because tiny models execute
-# millions of iterations per safe window, so the chunker's worst-case
-# sizing never exceeds it); the flagship (238k params) and XRD (248k)
-# shapes stay budgeted.
+# Tiny-topology routing HEURISTIC (VERDICT round 5): on the 2-class SNN
+# shape (784-20-2, ~15.7k params) the budgeted program ran ~166x slower
+# than the plain chunked one (271.9 vs 45,146.7 iters/s, BENCH_r03.json)
+# -- at sub-microsecond iteration cost the budgeted kernel's
+# per-grid-step machinery (scalar-prefetch control reads, stats carry
+# copy-through, SMEM counter) dominates the math.  Since ISSUE 6 this
+# constant is only the FALLBACK table: the production dispatch asks
+# ops.autotune.budgeted_decision, which micro-benchmarks both programs
+# per topology at first compile and caches the winner -- the hardcoded
+# guard only answers when autotuning is off (HPNN_NO_AUTOTUNE=1, or a
+# backend that cannot meaningfully measure), preserving today's routing
+# exactly as the escape hatch.
 _BUDGET_MIN_PARAMS = 1 << 16
 
 
 def use_budgeted(shapes) -> bool:
-    """True when the iteration-budgeted watchdog program should serve a
-    topology with these weight shapes (pinned by the bench guard test so
-    the tiny-shape BENCH row cannot silently regress again)."""
+    """HEURISTIC routing table (autotuner fallback + escape hatch): True
+    when the iteration-budgeted watchdog program should serve a topology
+    with these weight shapes (pinned by the bench guard test so the
+    tiny-shape BENCH row cannot silently regress again)."""
     return sum(int(n) * int(m) for n, m in shapes) >= _BUDGET_MIN_PARAMS
 
 
@@ -565,9 +567,12 @@ def train_epoch_pallas_watchdog(weights, xs, ts, kind: str, momentum: bool,
                                   alpha=alpha, delta=delta, lr=lr,
                                   interpret=interpret, precision=precision,
                                   donate=donate)
-    if not use_budgeted([w.shape for w in weights]):
-        # tiny topology: the plain kernel via host-side adaptive chunking
-        # (see _BUDGET_MIN_PARAMS above)
+    from .autotune import budgeted_decision
+
+    if not budgeted_decision([w.shape for w in weights], kind,
+                             momentum)[0]:
+        # the measured (or, with autotuning off, the heuristic) loser:
+        # the plain kernel via host-side adaptive chunking
         from .convergence import chunked_epoch
 
         return chunked_epoch(train_epoch_pallas)(

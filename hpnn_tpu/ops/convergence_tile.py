@@ -1,0 +1,728 @@
+"""Batched-tile convergence epoch: a tile of S samples per grid step.
+
+BENCH_r05 quantified why the per-sample hot path cannot feed the MXU:
+``convergence_pallas`` runs ONE sample's do/while loop per sequential
+grid step, so every matvec is a skinny ``(1, width)`` op and the chain
+reaches ``mfu_vs_bf16_peak`` of only 1e-4..5e-4 -- the BP epoch is
+latency-bound, not compute-bound.  This module closes that gap with a
+GEMM-shaped epoch: each grid step trains a TILE of S samples together,
+so every layer op is an ``(S, M) @ (M, N)``-class matmul (the
+compiler-first portable-kernel framing of arXiv:2603.09555 -- lower the
+algorithm to the constructs the matrix unit actually tiles).
+
+Semantics -- *group-to-convergence with per-lane masking*:
+
+* the epoch's (pre-shuffled) samples split into consecutive groups of
+  ``tile`` rows; groups run strictly in order, weights carrying from
+  group to group (exactly like the per-sample chain carries them from
+  sample to sample);
+* within a group every lane starts at the group's entry weights and the
+  reference's do/while iterations run LOCKSTEP: per iteration each LIVE
+  lane applies its own reference-rate rank-1 update -- the combined
+  weight step is one ``d^T @ h`` GEMM over the masked lane rows, so a
+  tile of S is S simultaneous per-sample updates, not a 1/S-scaled
+  minibatch mean;
+* a lane drops out of the update the moment its own sample's stop
+  criterion fires -- the exact per-sample formula
+  ``(dEp <= delta) && argmax-ok && iter > MIN`` bounded by MAX
+  (``/root/reference/src/ann.c:2322-2362``) -- and its ``SampleStats``
+  row (n_iter / first_ok / final_dep / success) freezes at that
+  iteration, so per-sample iteration accounting stays EXACT;
+* the group loop ends when every lane is dead.
+
+``tile=1`` therefore degenerates to the per-sample semantics: one lane,
+masked by its own liveness, summing one rank-1 update per iteration --
+the Pallas variant is BITWISE-equal to ``convergence_pallas``'s
+per-sample kernel (same ``dot_general`` specs, same op order; pinned in
+tests/test_tile_convergence.py).  ``tile>1`` is a *documented
+divergence* from the sequential trajectory (lanes interact through the
+shared weights); scripts/mfu_bench.py measures the convergence-
+trajectory envelope vs the per-sample path alongside the MFU sweep.
+
+Mixed-precision storage (the ``storage=`` axis): weights can be HELD
+between iterations in a narrower dtype than the update math --
+
+* ``storage="bf16"``: bf16-resident weights, every matmul accumulates
+  in f32 (``preferred_element_type``) and the weight add runs in f32
+  before quantizing back -- halves the VMEM/HBM weight footprint;
+* ``storage="f32"``: f32-resident weights with f64 update accumulation
+  (XLA route only; Mosaic has no f64);
+* ``storage=None``: the legacy rule (f32 master under bf16 activations,
+  identity elsewhere) -- bit-identical to the per-sample paths.
+
+The quantization error this introduces is bounded and ASSERTED in ULP
+units in tests/test_tile_convergence.py; bench rows report the storage
+mode in ``mxu_precision``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .activations import TINY, ann_act, ann_dact
+from .convergence import SampleStats
+from .convergence_pallas import LANE, _acc, _CompilerParams, _precision
+from .steps import (
+    DELTA_BP,
+    DELTA_BPM,
+    MAX_BP_ITER,
+    MAX_BPM_ITER,
+    MIN_BP_ITER,
+    MIN_BPM_ITER,
+    SNN,
+    bp_learn_rate,
+    bpm_learn_rate,
+)
+
+
+def resolve_hyper(kind: str, momentum: bool, lr, delta, max_iter=None):
+    """The reference's per-family hyper-parameter resolution, shared by
+    every convergence engine (lr=None / delta<=0 select the defaults).
+    ``max_iter`` overrides the family's iteration ceiling -- a bounded-
+    trajectory knob for rate measurement (scripts/mfu_bench.py, the
+    autotuner probes); None keeps the reference semantics."""
+    if lr is None:
+        lr = bpm_learn_rate(kind) if momentum else bp_learn_rate(kind)
+    if momentum:
+        min_iter, family_max = MIN_BPM_ITER, MAX_BPM_ITER
+        if delta <= 0.0:
+            delta = DELTA_BPM
+    else:
+        min_iter, family_max = MIN_BP_ITER, MAX_BP_ITER
+        if delta <= 0.0:
+            delta = DELTA_BP
+    return float(lr), float(delta), min_iter, \
+        int(max_iter) if max_iter else family_max
+
+
+def storage_wdtype(dtype, storage: str | None):
+    """Resident weight dtype for a storage mode.  ``None`` keeps the
+    legacy master rule (f32 under bf16 activations, identity elsewhere);
+    "bf16"/"f32" pin the resident dtype explicitly."""
+    if storage in (None, ""):
+        return _acc(dtype)
+    table = {"bf16": jnp.bfloat16, "f32": jnp.float32, "f64": jnp.float64}
+    if storage not in table:
+        raise ValueError(f"unknown weight storage {storage!r} "
+                         "(expected bf16/f32/f64)")
+    return table[storage]
+
+
+def _accum_dtype(storage: str | None):
+    """Update-accumulation dtype for an EXPLICIT storage mode: bf16
+    storage accumulates in f32, f32 storage in f64 (when x64 is on --
+    the drivers always enable it).  None = legacy (add in the resident
+    dtype, bit-identical to the per-sample paths)."""
+    if storage == "bf16":
+        return jnp.float32
+    if storage == "f32":
+        return jnp.float64 if jax.config.jax_enable_x64 else None
+    return None
+
+
+# --- tile-shaped math helpers -------------------------------------------
+# Same dot_general dimension_numbers as convergence_pallas' per-sample
+# _matvec/_matvec_t/_outer, generalized to S rows -- at S=1 the traced
+# ops are IDENTICAL, which is what makes tile=1 bitwise-equal.
+
+def _mv(v, w, precision):
+    """(S, M) x (N, M)^T -> (S, N) in the activation dtype."""
+    return lax.dot_general(
+        v, w.astype(v.dtype),
+        dimension_numbers=(((1,), (1,)), ((), ())),
+        preferred_element_type=_acc(v.dtype),
+        precision=precision).astype(v.dtype)
+
+
+def _mv_t(d, w, precision):
+    """(S, N) x (N, M) -> (S, M) (transposed matvec for hidden deltas)."""
+    return lax.dot_general(
+        d, w.astype(d.dtype),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=_acc(d.dtype),
+        precision=precision).astype(d.dtype)
+
+
+def _upd(d, h, precision):
+    """(S, N)^T x (S, M) -> (N, M) summed over lanes, in the f32-or-wider
+    ACCUMULATOR dtype (the per-sample `_outer` rule: a bf16-cast update
+    re-quantizes most weight steps to zero)."""
+    return lax.dot_general(
+        d, h, dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=_acc(d.dtype), precision=precision)
+
+
+def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
+                n_layers, n_out, kind, momentum, lr, alpha, min_iter,
+                max_iter, delta, precision, acc_dtype):
+    """One group of S samples trained to convergence, lockstep with
+    per-lane masking.  Two weight-state modes serve the two routes:
+
+    * ``w0`` given (XLA): the weight (and momentum) arrays ride the
+      ``lax.while_loop`` carry -- pure functional; returns
+      ``(new_weights, stats_cols)``.
+    * ``w0=None`` (Pallas): ``w_refs``/``dw_refs`` are VMEM refs mutated
+      in place every iteration (the convergence_pallas proven pattern --
+      Mosaic keeps the block resident, no large carry to spill); returns
+      ``(None, stats_cols)``.
+
+    ``acc_dtype`` (explicit storage modes only) widens the weight ADD:
+    resident -> acc, add the f32+ update, quantize back to resident.
+    None adds in the resident dtype (the per-sample kernels' exact
+    behavior -- required for the tile=1 bitwise guarantee).
+    """
+    dtype = x.dtype
+    s, npl = t.shape
+    col = lax.broadcasted_iota(jnp.int32, (1, npl), 1)
+    out_mask = col < n_out
+    # error/dep scalars: f32 for the f32/bf16 throughput dtypes (the
+    # per-sample Pallas rule -- Mosaic scalarizes 32-bit only, and the
+    # tile=1 bitwise guarantee needs the identical cast chain); f64
+    # keeps f64 so the stop test preserves the parity path's resolution
+    f32 = jnp.promote_types(jnp.float32, dtype)
+    carry_w = w0 is not None
+
+    def out_head(z):
+        if kind == SNN:
+            # softmax(x-1), TINY-seeded denominator (snn.c:282-334), per
+            # row; reductions in f32 (Mosaic scalarizes 32-bit only)
+            e = jnp.where(out_mask, jnp.exp(z - 1.0), 0.0).astype(dtype)
+            dv = jnp.sum(e.astype(f32), axis=1, keepdims=True) + TINY
+            return (e.astype(f32) / dv).astype(dtype)
+        return ann_act(z)
+
+    def fwd(getw):
+        acts = []
+        v = x
+        for l in range(n_layers):
+            z = _mv(v, getw(l), precision)
+            v = out_head(z) if l == n_layers - 1 else ann_act(z)
+            acts.append(v)
+        return tuple(acts)
+
+    def err(o):
+        # per-row error scalars in f32 whatever the activation dtype
+        # (same dtype rules as the per-sample kernel)
+        if kind == SNN:
+            of = o.astype(f32)
+            terms = jnp.where(of > 0.0,
+                              t.astype(f32) * jnp.log(of + TINY), 0.0)
+            return -jnp.sum(terms, axis=1, keepdims=True) / n_out
+        d = t.astype(f32) - o.astype(f32)
+        return 0.5 * jnp.sum(d * d, axis=1, keepdims=True)
+
+    def argmax_first(o):
+        """First maximal REAL lane per row (strict probe<ptr scan)."""
+        masked = jnp.where(out_mask, o, -jnp.inf).astype(f32)
+        m = jnp.max(masked, axis=1, keepdims=True)
+        return jnp.min(jnp.where(masked == m, col, jnp.int32(npl)),
+                       axis=1, keepdims=True)
+
+    # p_trg per row: LAST index with t==1.0, default 0 (ann.c:2341-2348)
+    p_trg = jnp.max(jnp.where(t.astype(f32) == 1.0, col, jnp.int32(0)),
+                    axis=1, keepdims=True)
+
+    if carry_w:
+        acts0 = fwd(lambda l: w0[l])
+    else:
+        acts0 = fwd(lambda l: w_refs[l][:])
+    init_err = err(acts0[-1])
+
+    zero_s1 = jnp.zeros((s, 1), f32)
+    false_s1 = jnp.zeros((s, 1), jnp.bool_)
+    state0 = [jnp.int32(0),                 # lockstep iteration counter
+              jnp.zeros((s, 1), jnp.int32),  # per-lane n_iter
+              zero_s1,                       # per-lane dEp (frozen at exit)
+              false_s1,                      # per-lane is_ok_raw (frozen)
+              false_s1,                      # per-lane first_ok
+              valid,                         # per-lane liveness
+              acts0, init_err]
+    if carry_w:
+        dw0 = (tuple(jnp.zeros(w.shape,
+                               acc_dtype if acc_dtype is not None
+                               else w.dtype) for w in w0)
+               if momentum else ())
+        state0.append(tuple(w0))
+        state0.append(dw0)
+    state0 = tuple(state0)
+
+    def cond(state):
+        live = state[5]
+        # 32-bit reduction (Mosaic rejects sub-32-bit scalarization)
+        return jnp.sum(live.astype(jnp.int32)) > 0
+
+    def body(state):
+        if carry_w:
+            (it, n_it, dep, ok_raw, first_ok, live, acts, epr,
+             w_t, dw_t) = state
+            w_loc, dw_loc = list(w_t), list(dw_t)
+            getw = lambda l: w_loc[l]
+            setw = lambda l, v: w_loc.__setitem__(l, v)
+            getdw = lambda l: dw_loc[l]
+            setdw = lambda l, v: dw_loc.__setitem__(l, v)
+        else:
+            it, n_it, dep, ok_raw, first_ok, live, acts, epr = state
+            getw = lambda l: w_refs[l][:]
+            setw = lambda l, v: w_refs[l].__setitem__(slice(None), v)
+            getdw = lambda l: dw_refs[l][:]
+            setdw = lambda l, v: dw_refs[l].__setitem__(slice(None), v)
+        it = it + 1
+        ep = epr
+        o = acts[-1]
+        if kind == SNN:
+            d = t - o
+        else:
+            d = (t - o) * ann_dact(o)
+        ds = [d]
+        for l in range(n_layers - 1, 0, -1):
+            d = _mv_t(ds[0], getw(l), precision) * ann_dact(acts[l - 1])
+            ds.insert(0, d)
+        hs = (x, *acts[:-1])
+        for l in range(n_layers):
+            # dead lanes drop out of the update: their delta rows zero,
+            # so the d^T @ h GEMM sums live lanes' rank-1 updates only
+            dm = jnp.where(live, ds[l], jnp.zeros_like(ds[l]))
+            g = _upd(dm, hs[l], precision)
+            w = getw(l)
+            if momentum:
+                # dw += lr*outer; W += dw; dw *= alpha (ann.c:1996-1999)
+                if acc_dtype is not None:
+                    step = getdw(l) + (lr * g).astype(acc_dtype)
+                    w = (w.astype(acc_dtype) + step).astype(w.dtype)
+                else:
+                    step = getdw(l) + lr * g
+                    w = w + step
+                setw(l, w)
+                setdw(l, alpha * step)
+            else:
+                if acc_dtype is not None:
+                    w = (w.astype(acc_dtype)
+                         + (lr * g).astype(acc_dtype)).astype(w.dtype)
+                else:
+                    w = w + lr * g
+                setw(l, w)
+        new_acts = fwd(getw)
+        new_epr = err(new_acts[-1])
+        dep_new = ep - new_epr
+        okr = argmax_first(new_acts[-1]) == p_trg
+        n_it = jnp.where(live, it, n_it)
+        dep = jnp.where(live, dep_new, dep)
+        ok_raw = jnp.where(live, okr, ok_raw)
+        first_ok = jnp.where(live & (it == 1), okr, first_ok)
+        # per-lane continuation: the reference's do/while test
+        live = live & (it <= max_iter) & ((dep_new > delta)
+                                          | ~(okr & (it > min_iter)))
+        out = [it, n_it, dep, ok_raw, first_ok, live, new_acts, new_epr]
+        if carry_w:
+            out.append(tuple(w_loc))
+            out.append(tuple(dw_loc))
+        return tuple(out)
+
+    final = lax.while_loop(cond, body, state0)
+    n_it, dep, ok_raw, first_ok = final[1], final[2], final[3], final[4]
+    init_cols = (init_err, first_ok, n_it, dep,
+                 ok_raw & (n_it > min_iter))
+    return (final[8] if carry_w else None), init_cols
+
+
+# --- XLA route -----------------------------------------------------------
+
+def _tiled_epoch_xla_impl(weights, xg, tg, vg, kind: str, momentum: bool,
+                          alpha, delta, lr, precision, storage,
+                          max_iter=None):
+    """Jitted XLA core: scan over groups, lockstep while_loop inside.
+
+    xg (G, S, n_in), tg (G, S, n_out), vg (G, S, 1) row-validity mask.
+    Weights arrive ALREADY cast to the resident dtype (the public
+    wrapper owns the cast so donation can alias them).  Returns
+    (weights, stats (G, S, 5) f32).
+    """
+    lr, delta, min_iter, max_iter = resolve_hyper(kind, momentum, lr,
+                                                  delta, max_iter)
+    n_layers = len(weights)
+    n_out_real = tg.shape[2]
+    acc_dtype = _accum_dtype(storage)
+
+    def step(carry, gxtv):
+        gx, gt, gv = gxtv
+        new_w, cols = _group_loop(
+            gx, gt, gv, None, None, tuple(carry),
+            n_layers=n_layers, n_out=n_out_real, kind=kind,
+            momentum=momentum, lr=lr, alpha=alpha, min_iter=min_iter,
+            max_iter=max_iter, delta=delta, precision=precision,
+            acc_dtype=acc_dtype)
+        init_err, first_ok, n_it, dep, success = cols
+        # stats rows keep the error dtype's width: f32 on the
+        # throughput dtypes (the Pallas LANE-row rule), f64 on the f64
+        # route so printed init=/final= values keep parity resolution
+        sdt = jnp.promote_types(jnp.float32, xg.dtype)
+        row = jnp.concatenate(
+            [init_err.astype(sdt), first_ok.astype(sdt),
+             n_it.astype(sdt), dep.astype(sdt), success.astype(sdt)],
+            axis=1)
+        return new_w, row
+
+    w, stats = lax.scan(step, tuple(weights), (xg, tg, vg))
+    return w, stats
+
+
+_TILE_STATIC = ("kind", "momentum", "alpha", "delta", "lr", "precision",
+                "storage", "max_iter")
+_tiled_epoch_xla = jax.jit(_tiled_epoch_xla_impl,
+                           static_argnames=_TILE_STATIC)
+# donated sibling for the epoch pipeline's device-resident weight carry
+_tiled_epoch_xla_donated = jax.jit(_tiled_epoch_xla_impl,
+                                   static_argnames=_TILE_STATIC,
+                                   donate_argnames=("weights",))
+
+
+# --- Pallas route --------------------------------------------------------
+
+def _kernel_tile(x_ref, t_ref, v_ref, *refs, n_layers, n_out, kind,
+                 momentum, lr, alpha, min_iter, max_iter, delta, precision,
+                 acc_dtype):
+    """Grid step g trains ONE group of S samples against the
+    VMEM-resident weights (const-index output refs, flushed to HBM once
+    at epoch end -- the convergence_pallas residency pattern with a tile
+    axis on the streamed blocks)."""
+    w_in = refs[:n_layers]
+    w_out = refs[n_layers:2 * n_layers]
+    stats_ref = refs[2 * n_layers]
+    dw = refs[2 * n_layers + 1:] if momentum else ()
+
+    g = pl.program_id(0)
+
+    @pl.when(g == 0)
+    def _():
+        for wi, wo in zip(w_in, w_out):
+            wo[:] = wi[:]
+
+    x = x_ref[0]                    # (S, n_in) -- blocks are (1, S, width)
+    t = t_ref[0]                    # (S, n_out_padded)
+    valid = v_ref[0][:, :1] > 0.5   # (S, 1) from the (S, LANE) mask row
+
+    if momentum:
+        # momentum zeroes at GROUP entry -- ann_raz_momentum per sample
+        # (ann.c:2391) generalized to the lane group; tile=1 is exactly
+        # the per-sample rule
+        for b in dw:
+            b[:] = jnp.zeros_like(b)
+
+    _, cols = _group_loop(
+        x, t, valid, w_out, dw, None,
+        n_layers=n_layers, n_out=n_out, kind=kind, momentum=momentum,
+        lr=lr, alpha=alpha, min_iter=min_iter, max_iter=max_iter,
+        delta=delta, precision=precision, acc_dtype=acc_dtype)
+    init_err, first_ok, n_it, dep, success = cols
+
+    # scatter the 5 per-lane columns into the (S, LANE) stats block with
+    # vector selects (the per-sample kernel's store idiom, row-batched)
+    f32 = jnp.float32
+    s = x.shape[0]
+    srow = jnp.zeros((s, stats_ref.shape[2]), f32)
+    scol = lax.broadcasted_iota(jnp.int32, srow.shape, 1)
+    for k, v in enumerate((init_err.astype(f32), first_ok.astype(f32),
+                           n_it.astype(f32), dep.astype(f32),
+                           success.astype(f32))):
+        srow = jnp.where(scol == k, v, srow)
+    stats_ref[0] = srow
+
+
+def _tiled_epoch_pallas_impl(weights, xg, tg, vg, kind: str, momentum: bool,
+                             alpha, delta, lr, interpret, precision,
+                             storage, max_iter=None):
+    """Pallas core: grid over groups, weights VMEM-resident across every
+    grid step.  Weights arrive pre-cast to the resident dtype."""
+    lr, delta, min_iter, max_iter = resolve_hyper(kind, momentum, lr,
+                                                  delta, max_iter)
+    n_layers = len(weights)
+    g, s = xg.shape[0], xg.shape[1]
+    wdtype = weights[0].dtype
+    acc_dtype = _accum_dtype(storage)
+    mom_dtype = acc_dtype if acc_dtype is not None else wdtype
+
+    kargs = dict(n_layers=n_layers, n_out=tg.shape[2], kind=kind,
+                 momentum=momentum, lr=lr, alpha=alpha, min_iter=min_iter,
+                 max_iter=max_iter, delta=delta, precision=precision,
+                 acc_dtype=acc_dtype)
+    out_shape = [jax.ShapeDtypeStruct(w.shape, wdtype) for w in weights] \
+        + [jax.ShapeDtypeStruct((g, s, LANE), jnp.float32)]
+    scratch = ([pltpu.VMEM(w.shape, mom_dtype) for w in weights]
+               if momentum else [])
+    params = _CompilerParams(dimension_semantics=("arbitrary",))
+    z = np.int32(0)
+    const = lambda shape: pl.BlockSpec(shape, lambda i: (z, z))
+    per_g = lambda width: pl.BlockSpec((1, s, width), lambda i: (i, z, z))
+
+    out = pl.pallas_call(
+        functools.partial(_kernel_tile, **kargs),
+        grid=(g,),
+        in_specs=[per_g(xg.shape[2]), per_g(tg.shape[2]), per_g(LANE)]
+        + [const(w.shape) for w in weights],
+        out_specs=[const(w.shape) for w in weights] + [per_g(LANE)],
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        compiler_params=params,
+        interpret=interpret,
+    )(xg, tg, vg, *weights)
+    return tuple(out[:n_layers]), out[n_layers]
+
+
+_tiled_epoch_pallas = jax.jit(
+    _tiled_epoch_pallas_impl,
+    static_argnames=_TILE_STATIC + ("interpret",))
+_tiled_epoch_pallas_donated = jax.jit(
+    _tiled_epoch_pallas_impl,
+    static_argnames=_TILE_STATIC + ("interpret",),
+    donate_argnames=("weights",))
+
+
+# --- public epoch --------------------------------------------------------
+
+def _group_arrays(xs, ts, tile: int, lane_pad: bool,
+                  lane_tile: int | None = None):
+    """Split (S, n) sample arrays into (G, lane_tile, n) groups +
+    per-lane validity.
+
+    Each group holds ``tile`` REAL consecutive rows in its first lanes;
+    ``lane_tile > tile`` (the mesh-sharded [batch] route: lane rows must
+    divide the data axis) and the ragged tail pad with masked-out lanes
+    -- never trained, stats dropped.  ``lane_pad`` shapes the validity
+    as (G, lane_tile, LANE) f32 rows for the Pallas block stream; the
+    XLA route takes (G, lane_tile, 1) bool."""
+    s = xs.shape[0]
+    lt = lane_tile or tile
+    g = -(-s // tile)
+    rows = (jnp.arange(g)[:, None] * tile + jnp.arange(lt)[None, :])
+    valid = (jnp.arange(lt)[None, :] < tile) & (rows < s)
+    rows = jnp.where(valid, rows, 0)
+    xg = jnp.take(xs, rows.reshape(-1), axis=0).reshape(g, lt, -1)
+    tg = jnp.take(ts, rows.reshape(-1), axis=0).reshape(g, lt, -1)
+    if lane_pad:
+        vg = jnp.broadcast_to(
+            valid.astype(jnp.float32).reshape(g, lt, 1), (g, lt, LANE))
+        # materialize: broadcast_to views cannot feed donation/pallas
+        vg = jnp.asarray(vg)
+    else:
+        vg = valid.reshape(g, lt, 1)
+    return xg, tg, vg, s
+
+
+def _flatten_rows(rows, tile: int, s: int):
+    """(G, lane_tile, C) stats blocks -> (S, C): real lanes only, in
+    sample order."""
+    return rows[:, :tile, :].reshape(-1, rows.shape[-1])[:s]
+
+
+def _stats_from_rows(flat) -> SampleStats:
+    """(S, >=5) flattened stats rows -> SampleStats."""
+    return SampleStats(
+        init_err=flat[:, 0],
+        first_ok=flat[:, 1] > 0.5,
+        n_iter=flat[:, 2].astype(jnp.int32),
+        final_dep=flat[:, 3],
+        success=flat[:, 4] > 0.5,
+    )
+
+
+# The Pallas program streams each group's (1, S, width) blocks into the
+# ~16 MB/core VMEM alongside the resident weight copies; the budget
+# keeps a safety margin for Mosaic's own allocations, and tiles whose
+# estimated footprint exceeds it demote to the XLA route (which tiles
+# the GEMMs itself) instead of failing Mosaic allocation at compile.
+_VMEM_BUDGET_BYTES = 12 * 2**20
+
+
+def _pallas_vmem_bytes(tile: int, shapes, storage: str | None) -> int:
+    """Per-grid-step VMEM footprint estimate of the tiled Pallas
+    program: double-buffered streamed blocks (x/t in the compute dtype,
+    validity + stats rows in f32 at LANE width) plus the resident
+    weights (input + output copies) and the momentum scratch."""
+    in_w = int(shapes[0][1])
+    n_out = int(shapes[-1][0])
+    streamed = 2 * tile * ((in_w + n_out) * 4 + 2 * LANE * 4)
+    wbytes = 2 if storage == "bf16" else 4
+    params = sum(int(n) * int(m) for n, m in shapes)
+    return streamed + 3 * params * wbytes
+
+
+def resolve_route(dtype, storage: str | None = None, route: str | None = None,
+                  mesh=None, tile: int | None = None,
+                  shapes=None) -> str:
+    """The ONE route-resolution rule for the tiled engine, shared with
+    ``ops.select_train_epoch`` so reported path names always match what
+    executes:
+
+    * ``route=None`` auto-resolves from the backend (Pallas on TPU
+      f32/bf16, else XLA);
+    * explicit storage beyond bf16 demotes Pallas to XLA (Mosaic has no
+      f64 accumulate for the f32-storage cell);
+    * a ``mesh`` demotes Pallas to XLA: the data-axis sharding is
+      compiled by GSPMD from sharding constraints, which the
+      single-device Pallas program cannot carry -- the [batch] route's
+      sharding promise holds on the XLA route only;
+    * when ``tile`` and the weight ``shapes`` are known, a group block
+      that cannot fit the VMEM budget demotes Pallas to XLA
+      (``_pallas_vmem_bytes``) -- a tile=8192 f32 input block alone is
+      ~26 MB, over any core's VMEM, and must not reach ``pallas_call``.
+    """
+    if route is None:
+        route = "pallas" if _pallas_ok(dtype) else "xla"
+    if route == "pallas" and storage not in (None, "", "bf16"):
+        route = "xla"
+    if route == "pallas" and mesh is not None:
+        route = "xla"
+    if route == "pallas" and tile is not None and shapes is not None \
+            and _pallas_vmem_bytes(int(tile), shapes,
+                                   storage) > _VMEM_BUDGET_BYTES:
+        route = "xla"
+    return route
+
+
+def train_epoch_tiled(weights, xs, ts, kind: str, momentum: bool,
+                      alpha=0.2, delta=-1.0, lr=None, tile: int = 8,
+                      storage: str | None = None, route: str | None = None,
+                      precision=None, interpret=False, donate=False,
+                      defer_stats=False, launch_groups: int = 0,
+                      mesh=None, lane_tile: int | None = None,
+                      max_iter: int | None = None):
+    """Call-compatible with ``ops.train_epoch``: groups of ``tile``
+    samples trained to convergence with per-lane masking (module
+    docstring).  Returns (new_weights, SampleStats with leading S axis,
+    padding lanes dropped).
+
+    ``route``: "pallas" (TPU f32/bf16 or interpret mode), "xla", or None
+    for backend-auto.  ``storage``: resident weight dtype override (the
+    mixed-precision axis).  ``launch_groups`` splits the epoch into
+    dispatches of that many groups (weights carry launch to launch;
+    trajectory identical to one launch -- the chunked_epoch argument),
+    0 = one launch off-TPU / watchdog-sized on TPU.  ``mesh``
+    constrains each group's lane rows to the data axis so the
+    per-layer GEMMs shard and the ``d^T @ h`` update all-reduces over
+    ICI (``parallel.dp.dp_tiled_epoch`` passes it); a mesh forces the
+    XLA route -- GSPMD compiles the sharding, the single-device Pallas
+    program cannot (``resolve_route``).  ``defer_stats`` is
+    accepted for epoch-pipeline call parity: stats are already lazy
+    device slices here.  ``max_iter`` overrides the family iteration
+    ceiling -- a bounded-trajectory rate-measurement knob
+    (scripts/mfu_bench.py); None keeps the reference semantics.
+    """
+    del defer_stats  # stats are lazy device arrays on every route
+    if precision is None:
+        precision = _precision()
+    tile = max(1, int(tile))
+    s = xs.shape[0]
+    if s == 0:
+        z = jnp.zeros((0,), jnp.float32)
+        return tuple(weights), SampleStats(z, z > 0, z.astype(jnp.int32),
+                                           z, z > 0)
+    route = resolve_route(xs.dtype, storage, route, mesh,
+                          tile=lane_tile or tile,
+                          shapes=[tuple(w.shape) for w in weights])
+    wdtype = storage_wdtype(xs.dtype, storage)
+    wp = tuple(w.astype(wdtype) for w in weights)
+    xg, tg, vg, s = _group_arrays(xs, ts, tile, lane_pad=route == "pallas",
+                                  lane_tile=lane_tile)
+    if mesh is not None and route == "xla":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS, replicated
+
+        xg = jax.device_put(xg, NamedSharding(mesh, P(None, DATA_AXIS,
+                                                      None)))
+        tg = jax.device_put(tg, NamedSharding(mesh, P(None, DATA_AXIS,
+                                                      None)))
+        vg = jax.device_put(vg, NamedSharding(mesh, P(None, DATA_AXIS,
+                                                      None)))
+        wp = tuple(jax.device_put(w, replicated(mesh)) for w in wp)
+
+    if route == "pallas":
+        core = (_tiled_epoch_pallas_donated
+                if donate and jax.default_backend() == "tpu"
+                else _tiled_epoch_pallas)
+        core = functools.partial(core, interpret=interpret)
+    else:
+        core = (_tiled_epoch_xla_donated
+                if donate and jax.default_backend() not in ("cpu",)
+                else _tiled_epoch_xla)
+
+    g = xg.shape[0]
+    chunk = int(launch_groups) if launch_groups else 0
+    tracker = None
+    if chunk <= 0 and jax.default_backend() == "tpu" \
+            and not isinstance(jnp.asarray(0), jax.core.Tracer):
+        chunk, tracker = _watchdog_groups(wp, tile, kind, momentum)
+    if chunk <= 0 or chunk >= g:
+        w, rows = core(wp, xg, tg, vg, kind, momentum, alpha=alpha,
+                       delta=delta, lr=lr, precision=precision,
+                       storage=storage, max_iter=max_iter)
+        return w, _stats_from_rows(_flatten_rows(rows, tile, s))
+    import time as _time
+
+    from .convergence import (_SYNC_EVERY, _SYNC_WARMUP, _WATCHDOG_SAFE_S)
+
+    w, parts, since = wp, [], []
+    lo, launches = 0, 0
+    t_sync = _time.perf_counter()
+    while lo < g:
+        w, rows = core(w, xg[lo:lo + chunk], tg[lo:lo + chunk],
+                       vg[lo:lo + chunk], kind, momentum, alpha=alpha,
+                       delta=delta, lr=lr, precision=precision,
+                       storage=storage, max_iter=max_iter)
+        parts.append(rows)
+        since.append(rows)
+        lo += chunk
+        launches += 1
+        if tracker is not None and lo < g and (
+                launches <= _SYNC_WARMUP or launches % _SYNC_EVERY == 0):
+            # feed the measured iteration rate back (the AdaptiveChunker
+            # contract: a tracker that is never observed stays frozen at
+            # the pessimistic initial rate and the launches never grow).
+            # The per-lane n_iter sum UNDERcounts executed lockstep work
+            # (dead lanes still ride the masked GEMMs), which errs the
+            # safe way: the rate reads low, launches stay smaller.
+            iters = float(np.asarray(
+                sum(jnp.sum(r[..., 2]) for r in since)))
+            now = _time.perf_counter()
+            tracker.observe(iters, now - t_sync)
+            t_sync, since = now, []
+            grown = int(tracker.rate * _WATCHDOG_SAFE_S
+                        / (tile * tracker.worst))
+            if grown > chunk:
+                # pow2 snap keeps the set of compiled launch shapes small
+                chunk = 1 << (grown.bit_length() - 1)
+    return w, _stats_from_rows(
+        _flatten_rows(jnp.concatenate(parts), tile, s))
+
+
+def _pallas_ok(dtype) -> bool:
+    """The ONE Pallas routing gate (ops._use_pallas): TPU backend, no
+    HPNN_NO_PALLAS, f32/bf16 -- delegated so the per-sample and tiled
+    engines can never split on a future gate change."""
+    from . import _use_pallas
+
+    return _use_pallas(dtype)
+
+
+def _watchdog_groups(weights, tile: int, kind: str, momentum: bool):
+    """(groups-per-launch, tracker) under the ~60 s TPU watchdog, sized
+    worst-case from the measured iteration rate (the AdaptiveChunker
+    invariant at group granularity: even if EVERY lane of every group
+    runs to the kind's MAX_ITER, the launch stays inside the safe
+    window).  The caller feeds measured launches back through
+    ``tracker.observe`` so the rate -- persistent per (shapes, kind,
+    momentum, tile) -- ramps off the pessimistic initial estimate."""
+    from .convergence import _WATCHDOG_SAFE_S, _get_chunker
+
+    tracker = _get_chunker([w.shape for w in weights], kind, momentum,
+                           route=f"tile{tile}")
+    per_group_worst = tile * tracker.worst
+    return (max(1, int(tracker.rate * _WATCHDOG_SAFE_S / per_group_worst)),
+            tracker)

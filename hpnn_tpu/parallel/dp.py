@@ -33,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops import steps
-from .mesh import batch_sharding, replicated
+from .mesh import DATA_AXIS, batch_sharding, replicated
 
 
 def batched_grads(weights, xs, ts, kind: str, mask=None):
@@ -155,6 +155,49 @@ def dp_train_epoch(weights, xs, ts, kind: str, momentum: bool,
     mb = mask.reshape(n_batches, bsz)
     return dp_train_epoch_batched(weights, xb, tb, mb, kind, momentum,
                                   lr, alpha=alpha, mesh=mesh)
+
+
+def dp_tiled_epoch(weights, xs, ts, kind: str, momentum: bool, group: int,
+                   lr=None, alpha=0.2, mesh=None, launch_groups: int = 0,
+                   storage=None, route=None):
+    """[batch]-route convergence engine (ISSUE 6): every [batch]-sized
+    group of samples trains TO CONVERGENCE in lockstep with per-lane
+    masking (``ops.convergence_tile``), instead of taking one minibatch
+    SGD step.  Per-sample iteration counts and ``SampleStats`` stay
+    exact -- the per-sample console grammar applies again.
+
+    The group's lane rows shard over the mesh's data axis: each layer's
+    ``(S, M) @ (M, N)`` forward runs as a local shard matmul against
+    replicated weights and the ``d^T @ h`` update contraction
+    all-reduces over ICI -- GSPMD compiles both from the same sharding
+    constraints ``dp_train_epoch_batched`` uses.  A mesh therefore
+    pins the tiled engine to its XLA route (``resolve_route``): the
+    single-device Pallas program cannot carry GSPMD shardings, and
+    silently skipping the mesh there would claim a sharding that never
+    happens.  Under a mesh the group is padded up to a multiple of the
+    data-axis size with masked-out lanes (they never train -- the dp
+    padding rule).
+
+    ``launch_groups`` is EXECUTION granularity only -- how many groups
+    ride one device launch.  Groups are sequential and the weights
+    carry launch-to-launch on device, so ``SampleStats`` and the final
+    weights are IDENTICAL for any launch tiling (pinned in
+    tests/test_tile_convergence.py).
+    """
+    from ..ops.convergence_tile import train_epoch_tiled
+
+    tile = max(1, int(group))
+    lane_tile = tile
+    if mesh is not None:
+        # lane rows must divide the data axis: pad each group with
+        # masked-out lanes (they never train), NOT with real rows --
+        # grouping is semantic on this route
+        n_data = mesh.shape[DATA_AXIS]
+        lane_tile = -(-tile // n_data) * n_data
+    return train_epoch_tiled(weights, xs, ts, kind, momentum, alpha=alpha,
+                             lr=lr, tile=tile, lane_tile=lane_tile,
+                             storage=storage, route=route, mesh=mesh,
+                             launch_groups=launch_groups)
 
 
 @functools.partial(jax.jit, static_argnames=("kind", "mesh"))
