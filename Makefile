@@ -21,10 +21,11 @@ MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_tile_convergence.py
 SERVE_TESTS = tests/test_serve.py
 CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
+JOBS_TESTS = tests/test_jobs.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
-	    $(CKPT_TESTS) -q
+	    $(CKPT_TESTS) $(JOBS_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -38,6 +39,20 @@ serve-check:
 # epoch-pipeline parity pins (pipeline on == HPNN_NO_EPOCH_PIPELINE=1)
 ckpt-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(CKPT_TESTS) -q
+
+# online-training tier: job store/queue/auth/A-B units + the full e2e
+# acceptance (submit over HTTP -> per-epoch hot swaps under concurrent
+# eval traffic, zero non-200s, kernel.opt byte-identical to offline
+# train_nn for BP and BPM, cancel/resume, graceful drain)
+jobs-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(JOBS_TESTS) -q
+
+# train-while-serving latency capture: eval p99 with vs without a
+# concurrent training job, >= 3 generation swaps, swap-window error
+# rate must be 0; emits JOBS_BENCH.json, rc!=0 when a floor misses
+jobs-bench:
+	env JAX_PLATFORMS=cpu python scripts/jobs_bench.py \
+	    --out JOBS_BENCH.json
 
 # snapshot overhead (sync vs async io_pool writes) + hot-reload latency
 # under a client load; emits CKPT_BENCH.json
@@ -93,5 +108,5 @@ mfu-bench:
 	python scripts/mfu_bench.py --out MFU_BENCH.json \
 	    $(if $(REAL),--real)
 
-.PHONY: check check-all serve-check ckpt-check ckpt-bench native bench \
-    serve-bench io-bench epoch-bench mfu-bench
+.PHONY: check check-all serve-check ckpt-check ckpt-bench jobs-check \
+    jobs-bench native bench serve-bench io-bench epoch-bench mfu-bench

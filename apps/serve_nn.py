@@ -7,11 +7,17 @@ Usage: serve_nn [-v]... [-a addr] [-p port] [-b max-batch] [-q queue-rows]
                 [--compile-cache DIR]
                 [--warmup-mode background|sync|off] [--no-warmup]
                 [--watch-ckpt [NAME=]DIR] [--watch-interval S]
+                [--jobs N] [--job-dir DIR] [--ab-fraction F]
+                [--auth-token TOKEN]
                 [conf (default ./nn.conf)]...
 
 Takes the same nn.conf files as run_nn; see hpnn_tpu/serve/ and the
 README "Serving" section (incl. "Throughput vs parity") for endpoints,
-backpressure semantics, and the parity/mesh policy knobs.
+backpressure semantics, and the parity/mesh policy knobs.  With
+``--jobs N`` the server also trains: POST /v1/kernels/<name>/train
+submits an online training job (hpnn_tpu/jobs) whose epoch-boundary
+snapshots hot-swap into serving with A/B generation pinning -- the
+README "Online training service" section has the walkthrough.
 """
 import os
 import sys

@@ -1287,6 +1287,94 @@ def run_kernel(nn: NNDef) -> None:
                 nn_cout(f" [FAIL idx={target + 1}]\n")
 
 
+def train_job(conf_path: str, *, epochs: int, ckpt_dir: str,
+              ckpt_every: int = 1, ckpt_keep: int = 0,
+              kernel_out: str | None = None, resume: str | None = None,
+              stop=None, on_epoch=None) -> dict:
+    """Reentrant in-process training entry (the jobs subsystem's driver).
+
+    The exact ``train_nn`` checkpoint path -- configure, multi-epoch
+    ``ckpt.train_loop`` with crash-safe snapshots, final kernel dump +
+    manifest stamp -- minus every process-global side effect the CLI
+    owns: no runtime init/deinit, no cwd-relative ``kernel.tmp``/
+    ``kernel.opt`` (the caller names ``kernel_out`` absolutely), no
+    stderr writes, no signal handlers unless running on the main
+    thread.  That is what makes it safe to call from a serve-process
+    worker thread while eval traffic runs -- and what makes the parity
+    contract literal: the same conf/corpus/seed produces a
+    byte-identical kernel to the offline CLI (pinned in
+    tests/test_jobs.py).
+
+    ``resume`` names a checkpoint dir/bundle to continue bit-exactly
+    (the ``--resume`` semantics: weights, BPM momentum, shuffle-RNG
+    words and epoch counter restored).  ``stop``/``on_epoch`` pass
+    through to :func:`ckpt.trainer.train_loop` (external cancel +
+    epoch-boundary callback).
+
+    Returns ``{"ok", "interrupted", "epoch", "errors", "error"}`` --
+    never raises for config/corpus problems (the scheduler maps the
+    dict to a job status); checkpoint WRITER failures do raise, exactly
+    like the CLI's flush-before-done contract.
+    """
+    from .ckpt import CheckpointManager, load_snapshot, train_loop
+    from .io.kernel_io import dump_kernel_to_path
+
+    def fail(msg: str) -> dict:
+        return {"ok": False, "interrupted": False, "epoch": 0,
+                "errors": [], "error": msg}
+
+    nn = configure(conf_path)
+    if nn is None or nn.kernel is None:
+        return fail(f"cannot read NN configuration {conf_path}")
+    snap = None
+    start_epoch = 0
+    if resume:
+        snap = load_snapshot(resume)
+        if snap is None:
+            return fail(f"no resumable snapshot at {resume}")
+        if snap.topology != list(nn.kernel.params):
+            return fail(f"snapshot topology {snap.topology} does not "
+                        f"match the configured kernel "
+                        f"{list(nn.kernel.params)}")
+        nn.kernel.weights = list(snap.weights)
+        nn.conf.seed = snap.seed
+        start_epoch = snap.epoch
+    mgr = CheckpointManager(ckpt_dir, every=ckpt_every,
+                            keep_last=ckpt_keep, target_epochs=epochs)
+    if snap is not None:
+        mgr.seed_errors(snap.errors)
+    if start_epoch >= epochs:
+        # nothing left to train (e.g. resuming a job interrupted during
+        # its final epoch): finalize exactly like a completed run -- the
+        # CLI always dumps kernel.opt, and record_final's generation
+        # bump is what tells watchers the run ended
+        if kernel_out:
+            dump_kernel_to_path(nn.kernel, kernel_out)
+            mgr.record_final(kernel_out)
+        else:
+            mgr.flush()
+        return {"ok": True, "interrupted": False, "epoch": start_epoch,
+                "errors": list(mgr.errors), "error": None}
+    trained, interrupted = train_loop(
+        nn, epochs, manager=mgr, start_epoch=start_epoch,
+        rng_state=snap.rng_state if snap is not None else None,
+        stop=stop, on_epoch=on_epoch)
+    if not trained:
+        mgr.flush()
+        return fail("training failed")
+    if kernel_out:
+        # interrupted runs dump too, exactly like the CLI: kernel_out
+        # always holds the LAST trained state, and record_final's
+        # generation bump is what tells watchers the run ended
+        dump_kernel_to_path(nn.kernel, kernel_out)
+        mgr.record_final(kernel_out)
+    else:
+        mgr.flush()
+    return {"ok": True, "interrupted": bool(interrupted),
+            "epoch": len(mgr.errors), "errors": list(mgr.errors),
+            "error": None}
+
+
 def dump_kernel_def(nn: NNDef, fp) -> bool:
     """_NN(dump,kernel) (libhpnn.c:996-1008)."""
     if nn.kernel is None:

@@ -67,9 +67,24 @@ def _restore_handlers(prev) -> None:
 
 def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
                start_epoch: int = 0,
-               rng_state: list[int] | None = None) -> tuple[bool, bool]:
+               rng_state: list[int] | None = None,
+               stop: threading.Event | None = None,
+               on_epoch=None) -> tuple[bool, bool]:
     """Run epochs ``start_epoch+1 .. epochs``; returns
     ``(trained_ok, interrupted)``.
+
+    ``stop`` (jobs subsystem): an EXTERNAL stop event shared with the
+    caller -- a job cancel or a server drain latches it exactly like a
+    SIGTERM would, the in-flight epoch finishes and the final snapshot
+    is written; without one the loop owns a private event wired to the
+    signal handlers (the train_nn behavior, unchanged).
+
+    ``on_epoch(epoch, manager)`` is called at every epoch boundary
+    (after the epoch's checkpoint bookkeeping, before the interruption
+    checks): the jobs scheduler uses it to flush due snapshots into the
+    serving registry and to YIELD to queued eval traffic -- the
+    epoch-granularity time-slice of the shared device.  The callback
+    may block; it runs on the training thread.
 
     ``rng_state`` (from a snapshot) restores the shuffle stream;
     otherwise the stream starts fresh from ``conf.seed`` (seed 0 ->
@@ -101,7 +116,8 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
 
     kill_at = int(os.environ.get("HPNN_CKPT_KILL_AT_EPOCH", "0") or 0)
     banner = epochs > 1 or start_epoch > 0
-    stop = threading.Event()
+    if stop is None:
+        stop = threading.Event()
     prev_handlers = _install_handlers(stop)
     interrupted = False
     last_epoch = start_epoch
@@ -145,6 +161,8 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
                 mean_err = stats.get("mean_final") if stats else None
                 if manager is not None:
                     manager.epoch_done(nn, epoch, mean_err)
+            if on_epoch is not None:
+                on_epoch(epoch, manager)
             if kill_at and epoch == kill_at and epoch < epochs:
                 # exercise the REAL signal path at a deterministic
                 # boundary (test hook; see module docstring)

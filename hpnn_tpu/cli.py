@@ -499,6 +499,29 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--watch-interval", type=float, default=2.0,
                     metavar="S", help="manifest poll period in seconds "
                     "(default 2.0)")
+    ap.add_argument("--jobs", type=int, default=0, metavar="N",
+                    help="enable the online training service with an "
+                    "N-job bounded queue (POST /v1/kernels/<name>/train; "
+                    "0: disabled).  One scheduler worker time-slices the "
+                    "device against eval traffic at epoch granularity "
+                    "and hot-swaps every epoch-boundary snapshot into "
+                    "serving")
+    ap.add_argument("--job-dir", default="./jobs", metavar="DIR",
+                    help="persistent job state/corpus/checkpoint root "
+                    "(default ./jobs); a restarted server reports the "
+                    "directory's job history")
+    ap.add_argument("--ab-fraction", type=float, default=0.0,
+                    metavar="F",
+                    help="A/B generation pinning: during a hot swap this "
+                    "fraction of unpinned traffic keeps routing to the "
+                    "previous weights generation until the job's "
+                    "promote/rollback endpoint finalizes (0: every swap "
+                    "is immediate; X-HPNN-Generation pins per request "
+                    "either way)")
+    ap.add_argument("--auth-token", default=None, metavar="TOKEN",
+                    help="require this bearer token (or X-HPNN-Token) on "
+                    "every mutating endpoint: reload, train submits, job "
+                    "actions.  Default: $HPNN_SERVE_TOKEN; unset = open")
     args = ap.parse_args(argv)
 
     from .serve.server import ServeApp, make_server
@@ -514,13 +537,22 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         # init_all, so restart warmup hits the on-disk cache
         runtime.enable_compilation_cache(args.compile_cache)
     warmup_mode = "off" if args.no_warmup else args.warmup_mode
+    if not 0.0 <= args.ab_fraction <= 1.0:
+        sys.stderr.write(f"--ab-fraction must be in [0, 1]: "
+                         f"{args.ab_fraction} (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    auth_token = args.auth_token or os.environ.get("HPNN_SERVE_TOKEN") \
+        or None
     app = ServeApp(max_batch=args.max_batch,
                    max_queue_rows=args.queue_rows,
                    linger_s=args.linger_ms / 1e3,
                    default_timeout_s=args.timeout_s,
                    parity=args.parity,
                    fast_threshold=args.fast_threshold,
-                   mesh_devices=(None if args.mesh < 0 else args.mesh))
+                   mesh_devices=(None if args.mesh < 0 else args.mesh),
+                   auth_token=auth_token,
+                   ab_fraction=args.ab_fraction)
     n_ok = 0
     for conf in args.confs:
         with phase("register"):
@@ -555,18 +587,49 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
             runtime.deinit_all()
             return -1
         app.watch_manifest(wname, wdir, interval_s=args.watch_interval)
+    if args.jobs > 0:
+        app.enable_jobs(args.job_dir, capacity=args.jobs)
+        tok = "on" if auth_token else "OFF (pass --auth-token)"
+        sys.stdout.write(f"SERVE: online training enabled "
+                         f"(queue={args.jobs}, job-dir={args.job_dir}, "
+                         f"ab-fraction={args.ab_fraction:g}, "
+                         f"auth={tok})\n")
     httpd = make_server(args.addr, args.port, app)
     host, port = httpd.server_address[:2]
     # unconditional: the bound port is the serving contract (with -p 0
     # it is the only way a launcher learns where to point clients)
     sys.stdout.write(f"SERVE: listening on http://{host}:{port}\n")
     sys.stdout.flush()
+    # graceful drain (jobs satellite): SIGTERM/SIGINT stop the accept
+    # loop; the finally block then finishes the in-flight training
+    # epoch, snapshots, marks the job `interrupted` (resumable) and
+    # drains the eval batchers -- nothing admitted is dropped.
+    # shutdown() must run OFF this thread (it joins serve_forever).
+    import signal as _signal
+    import threading as _threading
+
+    def _drain_signal(signum, frame):
+        sys.stdout.write("SERVE: draining...\n")
+        sys.stdout.flush()
+        _threading.Thread(target=httpd.shutdown, daemon=True).start()
+
+    prev_handlers = {}
+    for _sig in (_signal.SIGTERM, _signal.SIGINT):
+        try:
+            prev_handlers[_sig] = _signal.signal(_sig, _drain_signal)
+        except (ValueError, OSError):  # pragma: no cover - non-main thread
+            pass
     try:
         httpd.serve_forever()
-    except KeyboardInterrupt:
+    except KeyboardInterrupt:  # pragma: no cover - handler owns SIGINT
         sys.stdout.write("SERVE: draining...\n")
         sys.stdout.flush()
     finally:
+        for _sig, old in prev_handlers.items():
+            try:
+                _signal.signal(_sig, old)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
         httpd.shutdown()
         app.close(drain=True)
         runtime.deinit_all()

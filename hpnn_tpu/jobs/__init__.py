@@ -1,0 +1,36 @@
+"""Online training service: train-while-serving job subsystem.
+
+The paper's premise -- "train the ANN while the host program runs" --
+as a service embedded in the serve process: ``POST
+/v1/kernels/<name>/train`` submits a training job into a bounded queue,
+one scheduler worker time-slices the device against the micro-batching
+eval queue at epoch granularity, every epoch-boundary snapshot
+hot-reloads into the serving registry (with A/B generation pinning),
+and job state persists through ``io/atomic.py`` so a restarted server
+reports its full history.
+
+* :mod:`state`     -- persistent :class:`JobState` records + the
+  directory-backed :class:`JobStore` (crash recovery to ``interrupted``);
+* :mod:`queue`     -- the bounded FIFO :class:`JobQueue`
+  (:class:`JobQueueFull` -> HTTP 429);
+* :mod:`scheduler` -- the :class:`JobScheduler` worker: reentrant
+  ``api.train_job`` runs, epoch-boundary snapshot/reload/yield,
+  cancel + graceful drain (ckpt signal machinery reused).
+"""
+
+from .queue import JobQueue, JobQueueFull
+from .scheduler import JobScheduler
+from .state import (
+    ACTIVE_STATES,
+    JOB_STATES,
+    TERMINAL_STATES,
+    JobError,
+    JobState,
+    JobStore,
+)
+
+__all__ = [
+    "ACTIVE_STATES", "JOB_STATES", "TERMINAL_STATES",
+    "JobError", "JobQueue", "JobQueueFull", "JobScheduler",
+    "JobState", "JobStore",
+]

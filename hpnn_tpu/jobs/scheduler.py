@@ -1,0 +1,442 @@
+"""Job scheduler: train-while-serving on one shared device.
+
+One worker thread drains the bounded :class:`~.queue.JobQueue` strictly
+FIFO and drives each job through the REENTRANT training entry
+(``api.train_job`` -- the same configure/train_loop/checkpoint path
+``train_nn`` runs, so a job's ``kernel.opt`` is byte-identical to the
+offline CLI run of the same conf/corpus/seed).  Device sharing is
+cooperative and epoch-granular:
+
+* the trainer calls back at EVERY epoch boundary (``on_epoch``); the
+  scheduler updates the persistent job record, flushes the due snapshot,
+  hot-reloads the published bundle into the serving registry (the same
+  manifest-generation machinery ``--watch-ckpt`` polls, driven
+  synchronously here so a swap lands the moment its bundle is durable),
+  and then YIELDS: while eval traffic is queued on any batcher, the next
+  epoch waits (bounded by ``preempt_wait_s``) -- serve traffic preempts
+  training between epochs, never the reverse;
+* cancel and graceful drain both latch the job's stop event; the
+  in-flight epoch finishes, the checkpoint manager writes a final
+  snapshot (the ckpt subsystem's signal machinery, reused verbatim), and
+  the job lands ``cancelled`` or ``interrupted`` -- resumable through
+  ``resume_job`` submits or an offline ``train_nn --resume``.
+
+The scheduler never touches the device directly: training goes through
+the epoch pipeline, eval through the batchers, and the only
+coordination between them is the epoch-boundary yield -- which is
+exactly the granularity at which the two workloads' jit programs can
+interleave without either preempting a launch.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..utils import nn_log
+from ..utils.nn_log import nn_out, nn_warn
+from .queue import JobQueue, JobQueueFull
+from .state import (
+    JOB_CONSOLE,
+    JOB_CORPUS,
+    TERMINAL_STATES,
+    JobError,
+    JobState,
+    JobStore,
+)
+
+__all__ = ["JobScheduler", "JobQueueFull", "JobError"]
+
+_TRAINERS = ("BP", "BPM")
+_DTYPES = ("f64", "f32", "bf16")
+_TYPES = ("ANN", "SNN")
+
+# console.log prefixes per captured nn_log level (replay-equivalent at
+# the verbosity the entries were captured under)
+_LOG_PREFIX = {"dbg": "NN(DBG): ", "out": "NN: ", "cout": "",
+               "warn": "NN(WARN): ", "error": "NN(ERR): ", "raw": ""}
+
+
+def _as_int(params: dict, key: str, default: int, floor: int = 0) -> int:
+    v = params.get(key, default)
+    try:
+        v = int(v)
+    except (TypeError, ValueError):
+        raise JobError(f"'{key}' must be an integer: {v!r}")
+    if v < floor:
+        raise JobError(f"'{key}' must be >= {floor}: {v}")
+    return v
+
+
+class JobScheduler:
+    def __init__(self, app, job_dir: str, capacity: int = 8,
+                 preempt_wait_s: float = 2.0):
+        self.app = app
+        self.store = JobStore(job_dir)
+        recovered = self.store.recover()
+        if recovered:
+            nn_out(f"jobs: recovered {len(recovered)} interrupted "
+                   f"job(s) from {job_dir}: {', '.join(recovered)}\n")
+        self.queue = JobQueue(capacity)
+        self.preempt_wait_s = float(preempt_wait_s)
+        self._mu = threading.Lock()
+        self._current: JobState | None = None
+        self._current_stop: threading.Event | None = None
+        self._cancel_requested = False
+        self._pending_cancel: set[str] = set()
+        self._draining = False
+        self._paused = False
+        self._closed = False
+        self._thread = threading.Thread(target=self._loop,
+                                        name="hpnn-job-scheduler",
+                                        daemon=True)
+        self._thread.start()
+
+    # --- submission ------------------------------------------------------
+    def submit(self, kernel: str, params: dict,
+               corpus_files: list[tuple[str, bytes]] | None = None
+               ) -> JobState:
+        """Validate, materialize the job dir (conf + uploaded corpus) and
+        enqueue.  Raises :class:`JobError` (HTTP 400) on bad parameters,
+        :class:`JobQueueFull` (429) when the queue is at capacity."""
+        model = self.app.registry.get(kernel)
+        if model is None:
+            raise JobError(f"unknown kernel '{kernel}'")
+        if not isinstance(params, dict):
+            raise JobError("params must be a JSON object")
+        if self.queue.depth() >= self.queue.capacity:
+            # reject BEFORE creating the job dir: a 429 must leave no
+            # half-registered job behind
+            raise JobQueueFull(
+                f"job queue at {self.queue.depth()}/{self.queue.capacity}")
+        clean = self._sanitize(model, params, corpus_files)
+        job = self.store.create(kernel, clean)
+        try:
+            if corpus_files:
+                cdir = os.path.join(job.path, JOB_CORPUS)
+                os.makedirs(cdir, exist_ok=True)
+                for name, data in corpus_files:
+                    base = os.path.basename(name)
+                    if not base or base.startswith("."):
+                        raise JobError(f"bad corpus file name {name!r}")
+                    with open(os.path.join(cdir, base), "wb") as fp:
+                        fp.write(data)
+                clean["samples"] = cdir
+            job.epochs = clean["epochs"]
+            job.start_epoch = clean.get("start_epoch", 0)
+            job.epoch = job.start_epoch
+            job.resumed_from = clean.get("resumed_from")
+            self._write_conf(job, model, clean)
+            self.store.update(job)
+            self.queue.submit(job)
+        except Exception:
+            # the job never ran -- a failed admission (429 racing the
+            # pre-check, bad upload name, closed queue) must leave no
+            # phantom record or directory behind
+            self.store.discard(job)
+            raise
+        nn_out(f"jobs: {job.job_id} queued for kernel '{kernel}' "
+               f"({clean['epochs']} epoch(s), train={clean['train']})\n")
+        return job
+
+    def _sanitize(self, model, params: dict,
+                  corpus_files) -> dict:
+        clean: dict = {}
+        clean["epochs"] = _as_int(params, "epochs", 1, floor=1)
+        clean["ckpt_every"] = _as_int(params, "ckpt_every", 1)
+        clean["ckpt_keep"] = _as_int(params, "ckpt_keep", 0)
+        clean["seed"] = _as_int(params, "seed", 1)
+        train = str(params.get("train") or model.nn.conf.train
+                    or "BP").upper()
+        if train not in _TRAINERS:
+            raise JobError(f"'train' must be one of {_TRAINERS}: {train}")
+        clean["train"] = train
+        ktype = str(params.get("type") or model.kind).upper()
+        if ktype not in _TYPES:
+            raise JobError(f"'type' must be one of {_TYPES}: {ktype}")
+        clean["type"] = ktype
+        dtype = str(params.get("dtype") or model.dtype_name)
+        if dtype not in _DTYPES:
+            raise JobError(f"'dtype' must be one of {_DTYPES}: {dtype}")
+        clean["dtype"] = dtype
+        hidden = params.get("hidden", list(model.topology[1:-1]))
+        if isinstance(hidden, int):
+            hidden = [hidden]
+        try:
+            hidden = [int(h) for h in hidden]
+        except (TypeError, ValueError):
+            raise JobError(f"'hidden' must be int(s): {hidden!r}")
+        if not hidden or any(h < 1 for h in hidden):
+            raise JobError(f"'hidden' layers must be >= 1: {hidden}")
+        clean["hidden"] = hidden
+        resume_id = params.get("resume_job")
+        if resume_id:
+            prev = self.store.get(str(resume_id))
+            if prev is None:
+                raise JobError(f"unknown resume_job '{resume_id}'")
+            if not prev.resumable:
+                raise JobError(
+                    f"job '{resume_id}' is not resumable "
+                    f"(status {prev.status})")
+            clean["resumed_from"] = prev.job_id
+            # continue the prior job's checkpoint history (one run, one
+            # manifest -- train_nn --resume PATH semantics) and, by
+            # default, its corpus and goal
+            clean["ckpt_dir"] = prev.ckpt_dir
+            clean["start_epoch"] = prev.epoch
+            clean.setdefault("samples", prev.params.get("samples"))
+            if "epochs" not in params:
+                clean["epochs"] = max(prev.epochs, prev.epoch)
+        if corpus_files:
+            if params.get("samples"):
+                raise JobError(
+                    "pass a server-side 'samples' path OR upload corpus "
+                    "files, not both")
+        else:
+            # an explicit submit-time path overrides the resumed job's
+            # inherited corpus
+            samples = params.get("samples") or clean.get("samples")
+            if not samples:
+                raise JobError("missing 'samples' (server-side corpus "
+                               "path) or a multipart corpus upload")
+            samples = os.path.abspath(str(samples))
+            if not os.path.isdir(samples):
+                raise JobError(f"'samples' is not a directory: {samples}")
+            clean["samples"] = samples
+        return clean
+
+    def _write_conf(self, job: JobState, model, clean: dict) -> None:
+        """The generated train_nn conf -- the SAME grammar the offline
+        CLI parses, so the parity contract is literal: train_nn on this
+        file reproduces the job byte-for-byte."""
+        lines = [
+            f"[name] {job.kernel}",
+            f"[type] {clean['type']}",
+            "[init] generate",
+            f"[seed] {clean['seed']}",
+            f"[input] {model.n_inputs}",
+            "[hidden] " + " ".join(str(h) for h in clean["hidden"]),
+            f"[output] {model.n_outputs}",
+            f"[train] {clean['train']}",
+            f"[dtype] {clean['dtype']}",
+            f"[sample_dir] {clean['samples']}",
+        ]
+        with open(job.conf_path, "w") as fp:
+            fp.write("\n".join(lines) + "\n")
+
+    # --- worker -----------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._closed:
+            job = self.queue.take(timeout_s=0.1)
+            if job is None:
+                continue
+            if self._paused:
+                # pause() may land while this thread is parked in
+                # take(): hand the job back untouched instead of
+                # running it behind the pause
+                self.queue.requeue_front(job)
+                time.sleep(0.02)
+                continue
+            with self._mu:
+                if self._closed or self._draining:
+                    # the server is going down: the queued job never ran,
+                    # leave it resumable instead of silently dropping it
+                    self._pending_cancel.discard(job.job_id)
+                    self.store.update(job, status="interrupted",
+                                      error="server shutdown before run",
+                                      finished=time.time())
+                    continue
+                self._current = job
+                self._current_stop = threading.Event()
+                self._cancel_requested = False
+                if job.job_id in self._pending_cancel:
+                    # a cancel latched while the job was between the
+                    # queue and this install: honor it now
+                    self._pending_cancel.discard(job.job_id)
+                    self._cancel_requested = True
+                    self._current_stop.set()
+            try:
+                self._run_job(job, self._current_stop)
+            except Exception as exc:  # noqa: BLE001 -- job isolation:
+                # one broken job must not kill the scheduler
+                nn_warn(f"jobs: {job.job_id} failed: {exc}\n")
+                self.store.update(job, status="failed",
+                                  error=f"{type(exc).__name__}: {exc}",
+                                  finished=time.time())
+            finally:
+                with self._mu:
+                    self._current = None
+                    self._current_stop = None
+                    # a cancel that raced job completion leaves a stale
+                    # latch -- the job is terminal, drop it
+                    self._pending_cancel.discard(job.job_id)
+
+    def _run_job(self, job: JobState, stop: threading.Event) -> None:
+        from ..api import train_job
+
+        self.store.update(job, status="running", started=time.time())
+        ckpt_dir = job.ckpt_dir
+        watch_state = {"gen": 0}
+        resume = (job.resumed_from and ckpt_dir) or None
+
+        def on_epoch(epoch: int, manager) -> None:
+            due = (manager is not None and manager.every
+                   and epoch % manager.every == 0) or epoch >= job.epochs
+            errors = list(manager.errors) if manager is not None else []
+            if due and manager is not None:
+                # snapshotting: the async bundle write must be durable
+                # before the registry swaps it in
+                self.store.update(job, status="snapshotting",
+                                  epoch=epoch, errors=errors)
+                manager.flush()
+                self._reload_into_serving(job, ckpt_dir, watch_state)
+                self.store.update(job, status="running")
+            else:
+                self.store.update(job, epoch=epoch, errors=errors)
+            self._yield_to_eval(stop)
+
+        entries: list = []
+        with nn_log.capture(entries):
+            result = train_job(
+                job.conf_path, epochs=job.epochs, ckpt_dir=ckpt_dir,
+                ckpt_every=job.params.get("ckpt_every", 1),
+                ckpt_keep=job.params.get("ckpt_keep", 0),
+                kernel_out=job.kernel_out, resume=resume,
+                stop=stop, on_epoch=on_epoch)
+        self._write_console(job, entries)
+        # record_final bumped the manifest generation: swap the finished
+        # kernel in (same weights as the last bundle, but the bump keeps
+        # any external --watch-ckpt watcher coherent with us)
+        self._reload_into_serving(job, ckpt_dir, watch_state)
+        if not result["ok"]:
+            status, error = "failed", result["error"]
+        elif result["interrupted"]:
+            status = "cancelled" if self._cancel_requested else "interrupted"
+            error = None
+        else:
+            status, error = "done", None
+        self.store.update(job, status=status, error=error,
+                          epoch=result["epoch"],
+                          errors=list(result["errors"]),
+                          finished=time.time())
+        nn_out(f"jobs: {job.job_id} {status} at epoch "
+               f"{result['epoch']}/{job.epochs}\n")
+
+    def _reload_into_serving(self, job: JobState, ckpt_dir: str,
+                             watch_state: dict) -> None:
+        result = self.app.poll_ckpt_reload(job.kernel, ckpt_dir,
+                                           watch_state)
+        if result is not None:
+            self.store.update(job, generations=job.generations
+                              + [int(result["generation"])])
+
+    def _yield_to_eval(self, stop: threading.Event) -> None:
+        """The preemption gate: while eval traffic is queued, the next
+        epoch waits (bounded) -- serving latency beats training
+        throughput on a shared device."""
+        deadline = time.monotonic() + self.preempt_wait_s
+        while not stop.is_set() and time.monotonic() < deadline:
+            depths = [b.depth() for b in self.app.batchers.values()]
+            if not any(depths):
+                return
+            time.sleep(0.001)
+
+    def _write_console(self, job: JobState, entries: list) -> None:
+        try:
+            with open(os.path.join(job.path, JOB_CONSOLE), "w") as fp:
+                for level, text in entries:
+                    fp.write(_LOG_PREFIX.get(level, "") + text)
+        except OSError:
+            pass  # the log is a convenience, never a failure
+
+    # --- control ----------------------------------------------------------
+    def get(self, job_id: str) -> dict | None:
+        return self.store.snapshot(job_id)
+
+    def list(self) -> list[dict]:
+        return self.store.list()
+
+    def cancel(self, job_id: str) -> dict:
+        """Cancel a queued job immediately, or latch the running job's
+        stop event (the in-flight epoch finishes, a final snapshot is
+        written, the job lands ``cancelled`` -- resumable)."""
+        job = self.store.get(job_id)
+        if job is None:
+            raise KeyError(job_id)
+        if self.queue.remove(job_id):
+            self.store.update(job, status="cancelled",
+                              error="cancelled while queued",
+                              finished=time.time())
+            return self.store.snapshot(job_id)
+        with self._mu:
+            if self._current is not None \
+                    and self._current.job_id == job_id:
+                self._cancel_requested = True
+                self._current_stop.set()
+                return self.store.snapshot(job_id)
+            if job.status not in TERMINAL_STATES:
+                # TOCTOU window: the worker popped the job from the
+                # queue but has not installed it as _current yet (or
+                # pause() is cycling it through requeue_front).  Latch
+                # the cancel; the worker honors it at install time.
+                self._pending_cancel.add(job_id)
+                return self.store.snapshot(job_id)
+        raise JobError(f"job '{job_id}' already {job.status}")
+
+    def finalize(self, job_id: str, how: str) -> None:
+        job = self.store.get(job_id)
+        if job is not None:
+            self.store.update(job, finalized=how)
+
+    def pause(self) -> None:
+        """Hold the worker between jobs (queue keeps admitting) -- test
+        / operations hook, same spirit as MicroBatcher.pause."""
+        self._paused = True
+
+    def resume(self) -> None:
+        self._paused = False
+
+    def drain(self, timeout_s: float = 120.0) -> None:
+        """Graceful shutdown: stop admitting, latch the running job's
+        stop event (finish the in-flight epoch + final snapshot, mark it
+        ``interrupted``), park queued jobs as interrupted/resumable."""
+        with self._mu:
+            self._draining = True
+            if self._current_stop is not None:
+                self._current_stop.set()
+        self.queue.close()
+        self._closed = True
+        self._thread.join(timeout=timeout_s)
+        if self._thread.is_alive():  # pragma: no cover - watchdog only
+            nn_warn("jobs: scheduler did not drain in time\n")
+        # anything still queued never ran: park it resumable
+        while True:
+            job = self.queue.take(timeout_s=0.0)
+            if job is None:
+                break
+            self.store.update(job, status="interrupted",
+                              error="server shutdown before run",
+                              finished=time.time())
+
+    # --- observability ----------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        with self._mu:
+            cur = self._current
+            running = None
+            if cur is not None:
+                snap = self.store.snapshot(cur.job_id) or {}
+                errs = snap.get("errors") or []
+                running = {
+                    "job": cur.job_id,
+                    "kernel": snap.get("kernel"),
+                    "epoch": snap.get("epoch", 0),
+                    "epochs": snap.get("epochs", 0),
+                    "mean_err": errs[-1] if errs else None,
+                }
+        return {
+            "queue_depth": self.queue.depth(),
+            "running": running,
+            "by_status": self.store.by_status(),
+            "trained_epochs_total": self.store.trained_epochs(),
+        }

@@ -63,13 +63,17 @@ class ServeClosed(Exception):
 
 
 class _Pending:
-    __slots__ = ("xs", "rows", "deadline", "t_enq", "t_dispatch",
-                 "event", "result", "error")
+    __slots__ = ("xs", "rows", "deadline", "gen", "served_gen", "t_enq",
+                 "t_dispatch", "event", "result", "error")
 
-    def __init__(self, xs: np.ndarray, deadline: float):
+    def __init__(self, xs: np.ndarray, deadline: float,
+                 gen: int | None = None):
         self.xs = xs
         self.rows = xs.shape[0]
         self.deadline = deadline
+        self.gen = gen            # pinned model generation (A/B), or None
+        self.served_gen = gen     # generation that actually served it
+        #                           (captured at dispatch for unpinned)
         self.t_enq = time.monotonic()
         self.t_dispatch = 0.0
         self.event = threading.Event()
@@ -118,15 +122,21 @@ class MicroBatcher:
             self._cv.notify_all()
 
     # --- client side ----------------------------------------------------
-    def submit(self, xs: np.ndarray, timeout_s: float) -> np.ndarray:
+    def submit(self, xs: np.ndarray, timeout_s: float,
+               gen: int | None = None,
+               return_gen: bool = False) -> np.ndarray:
         """Enqueue (rows, n_inputs) float64 inputs and block until the
         batch containing them completes.  Raises QueueFull /
-        DeadlineExceeded / ServeClosed; any model exception propagates."""
+        DeadlineExceeded / ServeClosed; any model exception propagates.
+
+        ``gen`` pins the request to one model generation (A/B pinning):
+        the worker keeps batches generation-homogeneous, so a pinned
+        request can never ride a batch served by different weights."""
         rows = xs.shape[0]
         if not 1 <= rows <= self.max_batch:
             raise ValueError(
                 f"request rows {rows} outside [1, {self.max_batch}]")
-        p = _Pending(xs, time.monotonic() + timeout_s)
+        p = _Pending(xs, time.monotonic() + timeout_s, gen=gen)
         with self._cv:
             if self._closing:
                 raise ServeClosed(f"kernel '{self.model.name}' draining")
@@ -145,14 +155,19 @@ class MicroBatcher:
         if p.error is not None:
             raise p.error
         self.metrics.latency.observe(time.monotonic() - p.t_enq)
-        return p.result
+        return (p.result, p.served_gen) if return_gen else p.result
 
     # --- worker ---------------------------------------------------------
     def _pop_locked(self) -> list[_Pending]:
-        """Pop up to max_batch rows FIFO, never splitting a request.
+        """Pop up to max_batch rows FIFO, never splitting a request and
+        never mixing pinned generations in one batch (the launch serves
+        ONE weights tuple; a lane change ends the batch and the next
+        worker iteration picks the rest up -- FIFO order preserved).
         Caller holds the lock."""
         batch, rows = [], 0
         while self._q and rows + self._q[0].rows <= self.max_batch:
+            if batch and self._q[0].gen != batch[0].gen:
+                break
             p = self._q.popleft()
             rows += p.rows
             batch.append(p)
@@ -216,7 +231,14 @@ class MicroBatcher:
         xs = (live[0].xs if len(live) == 1
               else np.concatenate([p.xs for p in live]))
         try:
-            handle = self.model.registry.dispatch(self.model, xs)
+            # unpinned batches keep the two-argument call so registry
+            # stand-ins (tests, custom backends) need not know about
+            # generation pinning
+            if live[0].gen is None:
+                handle = self.model.registry.dispatch(self.model, xs)
+            else:
+                handle = self.model.registry.dispatch(self.model, xs,
+                                                      gen=live[0].gen)
         except Exception as exc:  # dispatch-time failure: fail the
             # batch's requests, keep serving the next one
             nn_warn(f"serve: batch dispatch failed for "
@@ -225,6 +247,18 @@ class MicroBatcher:
                 p.error = exc
                 p.event.set()
             return None
+        # record the generation the launch actually read, not whatever
+        # is current once the batch completes -- a job's epoch-boundary
+        # swap landing mid-batch (or pruning a pinned generation between
+        # admission and dispatch) must not misattribute these requests
+        # in the A/B counters or the response label
+        if live[0].gen is None:
+            g = getattr(self.model, "generation", 0)
+        else:
+            g = getattr(handle, "served_gen", None)
+            g = live[0].gen if g is None else g
+        for p in live:
+            p.served_gen = g
         return live, handle, now
 
     def _complete(self, inflight) -> None:

@@ -118,6 +118,12 @@ class ServeMetrics:
         # weight swaps happen
         self._model_info: dict[str, dict] = {}
         self.reloads = {"ok": 0, "error": 0}
+        # A/B routing: requests per (kernel, model generation) -- how a
+        # canary fraction is verified to actually receive traffic
+        self._gen_requests: dict[str, dict[str, int]] = {}
+        # jobs subsystem gauges, read through a callback at render time
+        # (like queue depth) so they can never go stale
+        self._jobs_fn: Callable[[], dict] | None = None
 
     # --- write side -----------------------------------------------------
     def count_request(self, outcome: str) -> None:
@@ -170,6 +176,31 @@ class ServeMetrics:
         with self._lock:
             self.reloads["ok" if ok else "error"] += 1
 
+    # newest generations kept as distinct labels per kernel; continuous
+    # online training mints one generation per epoch, so an uncapped map
+    # is a label-cardinality leak on any long-lived server
+    GEN_LABELS_KEPT = 16
+
+    def count_generation(self, kernel: str, generation: int) -> None:
+        """One request routed to ``generation`` of ``kernel`` (explicit
+        pin, A/B canary fraction, or the live current weights).  Counts
+        older than the newest :data:`GEN_LABELS_KEPT` generations fold
+        into one ``"older"`` bucket (totals are preserved)."""
+        with self._lock:
+            d = self._gen_requests.setdefault(kernel, {})
+            g = str(int(generation))
+            d[g] = d.get(g, 0) + 1
+            numeric = [k for k in d if k != "older"]
+            if len(numeric) > self.GEN_LABELS_KEPT:
+                for k in sorted(numeric, key=int)[:-self.GEN_LABELS_KEPT]:
+                    d["older"] = d.get("older", 0) + d.pop(k)
+
+    def set_jobs_source(self, fn: Callable[[], dict] | None) -> None:
+        """Attach the job scheduler's live metrics callback (queue
+        depth, running job epoch/error, cumulative trained epochs)."""
+        with self._lock:
+            self._jobs_fn = fn
+
     # --- read side ------------------------------------------------------
     def batch_fill_ratio(self) -> float:
         with self._lock:
@@ -194,6 +225,10 @@ class ServeMetrics:
         from ..io.samples import native_io_status
 
         depths = {name: fn() for name, fn in list(self._depth_fns.items())}
+        jobs_fn = self._jobs_fn
+        # the jobs callback takes the scheduler/store locks: call it
+        # OUTSIDE our own lock (no nested-lock ordering to get wrong)
+        jobs = jobs_fn() if jobs_fn is not None else None
         with self._lock:
             req = dict(self.requests)
             out = {
@@ -205,6 +240,9 @@ class ServeMetrics:
                 "models": {n: dict(v)
                            for n, v in self._model_info.items()},
                 "reloads": dict(self.reloads),
+                "generations": {k: dict(v)
+                                for k, v in self._gen_requests.items()},
+                "jobs": jobs,
                 # whether the native sample loader backs corpus ingestion
                 # (registration/warmup reload paths); "off" means the
                 # silent-fallback Python parser is doing the work
@@ -275,6 +313,56 @@ class ServeMetrics:
             lines.append(
                 "hpnn_serve_model_last_reload_timestamp_seconds"
                 f'{{kernel="{name}"}} {info["last_reload_ts"]}')
+        lines += [
+            "# HELP hpnn_serve_generation_requests_total Requests "
+            "routed per model generation (A/B pinning).",
+            "# TYPE hpnn_serve_generation_requests_total counter",
+        ]
+        for kernel, gens in sorted(snap["generations"].items()):
+            for gen, n in sorted(
+                    gens.items(),
+                    key=lambda kv: -1 if kv[0] == "older" else int(kv[0])):
+                lines.append(
+                    "hpnn_serve_generation_requests_total"
+                    f'{{kernel="{kernel}",generation="{gen}"}} {n}')
+        if snap.get("jobs") is not None:
+            j = snap["jobs"]
+            running = j.get("running") or {}
+            lines += [
+                "# HELP hpnn_jobs_queue_depth Training jobs queued.",
+                "# TYPE hpnn_jobs_queue_depth gauge",
+                f"hpnn_jobs_queue_depth {j['queue_depth']}",
+                "# HELP hpnn_jobs_running Whether a training job is "
+                "running (1) or the device serves eval only (0).",
+                "# TYPE hpnn_jobs_running gauge",
+                f"hpnn_jobs_running {1 if running else 0}",
+                "# HELP hpnn_jobs_trained_epochs_total Cumulative "
+                "epochs trained by the jobs subsystem.",
+                "# TYPE hpnn_jobs_trained_epochs_total counter",
+                f"hpnn_jobs_trained_epochs_total "
+                f"{j['trained_epochs_total']}",
+            ]
+            if running:
+                lines += [
+                    "# HELP hpnn_jobs_running_epoch Running job's last "
+                    "completed epoch.",
+                    "# TYPE hpnn_jobs_running_epoch gauge",
+                    f"hpnn_jobs_running_epoch {running.get('epoch', 0)}",
+                ]
+                if running.get("mean_err") is not None:
+                    lines += [
+                        "# HELP hpnn_jobs_running_mean_err Running "
+                        "job's last epoch mean final error.",
+                        "# TYPE hpnn_jobs_running_mean_err gauge",
+                        f"hpnn_jobs_running_mean_err "
+                        f"{running['mean_err']}",
+                    ]
+            lines += [
+                "# HELP hpnn_jobs_total Jobs by lifecycle status.",
+                "# TYPE hpnn_jobs_total gauge",
+            ]
+            for status, n in sorted(j.get("by_status", {}).items()):
+                lines.append(f'hpnn_jobs_total{{status="{status}"}} {n}')
         lines += [
             "# HELP hpnn_serve_queue_depth Requests waiting per kernel.",
             "# TYPE hpnn_serve_queue_depth gauge",

@@ -47,6 +47,7 @@ H2D of the next batch with device compute of the current one.
 from __future__ import annotations
 
 import os
+import random as _random
 import threading
 import time as _time
 
@@ -109,13 +110,15 @@ class _InFlight:
     """One dispatched bucket: the device-side result plus the scratch
     buffer to recycle once the result is collected."""
 
-    __slots__ = ("out", "rows", "bucket", "_buf", "_pool")
+    __slots__ = ("out", "rows", "bucket", "served_gen", "_buf", "_pool")
 
     def __init__(self, out, rows: int, bucket: int,
-                 buf, pool: _ScratchPool):
+                 buf, pool: _ScratchPool, served_gen: int | None = None):
         self.out = out
         self.rows = rows
         self.bucket = bucket
+        self.served_gen = served_gen  # pinned dispatch: the generation
+        #                               whose weights actually launched
         self._buf = buf
         self._pool = pool
 
@@ -166,6 +169,17 @@ class ServedModel:
         # weights until the purge removes them.
         self._holder: list | None = None  # [cast weights tuple]
         self._mesh_weights = {}           # mesh -> replicated device copies
+        # --- A/B generation pinning (jobs subsystem) -------------------
+        # retained PREVIOUS generations: cast device weights (pinned
+        # dispatch) + host kernels (rollback), pruned to the registry's
+        # gen_keep most recent.  ab_window is the active swap window:
+        # while set, an ab_fraction of unpinned traffic keeps routing to
+        # the previous generation until promote()/rollback() finalizes.
+        # Same-topology swaps only -- a topology change clears both (an
+        # old-shape generation cannot serve the new padding geometry).
+        self._gen_weights: dict[int, tuple] = {}
+        self._gen_kernels: dict[int, object] = {}
+        self.ab_window: dict | None = None
         self._pool: _ScratchPool | None = None
         self._lock = threading.Lock()
         # serializes whole reloads (disk read + swap): two concurrent
@@ -235,7 +249,8 @@ class ServedModel:
                                           np.dtype(self.dtype))
             return self._pool
 
-    def swap_kernel(self, kernel, source: str | None) -> dict:
+    def swap_kernel(self, kernel, source: str | None,
+                    ab: bool = True) -> dict:
         """Atomically replace the served weights with ``kernel`` (hot
         reload).  The new device copies (and replicated mesh copies for
         every mesh already in use) are built OUTSIDE the lock, then
@@ -259,6 +274,7 @@ class ServedModel:
             for mesh in list(self._mesh_weights)
         }
         with self._lock:
+            old_kernel = self.nn.kernel
             self.nn.kernel = kernel
             if changed or self._holder is None:
                 # FRESH containers: callables compiled for the old
@@ -266,9 +282,33 @@ class ServedModel:
                 # in-flight work on shape-consistent old weights
                 self._holder = [new_w]
                 self._mesh_weights = new_mesh
+                # old-shape generations cannot serve the new geometry
+                self._gen_weights.clear()
+                self._gen_kernels.clear()
+                self.ab_window = None
             else:
-                # same topology: swap in place, every cached callable
-                # picks the new weights up on its next dispatch
+                # same topology: retain the outgoing generation (pinned
+                # dispatch + rollback read it) and open the A/B window
+                # when the registry routes a swap fraction.  Retention
+                # only runs when something can consume it (an A/B
+                # fraction or the jobs subsystem) -- a plain --watch-ckpt
+                # server must not silently hold extra device weight
+                # copies per swap
+                old_gen = self.generation
+                keep = (self.registry.gen_keep
+                        if self.registry.retain_generations else 0)
+                if keep > 0:
+                    self._gen_weights[old_gen] = self._holder[0]
+                    self._gen_kernels[old_gen] = old_kernel
+                    for g in sorted(self._gen_weights)[:-keep]:
+                        del self._gen_weights[g]
+                        self._gen_kernels.pop(g, None)
+                if ab and self.registry.ab_fraction > 0.0:
+                    self.ab_window = {
+                        "prev": old_gen,
+                        "fraction": float(self.registry.ab_fraction)}
+                # swap in place, every cached callable picks the new
+                # weights up on its next dispatch
                 self._holder[0] = new_w
                 # a mesh placed concurrently (first fast@mesh dispatch
                 # between our pre-lock snapshot and here) still holds
@@ -289,12 +329,97 @@ class ServedModel:
             if source:
                 self.source = source
             gen = self.generation
+            ab_win = dict(self.ab_window) if self.ab_window else None
+            retained = sorted(self._gen_weights)
         if changed:
             self.registry.purge_cache(self.name, keep_topology=new_topo)
         return {"kernel": self.name, "generation": gen,
                 "topology_changed": changed,
                 "topology": list(new_topo),
-                "source": self.source}
+                "source": self.source,
+                "ab_window": ab_win,
+                "retained_generations": retained}
+
+    # --- A/B generation pinning ----------------------------------------
+    def resolve_generation(self, requested: int | None = None
+                           ) -> int | None:
+        """Which generation a request routes to: an explicit pin
+        (``X-HPNN-Generation``) is validated against the current +
+        retained generations (KeyError when unknown -- the HTTP layer
+        404s); unpinned traffic routes to the PREVIOUS generation with
+        the A/B window's probability while a swap window is open, else
+        None (= the live current weights, the zero-overhead path)."""
+        with self._lock:
+            if requested is not None:
+                req = int(requested)
+                if req != self.generation and req not in self._gen_weights:
+                    raise KeyError(req)
+                return req
+            ab = self.ab_window
+            if (ab and ab["prev"] in self._gen_weights
+                    and _random.random() < ab["fraction"]):
+                return int(ab["prev"])
+            return None
+
+    def weights_for(self, gen: int):
+        """Cast device weights for a pinned generation, as ``(weights,
+        served_gen)``.  Falls back to the CURRENT weights when the
+        generation was pruned between admission and dispatch (a
+        best-effort answer beats failing the whole coalesced batch) --
+        ``served_gen`` reports which generation ACTUALLY serves, so the
+        response label and A/B counters stay honest about the fallback."""
+        with self._lock:
+            if gen == self.generation:
+                return self.weights_nolock(), gen
+            w = self._gen_weights.get(gen)
+            if w is not None:
+                return w, gen
+            return self.weights_nolock(), self.generation
+
+    def generation_table(self) -> dict:
+        """The registry generation table /metrics and the jobs API
+        expose: current, retained pins, and the open A/B window."""
+        with self._lock:
+            return {"current": self.generation,
+                    "retained": sorted(self._gen_weights),
+                    "ab_window": (dict(self.ab_window)
+                                  if self.ab_window else None)}
+
+    def promote(self) -> dict:
+        """Finalize a swap: close the A/B window -- ALL unpinned traffic
+        routes to the current generation from here on (explicit pins to
+        retained generations keep working until pruned)."""
+        with self._lock:
+            self.ab_window = None
+            return {"kernel": self.name, "generation": self.generation,
+                    "ab_window": None,
+                    "retained": sorted(self._gen_weights)}
+
+    def rollback(self, gen: int | None = None) -> dict:
+        """Swap a retained previous generation's kernel back in (default:
+        the open A/B window's previous generation) and close the window.
+        The rollback is itself a generation bump -- history only moves
+        forward -- and never reopens an A/B window."""
+        with self._lock:
+            if gen is None:
+                gen = self.ab_window["prev"] if self.ab_window else None
+            if gen is None and self._gen_kernels:
+                # no open A/B window (e.g. --ab-fraction 0, the default):
+                # generations are still retained -- default to the most
+                # recent previous one instead of refusing the rollback
+                gen = max(self._gen_kernels)
+            kernel = (self._gen_kernels.get(int(gen))
+                      if gen is not None else None)
+        if kernel is None:
+            raise KeyError(
+                f"no retained generation to roll back to ({gen})")
+        result = self.swap_kernel(kernel, f"rollback:gen{int(gen)}",
+                                  ab=False)
+        with self._lock:
+            self.ab_window = None
+        result["ab_window"] = None
+        result["rolled_back_to"] = int(gen)
+        return result
 
     def infer(self, xs: np.ndarray) -> np.ndarray:
         """Batched forward for (rows, n_inputs) float64 inputs; returns
@@ -353,8 +478,12 @@ class ModelRegistry:
 
     def __init__(self, metrics: ServeMetrics | None = None,
                  max_batch: int = 64, parity: str = "strict",
-                 fast_threshold: int = 256, mesh=None):
+                 fast_threshold: int = 256, mesh=None,
+                 ab_fraction: float = 0.0, gen_keep: int = 2):
         assert max_batch >= 1
+        if not 0.0 <= float(ab_fraction) <= 1.0:
+            raise ValueError(
+                f"ab_fraction must be in [0, 1]: {ab_fraction}")
         if parity not in PARITY_MODES:
             raise ValueError(
                 f"parity must be one of {PARITY_MODES}: {parity!r}")
@@ -382,6 +511,17 @@ class ModelRegistry:
                     "strict (raise -b/--max-batch or lower "
                     "--fast-threshold)\n")
         self.mesh = mesh  # jax.sharding.Mesh with a "data" axis, or None
+        # A/B generation pinning policy: during a hot swap this fraction
+        # of unpinned traffic keeps routing to the previous generation
+        # until a promote/rollback finalizes; gen_keep bounds how many
+        # previous generations stay pinnable per model
+        self.ab_fraction = float(ab_fraction)
+        self.gen_keep = max(0, int(gen_keep))
+        # swaps retain previous generations only when something can
+        # consume them: an A/B canary fraction here, or the jobs
+        # subsystem (ServeApp.enable_jobs flips this on for rollback +
+        # explicit pinning even at --ab-fraction 0)
+        self.retain_generations = self.ab_fraction > 0.0
         self._models: dict[str, ServedModel] = {}
         self._cache: dict[tuple, object] = {}
         self._shardings: dict[tuple, object] = {}
@@ -498,7 +638,8 @@ class ModelRegistry:
         return sh
 
     # --- the forward path ----------------------------------------------
-    def _callable_for(self, model: ServedModel, bucket: int):
+    def _callable_for(self, model: ServedModel, bucket: int,
+                      pinned: bool = False):
         """The jitted batched-forward entry for one (topology, dtype,
         bucket, kind, tier) key.  Creating the entry is the cache MISS
         (the underlying jit compiles on its first call at this shape);
@@ -506,8 +647,19 @@ class ModelRegistry:
         takes the PADDED (bucket, n_inputs) host buffer in the model's
         numpy dtype and returns the device-side (bucket, n_outputs)
         result WITHOUT synchronizing -- callers choose when to pay D2H.
+
+        ``pinned=True`` (A/B generation pinning) returns a variant that
+        takes the weights tuple EXPLICITLY per call instead of reading
+        the live holder -- the underlying jits trace weights as
+        arguments, so the pinned entry shares their compiled programs
+        (cache-entry cost only, zero extra XLA compiles).  Pinned
+        dispatch never shards: retained generations have no replicated
+        mesh copies, and a pin is a correctness request, not a
+        throughput one.
         """
         tier = self.tier_for(bucket)
+        if pinned and tier.startswith("fast@mesh"):
+            tier = "fast"
         # the MODEL is part of the key: entries bind the model's device
         # weights in their closure, so two same-topology kernels must
         # never share an entry (they would cross-serve weights -- caught
@@ -515,7 +667,7 @@ class ModelRegistry:
         # across same-shaped models is unaffected: the underlying jits
         # trace weights as arguments and cache by shape.
         key = (model.name, model.topology, model.dtype_name, bucket,
-               model.kind, tier)
+               model.kind, tier, "pinned" if pinned else "live")
         with self._lock:
             fn = self._cache.get(key)
             if fn is not None:
@@ -549,6 +701,18 @@ class ModelRegistry:
                     w = _md.get(_m) or _mo.mesh_weights(_m)
                     return dp_eval_batch(w, jax.device_put(buf, _sh),
                                          _k, _m)
+            elif pinned:
+                run_batch_fn, path = ops.select_run_batch(
+                    model.dtype,
+                    parity="fast" if tier == "fast" else "strict")
+
+                # explicit-weights variant: the caller passes the pinned
+                # generation's tuple per dispatch (same shapes -> the
+                # same compiled XLA programs as the live entry)
+                def fn(buf, w, _fn=run_batch_fn, _k=kind):
+                    import jax.numpy as jnp
+
+                    return _fn(w, jnp.asarray(buf), _k)
             else:
                 run_batch_fn, path = ops.select_run_batch(
                     model.dtype,
@@ -567,23 +731,36 @@ class ModelRegistry:
                    f"path={path})\n")
             return fn
 
-    def dispatch(self, model: ServedModel, xs: np.ndarray) -> _InFlight:
+    def dispatch(self, model: ServedModel, xs: np.ndarray,
+                 gen: int | None = None) -> _InFlight:
         """Pad rows into a pooled scratch buffer and launch the cached
         forward WITHOUT waiting for the result: the returned handle's
         ``out`` is the device-side array (jax async dispatch), so the
         caller can overlap the next batch's host work with this batch's
-        device compute.  ``collect`` pays the D2H sync."""
+        device compute.  ``collect`` pays the D2H sync.
+
+        ``gen`` pins the batch to a specific model generation (A/B
+        pinning): the explicit-weights callable variant serves the
+        retained generation's weights; ``None`` is the live current
+        path, untouched."""
         rows = xs.shape[0]
         assert 1 <= rows <= self.max_batch, rows
         bucket = bucket_rows(rows, self.max_batch)
-        fn = self._callable_for(model, bucket)
+        pinned = gen is not None
+        fn = self._callable_for(model, bucket, pinned=pinned)
         pool = model.scratch_pool()
         buf = pool.acquire(bucket)
         buf[:rows] = xs
         if rows < bucket:
             buf[rows:] = 0.0  # a reused buffer may carry a stale tail
-        out = fn(buf)
-        return _InFlight(out, rows, bucket, buf, pool)
+        served_gen = None
+        if pinned:
+            w, served_gen = model.weights_for(gen)
+            out = fn(buf, w)
+        else:
+            out = fn(buf)
+        return _InFlight(out, rows, bucket, buf, pool,
+                         served_gen=served_gen)
 
     def collect(self, handle: _InFlight) -> np.ndarray:
         """Materialize a dispatched bucket as float64 host rows (the D2H

@@ -20,16 +20,40 @@ Endpoints:
   in-flight traffic; same-topology swaps reuse every compiled batch
   bucket.  The registry can also watch a checkpoint manifest
   (``serve_nn --watch-ckpt``) and reload on every generation bump.
+* ``POST /v1/kernels/<name>/train`` -- submit an online training job
+  (``serve_nn --jobs N``): JSON body with a server-side ``samples``
+  path, or ``multipart/form-data`` with a ``params`` JSON field plus
+  the corpus files; 202 with the job record.  The scheduler
+  time-slices the device against eval traffic at epoch granularity and
+  hot-swaps every epoch-boundary snapshot into serving (A/B pinning:
+  ``--ab-fraction`` keeps a canary fraction on the previous generation,
+  ``X-HPNN-Generation`` pins a request explicitly, and the job's
+  ``promote``/``rollback`` endpoints finalize).
+* ``GET /v1/jobs`` / ``GET /v1/jobs/<id>`` -- job history (persisted:
+  a restarted server reports it) / one job's live record.
+* ``GET /v1/jobs/<id>/events`` -- chunked NDJSON progress feed: one
+  line per state change carrying the per-epoch error trajectory from
+  the checkpoint manifest, closed when the job reaches a terminal
+  state.
+* ``POST /v1/jobs/<id>/{cancel,promote,rollback}`` -- stop the job at
+  the next epoch boundary (final snapshot written, resumable) /
+  finalize its A/B window.
+
+Mutating endpoints (reload, train, job actions) honor ``--auth-token``
+/ ``HPNN_SERVE_TOKEN``: when configured, requests without the matching
+``Authorization: Bearer`` (or ``X-HPNN-Token``) header get 401.
 
 Status mapping (distinct by failure class, so clients can react):
 
   ====  ==========================================================
   200   result
+  202   training job accepted (queued)
   400   malformed body / wrong input width / too many rows
-  404   unknown kernel
-  409   reload failed (weights file unreadable; old weights serve on)
+  401   missing/invalid auth token on a mutating endpoint
+  404   unknown kernel / job / pinned generation
+  409   reload failed / job action in a conflicting state
   429   queue full (backpressure -- retry later; Retry-After: 1)
-  503   server draining (shutdown in progress)
+  503   server draining (shutdown in progress) / jobs disabled
   504   deadline exceeded (queued or computed past the timeout)
   ====  ==========================================================
 
@@ -40,6 +64,7 @@ one touching the device -- the HTTP layer is pure coordination.
 
 from __future__ import annotations
 
+import hmac
 import json
 import os
 import re
@@ -56,6 +81,11 @@ from .registry import ModelRegistry
 
 _INFER_RE = re.compile(r"^/v1/kernels/([^/]+)/infer$")
 _RELOAD_RE = re.compile(r"^/v1/kernels/([^/]+)/reload$")
+_TRAIN_RE = re.compile(r"^/v1/kernels/([^/]+)/train$")
+_JOB_RE = re.compile(r"^/v1/jobs/([^/]+)$")
+_JOB_EVENTS_RE = re.compile(r"^/v1/jobs/([^/]+)/events$")
+_JOB_ACTION_RE = re.compile(
+    r"^/v1/jobs/([^/]+)/(cancel|promote|rollback)$")
 
 
 class _HTTPError(Exception):
@@ -63,6 +93,49 @@ class _HTTPError(Exception):
         super().__init__(message)
         self.status = status
         self.outcome = outcome
+
+
+def _parse_multipart(body: bytes,
+                     content_type: str) -> tuple[dict, list]:
+    """Decode a multipart/form-data train submit: the ``params`` field
+    (JSON) plus corpus file parts (filename => sample text bytes).
+    Stdlib-only via the email package -- the upload is a one-shot POST,
+    not a streaming protocol, so parse-in-memory is the right
+    simplicity."""
+    import email.parser
+    import email.policy
+
+    try:
+        msg = email.parser.BytesParser(
+            policy=email.policy.default).parsebytes(
+            b"Content-Type: " + content_type.encode("latin-1")
+            + b"\r\nMIME-Version: 1.0\r\n\r\n" + body)
+    except Exception as exc:
+        raise _HTTPError(400, "bad_request", f"bad multipart body: {exc}")
+    if not msg.is_multipart():
+        raise _HTTPError(400, "bad_request",
+                         "multipart body has no parts (bad boundary?)")
+    params: dict = {}
+    files: list[tuple[str, bytes]] = []
+    for part in msg.iter_parts():
+        payload = part.get_payload(decode=True)
+        if payload is None:
+            continue
+        fname = part.get_filename()
+        if fname:
+            files.append((fname, payload))
+            continue
+        field = part.get_param("name", header="content-disposition")
+        if field == "params":
+            try:
+                params = json.loads(payload.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+                raise _HTTPError(400, "bad_request",
+                                 f"bad params JSON: {exc}")
+            if not isinstance(params, dict):
+                raise _HTTPError(400, "bad_request",
+                                 "'params' must be a JSON object")
+    return params, files
 
 
 class ServeApp:
@@ -81,8 +154,12 @@ class ServeApp:
                  metrics: ServeMetrics | None = None,
                  parity: str = "strict", fast_threshold: int = 256,
                  mesh_devices: int | None = 0,
-                 warmup_workers: int | None = None):
+                 warmup_workers: int | None = None,
+                 auth_token: str | None = None,
+                 ab_fraction: float = 0.0):
         self.metrics = metrics or ServeMetrics()
+        self.auth_token = auth_token or None
+        self.jobs = None  # JobScheduler once enable_jobs() runs
         mesh = None
         if parity == "fast" and mesh_devices != 0:  # 0: explicitly off
             from ..parallel.mesh import data_mesh
@@ -101,7 +178,8 @@ class ServeApp:
                                       max_batch=max_batch,
                                       parity=parity,
                                       fast_threshold=fast_threshold,
-                                      mesh=mesh)
+                                      mesh=mesh,
+                                      ab_fraction=ab_fraction)
         self.batchers: dict[str, MicroBatcher] = {}
         self.max_queue_rows = int(max_queue_rows)
         self.linger_s = float(linger_s)
@@ -173,8 +251,52 @@ class ServeApp:
 
     def close(self, drain: bool = True) -> None:
         self._closed = True
+        if self.jobs is not None:
+            # graceful job drain FIRST: the running job finishes its
+            # in-flight epoch, snapshots and lands `interrupted`
+            # (resumable) before the eval batchers stop
+            self.jobs.drain()
         for b in self.batchers.values():
             b.close(drain=drain)
+
+    # --- auth (mutating endpoints) --------------------------------------
+    def authorized(self, headers) -> bool:
+        """True when no token is configured, or the request carries it
+        (``Authorization: Bearer <token>`` or ``X-HPNN-Token``)."""
+        tok = self.auth_token
+        if not tok:
+            return True
+        if not headers:
+            return False
+        # compare BYTES: str compare_digest raises TypeError on
+        # non-ASCII, and header values arrive latin-1-decoded -- an
+        # unauthenticated client must get a 401, never a traceback
+        want = tok.encode("utf-8")
+
+        def _eq(supplied: str) -> bool:
+            return hmac.compare_digest(
+                supplied.encode("utf-8", "surrogateescape"), want)
+
+        auth = headers.get("Authorization", "")
+        if auth.startswith("Bearer ") and _eq(auth[7:].strip()):
+            return True
+        return _eq(headers.get("X-HPNN-Token") or "")
+
+    # --- online training jobs -------------------------------------------
+    def enable_jobs(self, job_dir: str, capacity: int = 8,
+                    preempt_wait_s: float = 2.0):
+        """Attach the train-while-serving job subsystem (``serve_nn
+        --jobs N``): bounded queue + scheduler worker + persistent job
+        store under ``job_dir``, with its gauges wired into /metrics."""
+        from ..jobs import JobScheduler
+
+        # jobs consume retained generations (rollback, explicit pins,
+        # canary counters) even when no A/B fraction is configured
+        self.registry.retain_generations = True
+        self.jobs = JobScheduler(self, job_dir, capacity=capacity,
+                                 preempt_wait_s=preempt_wait_s)
+        self.metrics.set_jobs_source(self.jobs.metrics_snapshot)
+        return self.jobs
 
     # --- model lifecycle (hot reload) ----------------------------------
     def reload_model(self, name: str,
@@ -192,6 +314,41 @@ class ServeApp:
         self.metrics.count_reload(True)
         return result
 
+    def poll_ckpt_reload(self, name: str, ckpt_dir: str,
+                         state: dict) -> dict | None:
+        """One manifest poll: hot-reload ``name`` when the checkpoint
+        manifest's ``generation`` counter moved past ``state['gen']``.
+        The --watch-ckpt watcher loop calls this on its poll period; the
+        job scheduler calls it SYNCHRONOUSLY at every epoch-boundary
+        snapshot, so a training job's swap lands the moment its bundle
+        is durable -- one reload code path either way.  Returns the
+        reload result dict, or None when nothing (new) was loadable."""
+        from ..ckpt import read_manifest
+        from ..utils.nn_log import nn_warn
+
+        m = read_manifest(ckpt_dir)
+        if not m:
+            return None
+        gen = m.get("generation", 0)
+        if gen == state.get("gen", 0):
+            return None
+        rel = m.get("kernel")
+        if not rel:
+            state["gen"] = gen
+            return None
+        try:
+            result = self.reload_model(name, os.path.join(ckpt_dir, rel))
+        except Exception as exc:
+            # do NOT mark the generation consumed: a transient failure
+            # (mid-prune bundle, FS hiccup) on the run's LAST bump would
+            # otherwise leave the server stale forever; the next poll
+            # retries
+            nn_warn(f"serve: watched reload of '{name}' from "
+                    f"{ckpt_dir} failed (will retry): {exc}\n")
+            return None
+        state["gen"] = gen
+        return result
+
     def watch_manifest(self, name: str, ckpt_dir: str,
                        interval_s: float = 2.0) -> threading.Thread:
         """Poll a checkpoint directory's manifest (hpnn_tpu/ckpt) and
@@ -200,8 +357,6 @@ class ServeApp:
         progress straight into serving, no restart.  The manifest (and
         every bundle) is published by atomic rename, so a poll never
         sees a half-written kernel."""
-        from ..ckpt import read_manifest
-
         # baseline 0, NOT the manifest's current generation: a manifest
         # that already exists when the watch starts (training finished
         # before the server came up) must be loaded on the first poll,
@@ -210,32 +365,9 @@ class ServeApp:
         state = {"gen": 0}
 
         def loop():
-            from ..utils.nn_log import nn_warn
-
             while not self._closed:
                 time.sleep(interval_s)
-                m = read_manifest(ckpt_dir)
-                if not m:
-                    continue
-                gen = m.get("generation", 0)
-                if gen == state["gen"]:
-                    continue
-                rel = m.get("kernel")
-                if not rel:
-                    state["gen"] = gen
-                    continue
-                try:
-                    self.reload_model(name,
-                                      os.path.join(ckpt_dir, rel))
-                except Exception as exc:
-                    # do NOT mark the generation consumed: a transient
-                    # failure (mid-prune bundle, FS hiccup) on the
-                    # run's LAST bump would otherwise leave the server
-                    # stale forever; the next poll retries
-                    nn_warn(f"serve: watched reload of '{name}' from "
-                            f"{ckpt_dir} failed (will retry): {exc}\n")
-                else:
-                    state["gen"] = gen
+                self.poll_ckpt_reload(name, ckpt_dir, state)
 
         t = threading.Thread(target=loop, daemon=True,
                              name=f"hpnn-ckpt-watch-{name}")
@@ -246,7 +378,8 @@ class ServeApp:
         return t
 
     # --- request handling (transport-independent) ----------------------
-    def handle_infer(self, name: str, body: bytes) -> dict:
+    def handle_infer(self, name: str, body: bytes,
+                     headers=None) -> dict:
         b = self.batchers.get(name)
         if b is None:
             raise _HTTPError(404, "not_found", f"unknown kernel '{name}'")
@@ -256,6 +389,23 @@ class ServeApp:
             raise _HTTPError(400, "bad_request", f"bad JSON: {exc}")
         if not isinstance(req, dict):
             raise _HTTPError(400, "bad_request", "body must be an object")
+        # A/B generation pinning: an explicit X-HPNN-Generation header
+        # wins; otherwise an open A/B window routes a canary fraction to
+        # the previous generation; None = the live current weights
+        requested = headers.get("X-HPNN-Generation") if headers else None
+        if requested is not None:
+            try:
+                requested = int(requested)
+            except (TypeError, ValueError):
+                raise _HTTPError(400, "bad_request",
+                                 "X-HPNN-Generation must be an integer")
+        try:
+            gen = b.model.resolve_generation(requested)
+        except KeyError:
+            raise _HTTPError(
+                404, "unknown_generation",
+                f"kernel '{name}' has no pinned generation {requested} "
+                f"(retained: {b.model.generation_table()['retained']})")
         raw = req.get("inputs")
         if raw is None:
             one = req.get("input")
@@ -281,7 +431,8 @@ class ServeApp:
             except (TypeError, ValueError):
                 raise _HTTPError(400, "bad_request", "bad timeout_ms")
         try:
-            outs = b.submit(xs, timeout_s)
+            outs, served_gen = b.submit(xs, timeout_s, gen=gen,
+                                        return_gen=True)
         except QueueFull as exc:
             raise _HTTPError(429, "queue_full", str(exc))
         except DeadlineExceeded as exc:
@@ -290,8 +441,12 @@ class ServeApp:
             raise _HTTPError(503, "error", str(exc))
         except Exception as exc:
             raise _HTTPError(500, "error", f"{type(exc).__name__}: {exc}")
+        if served_gen is None:  # registry stand-ins without generations
+            served_gen = gen if gen is not None else model.generation
+        self.metrics.count_generation(name, served_gen)
         return {
             "kernel": name,
+            "generation": int(served_gen),
             "outputs": outs.tolist(),
             "argmax": [int(i) for i in np.argmax(outs, axis=1)],
         }
@@ -323,6 +478,95 @@ class ServeApp:
             raise _HTTPError(409, "reload_failed", str(exc))
         except Exception as exc:
             raise _HTTPError(500, "error", f"{type(exc).__name__}: {exc}")
+
+    def _jobs_or_503(self):
+        if self.jobs is None:
+            raise _HTTPError(503, "jobs_disabled",
+                             "online training is disabled "
+                             "(start serve_nn with --jobs N)")
+        return self.jobs
+
+    def handle_train(self, name: str, body: bytes,
+                     content_type: str = "") -> dict:
+        """POST /v1/kernels/<name>/train: submit an online training job.
+        JSON body (server-side ``samples`` path) or multipart/form-data
+        (a ``params`` JSON field + corpus file parts).  202 with the job
+        record; 400 bad params, 404 unknown kernel, 429 queue full."""
+        from ..jobs import JobError, JobQueueFull
+
+        jobs = self._jobs_or_503()
+        corpus_files = None
+        if content_type.startswith("multipart/form-data"):
+            params, corpus_files = _parse_multipart(body, content_type)
+        elif body.strip():
+            try:
+                params = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+                raise _HTTPError(400, "bad_request", f"bad JSON: {exc}")
+            if not isinstance(params, dict):
+                raise _HTTPError(400, "bad_request",
+                                 "body must be an object")
+        else:
+            params = {}
+        try:
+            job = jobs.submit(name, params, corpus_files=corpus_files)
+        except JobQueueFull as exc:
+            raise _HTTPError(429, "queue_full", str(exc))
+        except JobError as exc:
+            msg = str(exc)
+            if "unknown kernel" in msg:
+                raise _HTTPError(404, "not_found", msg)
+            raise _HTTPError(400, "bad_request", msg)
+        return job.to_dict()
+
+    def handle_job_get(self, job_id: str) -> dict:
+        jobs = self._jobs_or_503()
+        snap = jobs.get(job_id)
+        if snap is None:
+            raise _HTTPError(404, "not_found", f"unknown job '{job_id}'")
+        return snap
+
+    def handle_job_list(self) -> dict:
+        jobs = self._jobs_or_503()
+        return {"jobs": jobs.list()}
+
+    def handle_job_action(self, job_id: str, action: str) -> dict:
+        """POST /v1/jobs/<id>/{cancel,promote,rollback}.  Cancel stops
+        the job at the next epoch boundary (final snapshot written);
+        promote/rollback finalize the job's A/B swap window on its
+        target kernel."""
+        from ..jobs import JobError
+
+        jobs = self._jobs_or_503()
+        job = jobs.store.get(job_id)
+        if job is None:
+            raise _HTTPError(404, "not_found", f"unknown job '{job_id}'")
+        if action == "cancel":
+            try:
+                return jobs.cancel(job_id)
+            except JobError as exc:
+                raise _HTTPError(409, "conflict", str(exc))
+        model = self.registry.get(job.kernel)
+        if model is None:
+            raise _HTTPError(404, "not_found",
+                             f"job '{job_id}' kernel '{job.kernel}' is "
+                             "not registered")
+        if action == "promote":
+            result = model.promote()
+        else:  # rollback
+            try:
+                result = model.rollback()
+            except KeyError as exc:
+                raise _HTTPError(409, "conflict", str(exc))
+            # a rollback is a weights swap: keep the lifecycle metrics
+            # truthful, exactly like a reload
+            self.metrics.count_reload(True)
+            self.metrics.set_model_info(model.name, model.generation,
+                                        model.loaded_at)
+        jobs.finalize(job_id,
+                      "promoted" if action == "promote" else "rolled_back")
+        result["job"] = jobs.get(job_id)
+        return result
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -375,7 +619,74 @@ class _Handler(BaseHTTPRequestHandler):
                     self.app.metrics.render_prometheus().encode("utf-8"),
                     content_type="text/plain; version=0.0.4")
             return
+        try:
+            if path == "/v1/jobs":
+                self._reply(200, self.app.handle_job_list())
+                return
+            m = _JOB_EVENTS_RE.match(path)
+            if m is not None:
+                self._stream_job_events(m.group(1))
+                return
+            m = _JOB_RE.match(path)
+            if m is not None:
+                self._reply(200, self.app.handle_job_get(m.group(1)))
+                return
+        except _HTTPError as exc:
+            self._reply(exc.status,
+                        {"error": str(exc), "reason": exc.outcome})
+            return
         self._reply(404, {"error": f"no route {path}"})
+
+    # --- job progress streaming ----------------------------------------
+    def _write_chunk(self, data: bytes) -> None:
+        """One HTTP/1.1 chunked-transfer frame (b"" = the terminator)."""
+        if data:
+            self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+        else:
+            self.wfile.write(b"0\r\n\r\n")
+        self.wfile.flush()
+
+    def _stream_job_events(self, job_id: str,
+                           max_s: float = 3600.0) -> None:
+        """GET /v1/jobs/<id>/events: chunked NDJSON feed -- one line per
+        observed state change (status, epoch counter, error-trajectory
+        growth from the ckpt manifest, generation swaps), closed when
+        the job reaches a terminal state.  A disconnected client just
+        ends the stream; the job is unaffected."""
+        from ..jobs.state import TERMINAL_STATES
+
+        snap = self.app.handle_job_get(job_id)  # 404/503 before headers
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        last = None
+        deadline = time.monotonic() + max_s
+        try:
+            while time.monotonic() < deadline:
+                key = (snap["status"], snap["epoch"],
+                       len(snap["errors"]), len(snap["generations"]))
+                if key != last:
+                    last = key
+                    event = {
+                        "job": snap["job_id"],
+                        "kernel": snap["kernel"],
+                        "status": snap["status"],
+                        "epoch": snap["epoch"],
+                        "epochs": snap["epochs"],
+                        "errors": snap["errors"],
+                        "generations": snap["generations"],
+                    }
+                    self._write_chunk(
+                        (json.dumps(event) + "\n").encode("utf-8"))
+                if snap["status"] in TERMINAL_STATES:
+                    break
+                time.sleep(0.05)
+                snap = self.app.handle_job_get(job_id)
+            self._write_chunk(b"")
+        except (BrokenPipeError, ConnectionResetError, _HTTPError):
+            self.close_connection = True
 
     def do_POST(self) -> None:
         # drain the body FIRST, whatever the route: replying without
@@ -390,7 +701,17 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(400, {"error": "bad Content-Length",
                               "reason": "bad_request"})
             return
-        r = _RELOAD_RE.match(self.path)
+        path = self.path.partition("?")[0]
+        r = _RELOAD_RE.match(path)
+        t = _TRAIN_RE.match(path)
+        a = _JOB_ACTION_RE.match(path)
+        if (r or t or a) and not self.app.authorized(self.headers):
+            # every mutating endpoint sits behind the auth token when
+            # one is configured; infer/metrics/healthz stay open
+            self._reply(401, {"error": "missing or invalid auth token",
+                              "reason": "unauthorized"},
+                        extra_headers={"WWW-Authenticate": "Bearer"})
+            return
         if r is not None:
             try:
                 out = self.app.handle_reload(r.group(1), body)
@@ -400,13 +721,37 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(200, out)
             return
-        m = _INFER_RE.match(self.path)
+        if t is not None:
+            try:
+                out = self.app.handle_train(
+                    t.group(1), body,
+                    content_type=self.headers.get("Content-Type", ""))
+            except _HTTPError as exc:
+                headers = ({"Retry-After": "1"} if exc.status == 429
+                           else None)
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome},
+                            extra_headers=headers)
+                return
+            self._reply(202, out)
+            return
+        if a is not None:
+            try:
+                out = self.app.handle_job_action(a.group(1), a.group(2))
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+                return
+            self._reply(200, out)
+            return
+        m = _INFER_RE.match(path)
         if m is None:
             self.app.metrics.count_request("not_found")
             self._reply(404, {"error": f"no route {self.path}"})
             return
         try:
-            out = self.app.handle_infer(m.group(1), body)
+            out = self.app.handle_infer(m.group(1), body,
+                                        headers=self.headers)
         except _HTTPError as exc:
             self.app.metrics.count_request(exc.outcome)
             headers = {"Retry-After": "1"} if exc.status == 429 else None

@@ -1,0 +1,242 @@
+#!/usr/bin/env python3
+"""Train-while-serving bench: eval latency with and without a
+concurrent training job, swap-window error rate, generation swaps.
+
+Protocol (bench.py honesty rules):
+
+* phase 1 measures a BASELINE eval load (no job) -- client-observed
+  p50/p99 through the full HTTP round trip;
+* phase 2 submits a real training job over ``POST
+  /v1/kernels/<name>/train`` (per-epoch snapshots -> hot swaps into the
+  live registry) and hammers the same eval load until the job
+  completes, counting EVERY response status -- a single non-200 during
+  any swap window fails the run (rc 1), because "zero dropped requests
+  across generation swaps" is the subsystem's acceptance criterion, not
+  a nice-to-have;
+* the row records both phases' latencies, the generation-swap count
+  (floor: >= 3), the server's own /metrics jobs + per-generation
+  counters, and the job's final record, so every claim cross-checks.
+
+Self-contained: generates a corpus + kernel in a temp dir, self-hosts
+the server in-process (the same ServeApp serve_nn runs), emits ONE
+BENCH-style JSON line and writes JOBS_BENCH.json (``make jobs-bench``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import serve_bench  # noqa: E402
+
+N_IN, N_HID, N_OUT = 16, 12, 4
+
+
+def _write_corpus(dirpath: str, rng, n: int) -> None:
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n")
+            fp.write(" ".join(f"{v:7.5f}" for v in x) + "\n")
+            fp.write(f"[output] {N_OUT}\n")
+            fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+def _eval_phase(base: str, kernel: str, inputs, sizes, concurrency,
+                until=None, timeout_s=60.0) -> dict:
+    """One or more run_load passes; with ``until`` (a callable), keep
+    cycling the same load until it returns True, aggregating statuses
+    and latencies across passes."""
+    statuses: dict[str, int] = {}
+    lats: list[float] = []
+    passes = 0
+    while True:
+        load = serve_bench.run_load(base, kernel, inputs,
+                                    rows_per_request=sizes,
+                                    concurrency=concurrency,
+                                    timeout_s=timeout_s)
+        passes += 1
+        for s, n in load["statuses"].items():
+            statuses[s] = statuses.get(s, 0) + n
+        lats.extend(r["latency_s"] for r in load["records"])
+        if until is None or until():
+            break
+    lats.sort()
+
+    def pct(p):
+        return lats[min(len(lats) - 1, int(p / 100.0 * len(lats)))]
+
+    return {
+        "passes": passes,
+        "n_requests": len(lats),
+        "statuses": statuses,
+        "p50_ms": round(pct(50) * 1e3, 3),
+        "p99_ms": round(pct(99) * 1e3, 3),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--epochs", type=int, default=6,
+                    help="training job epochs (= snapshot swaps; "
+                    "default 6)")
+    ap.add_argument("--samples", type=int, default=24,
+                    help="corpus size (default 24)")
+    ap.add_argument("--requests", type=int, default=128,
+                    help="eval requests per load pass (default 128)")
+    ap.add_argument("--rows", default="1,3,5",
+                    help="rows per request, cycled (default 1,3,5)")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--ab-fraction", type=float, default=0.5,
+                    help="A/B canary fraction during swaps (default .5)")
+    ap.add_argument("--seed", type=int, default=42)
+    ap.add_argument("--out", default=None,
+                    help="also write the JSON row to this path")
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+    from hpnn_tpu.serve.server import ServeApp, serve_in_thread
+
+    work = tempfile.mkdtemp(prefix="hpnn_jobs_bench.")
+    row: dict = {"metric": "jobs_train_while_serve",
+                 "unit": "eval p99 ms under training",
+                 "epochs": args.epochs, "samples": args.samples,
+                 "ab_fraction": args.ab_fraction}
+    httpd = app = None
+    try:
+        corpus = os.path.join(work, "samples")
+        _write_corpus(corpus, np.random.default_rng(args.seed),
+                      args.samples)
+        kern, _ = generate_kernel(args.seed, N_IN, [N_HID], N_OUT)
+        kpath = os.path.join(work, "kernel.opt")
+        dump_kernel_to_path(kern, kpath)
+        conf = os.path.join(work, "bench.conf")
+        with open(conf, "w") as fp:
+            fp.write(f"[name] bench\n[type] ANN\n[init] {kpath}\n"
+                     "[seed] 1\n[train] BP\n")
+        app = ServeApp(max_batch=16, max_queue_rows=4096,
+                       ab_fraction=args.ab_fraction)
+        model = app.add_model(conf, warmup=True)
+        if model is None:
+            print(json.dumps({"error": "cannot register bench kernel"}))
+            return 2
+        app.enable_jobs(os.path.join(work, "jobs"), capacity=2)
+        httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+        base = "http://127.0.0.1:%d" % httpd.server_address[1]
+
+        sizes = [int(s) for s in str(args.rows).split(",")]
+        rng = np.random.default_rng(args.seed)
+        total_rows = sum(sizes[i % len(sizes)]
+                         for i in range(args.requests))
+        inputs = rng.uniform(-1.0, 1.0, (total_rows, N_IN))
+
+        # phase 1: baseline (no training job on the device)
+        row["baseline"] = _eval_phase(base, "bench", inputs, sizes,
+                                      args.concurrency)
+        gen0 = model.generation
+
+        # phase 2: the same load while a real training job runs
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/bench/train",
+            {"epochs": args.epochs, "seed": args.seed, "train": "BP",
+             "samples": corpus, "ckpt_every": 1})
+        if st != 202:
+            print(json.dumps({"error": f"submit failed: {st} {job}"}))
+            return 2
+        jid = job["job_id"]
+        done = threading.Event()
+
+        def poll():
+            # transient transport errors under the concurrent load must
+            # not kill the poller silently -- the eval loop would cycle
+            # forever waiting on done; give up only after a sustained
+            # failure streak (and let the 300s join be the backstop)
+            failures = 0
+            while not done.is_set():
+                try:
+                    _, snap = serve_bench.http_json(
+                        base + f"/v1/jobs/{jid}")
+                    failures = 0
+                except OSError:
+                    failures += 1
+                    if failures >= 100:
+                        done.set()
+                        return
+                    time.sleep(0.05)
+                    continue
+                if snap["status"] in ("done", "failed", "cancelled",
+                                      "interrupted"):
+                    done.set()
+                    return
+                time.sleep(0.05)
+
+        poller = threading.Thread(target=poll, daemon=True)
+        poller.start()
+        row["under_training"] = _eval_phase(
+            base, "bench", inputs, sizes, args.concurrency,
+            until=done.is_set)
+        poller.join(timeout=300)
+        _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+        swaps = model.generation - gen0
+        dropped = sum(n for s, n in
+                      row["under_training"]["statuses"].items()
+                      if s != "200")
+        m = serve_bench.fetch_metrics(base)
+        row.update({
+            "value": row["under_training"]["p99_ms"],
+            "baseline_p99_ms": row["baseline"]["p99_ms"],
+            "p99_ratio_vs_baseline": round(
+                row["under_training"]["p99_ms"]
+                / row["baseline"]["p99_ms"], 3)
+            if row["baseline"]["p99_ms"] else None,
+            "job_status": snap["status"],
+            "job_errors": snap["errors"],
+            "generation_swaps": swaps,
+            "dropped_requests": dropped,
+            "swap_window_error_rate": round(
+                dropped / max(1, row["under_training"]["n_requests"]),
+                6),
+            "server_jobs": m.get("jobs"),
+            "server_generations": m.get("generations"),
+        })
+        ok = (snap["status"] == "done" and dropped == 0 and swaps >= 3)
+        row["floors"] = {"job_done": snap["status"] == "done",
+                         "zero_dropped": dropped == 0,
+                         "swaps_ge_3": swaps >= 3}
+    finally:
+        if httpd is not None:
+            httpd.shutdown()
+        if app is not None:
+            app.close(drain=True)
+        shutil.rmtree(work, ignore_errors=True)
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(json.dumps(row) + "\n")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -48,16 +48,19 @@ import numpy as np
 
 
 def http_json(url: str, payload: dict | None = None,
-              timeout_s: float = 60.0) -> tuple[int, dict]:
+              timeout_s: float = 60.0,
+              headers: dict | None = None) -> tuple[int, dict]:
     """One request; returns (status, decoded body).  HTTP errors with a
     JSON body decode like successes (the server's distinct reject
-    statuses ARE the API); transport errors raise."""
+    statuses ARE the API); transport errors raise.  ``headers`` adds or
+    overrides request headers (auth tokens, X-HPNN-Generation pins)."""
     if payload is None:
-        req = urllib.request.Request(url)
+        req = urllib.request.Request(url, headers=headers or {})
     else:
+        h = {"Content-Type": "application/json"}
+        h.update(headers or {})
         req = urllib.request.Request(
-            url, data=json.dumps(payload).encode("utf-8"),
-            headers={"Content-Type": "application/json"})
+            url, data=json.dumps(payload).encode("utf-8"), headers=h)
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
             return resp.status, json.loads(resp.read().decode("utf-8"))
@@ -180,6 +183,11 @@ def bench_row(base_url: str, kernel: str, load: dict) -> dict:
         "server_requests": m.get("requests"),
         "device_time": m.get("device_time"),
         "buckets": m.get("buckets"),
+        # online-training observability (jobs subsystem): queue depth,
+        # running-job progress, per-generation A/B routing counters --
+        # None/{} on servers without --jobs
+        "jobs": m.get("jobs"),
+        "generations": m.get("generations"),
     }
 
 

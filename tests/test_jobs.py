@@ -1,0 +1,797 @@
+"""Online training service (hpnn_tpu/jobs): train-while-serving.
+
+The acceptance pin (slow tier, `make jobs-check`): a training job
+submitted over HTTP and run UNDER live eval traffic produces a
+``kernel.opt`` byte-identical to the offline ``train_nn`` run of the
+same conf/corpus/seed (BP and BPM), with ZERO dropped/failed eval
+requests across every epoch-boundary hot swap, A/B generation pinning
+honored, and the per-epoch error trajectory streamed over the chunked
+``/v1/jobs/<id>/events`` feed.  The fast tier covers the pieces: the
+persistent job store (restart -> history + interrupted recovery), the
+bounded queue, the auth guard on mutating endpoints, generation
+pinning/promote/rollback at the registry level, and submit validation.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import serve_bench  # noqa: E402
+
+from hpnn_tpu import cli  # noqa: E402
+from hpnn_tpu.io.kernel_io import dump_kernel_to_path  # noqa: E402
+from hpnn_tpu.jobs import (  # noqa: E402
+    JobQueue,
+    JobQueueFull,
+    JobState,
+    JobStore,
+)
+from hpnn_tpu.serve.server import (  # noqa: E402
+    ServeApp,
+    _parse_multipart,
+    serve_in_thread,
+)
+from hpnn_tpu.utils import nn_log  # noqa: E402
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+def _write_corpus(dirpath, rng, n):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n")
+            fp.write(" ".join(f"{v:7.5f}" for v in x) + "\n")
+            fp.write(f"[output] {N_OUT}\n")
+            fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+def _sample_text(i):
+    rng = np.random.default_rng(100 + i)
+    x = rng.uniform(-1, 1, N_IN)
+    t = -np.ones(N_OUT)
+    t[i % N_OUT] = 1.0
+    return (f"[input] {N_IN}\n" + " ".join(f"{v:7.5f}" for v in x)
+            + f"\n[output] {N_OUT}\n" + " ".join(f"{v:.1f}" for v in t)
+            + "\n")
+
+
+def _serve_conf(tmp_path, name="tiny", seed=1234):
+    """A conf serving a generated-then-dumped kernel (the serving side
+    does not need the training seed -- jobs generate their own)."""
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    return str(conf), kpath
+
+
+def _train_conf(tmp_path, samples, train="BP", seed=77):
+    """The OFFLINE train_nn conf semantically identical to what a job
+    submit with the same params generates."""
+    conf = tmp_path / f"train_{train}.conf"
+    conf.write_text(
+        "[name] tiny\n[type] ANN\n[init] generate\n"
+        f"[seed] {seed}\n[input] {N_IN}\n[hidden] {N_HID}\n"
+        f"[output] {N_OUT}\n[train] {train}\n[dtype] f64\n"
+        f"[sample_dir] {samples}\n")
+    return str(conf)
+
+
+def _wait_terminal(base, jid, timeout_s=180.0):
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+        if snap["status"] in ("done", "failed", "cancelled",
+                              "interrupted"):
+            return snap
+        time.sleep(0.05)
+    raise AssertionError(f"job {jid} did not finish: {snap}")
+
+
+@pytest.fixture(autouse=True)
+def _quiet():
+    nn_log.set_verbosity(0)
+    yield
+    nn_log.set_verbosity(0)
+
+
+# --- job store (persistence + crash recovery) -------------------------------
+
+def test_job_store_persistence_and_recovery(tmp_path):
+    root = str(tmp_path / "jobs")
+    store = JobStore(root)
+    a = store.create("k", {"epochs": 2, "samples": "/x"})
+    b = store.create("k", {"epochs": 1, "samples": "/y"})
+    assert [a.job_id, b.job_id] == ["job-000001", "job-000002"]
+    store.update(a, status="done", epoch=2, errors=[0.5, 0.25])
+    store.update(b, status="running", epoch=1)
+    # a fresh store (server restart) reports the full history...
+    store2 = JobStore(root)
+    jobs = {j["job_id"]: j for j in store2.list()}
+    assert jobs["job-000001"]["status"] == "done"
+    assert jobs["job-000001"]["errors"] == [0.5, 0.25]
+    # ...and recovers jobs that were active at the crash
+    assert store2.recover() == ["job-000002"]
+    assert store2.get("job-000002").status == "interrupted"
+    # ids keep incrementing past the recovered history
+    c = store2.create("k", {})
+    assert c.job_id == "job-000003"
+    assert store2.by_status() == {"done": 1, "interrupted": 1,
+                                  "queued": 1}
+
+
+def test_job_queue_bounded_fifo():
+    q = JobQueue(capacity=2)
+    j1 = JobState(job_id="j1", kernel="k", params={}, path="/tmp")
+    j2 = JobState(job_id="j2", kernel="k", params={}, path="/tmp")
+    q.submit(j1)
+    q.submit(j2)
+    with pytest.raises(JobQueueFull):
+        q.submit(JobState(job_id="j3", kernel="k", params={},
+                          path="/tmp"))
+    assert q.depth() == 2
+    assert q.remove("j2") and not q.remove("j2")
+    assert q.take(timeout_s=0.0) is j1
+    assert q.take(timeout_s=0.0) is None
+    q.close()
+    with pytest.raises(JobQueueFull):
+        q.submit(j2)  # closed queue admits nothing
+
+
+def test_multipart_parse_roundtrip():
+    boundary = "XbOuNdArYx"
+    parts = (
+        f'--{boundary}\r\n'
+        'Content-Disposition: form-data; name="params"\r\n\r\n'
+        '{"epochs": 2, "seed": 9}\r\n'
+        f'--{boundary}\r\n'
+        'Content-Disposition: form-data; name="corpus"; '
+        'filename="s000"\r\n'
+        'Content-Type: application/octet-stream\r\n\r\n'
+        'SAMPLE BYTES\r\n'
+        f'--{boundary}--\r\n').encode()
+    params, files = _parse_multipart(
+        parts, f"multipart/form-data; boundary={boundary}")
+    assert params == {"epochs": 2, "seed": 9}
+    assert files == [("s000", b"SAMPLE BYTES")]
+
+
+# --- submission validation + queue admission over HTTP ----------------------
+
+def test_submit_validation_and_queue_full(tmp_path):
+    conf, _ = _serve_conf(tmp_path)
+    corpus = tmp_path / "samples"
+    _write_corpus(str(corpus), np.random.default_rng(3), 3)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    sched.pause()  # jobs queue but never run: admission is the subject
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        url = base + "/v1/kernels/tiny/train"
+        st, body = serve_bench.http_json(base + "/v1/kernels/nope/train",
+                                         {"samples": str(corpus)})
+        assert st == 404
+        st, body = serve_bench.http_json(url, {})
+        assert st == 400 and "samples" in body["error"]
+        st, body = serve_bench.http_json(
+            url, {"samples": str(corpus), "train": "CG"})
+        assert st == 400 and "train" in body["error"]
+        st, body = serve_bench.http_json(
+            url, {"samples": str(corpus), "epochs": 0})
+        assert st == 400
+        st, body = serve_bench.http_json(
+            url, {"samples": str(tmp_path / "missing")})
+        assert st == 400 and "not a directory" in body["error"]
+        st, body = serve_bench.http_json(
+            url, {"samples": str(corpus), "hidden": [0]})
+        assert st == 400
+        # admission: capacity 1 -> second submit is a distinct 429
+        st, ok1 = serve_bench.http_json(url, {"samples": str(corpus)})
+        assert st == 202 and ok1["status"] == "queued"
+        st, body = serve_bench.http_json(url, {"samples": str(corpus)})
+        assert st == 429 and body["reason"] == "queue_full"
+        # jobs listing sees the queued job; unknown job 404s
+        st, listing = serve_bench.http_json(base + "/v1/jobs")
+        assert st == 200
+        assert [j["job_id"] for j in listing["jobs"]] == \
+            [ok1["job_id"]]
+        st, _b = serve_bench.http_json(base + "/v1/jobs/nope")
+        assert st == 404
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_jobs_disabled_distinct_status(tmp_path):
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train", {"samples": "/x"})
+        assert st == 503 and body["reason"] == "jobs_disabled"
+        st, body = serve_bench.http_json(base + "/v1/jobs")
+        assert st == 503
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- auth guard (satellite) -------------------------------------------------
+
+def test_auth_guard_on_mutating_endpoints(tmp_path):
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8, auth_token="s3cret")
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        x = [[0.0] * N_IN]
+        # read-only + infer stay open
+        st, _b = serve_bench.http_json(base + "/healthz")
+        assert st == 200
+        st, _b = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": x})
+        assert st == 200
+        st, _b = serve_bench.http_json(base + "/v1/jobs")
+        assert st == 200
+        # mutating endpoints 401 without the token...
+        for url, payload in (
+                (base + "/v1/kernels/tiny/reload", {}),
+                (base + "/v1/kernels/tiny/train", {"samples": "/x"}),
+                (base + "/v1/jobs/nope/cancel", {})):
+            st, body = serve_bench.http_json(url, payload)
+            assert st == 401 and body["reason"] == "unauthorized"
+            st, body = serve_bench.http_json(
+                url, payload, headers={"Authorization": "Bearer wrong"})
+            assert st == 401
+            # a non-ASCII token is a 401, never a dropped connection
+            # (str compare_digest raises TypeError on non-ASCII)
+            st, body = serve_bench.http_json(
+                url, payload, headers={"X-HPNN-Token": "caf\xe9"})
+            assert st == 401
+        # ...and pass with it (Bearer or X-HPNN-Token), reaching the
+        # endpoint's own semantics (200 reload, 404 unknown job)
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/reload", {},
+            headers={"Authorization": "Bearer s3cret"})
+        assert st == 200 and body["generation"] == 2
+        st, body = serve_bench.http_json(
+            base + "/v1/jobs/nope/cancel", {},
+            headers={"X-HPNN-Token": "s3cret"})
+        assert st == 404
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- A/B generation pinning (registry level) --------------------------------
+
+def test_ab_pinning_promote_rollback(tmp_path):
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    conf, kpath = _serve_conf(tmp_path, name="ab")
+    app = ServeApp(max_batch=8, ab_fraction=1.0)
+    model = app.add_model(conf, warmup=False)
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    out1 = app.infer("ab", x)
+    k2, _ = generate_kernel(4321, N_IN, [N_HID], N_OUT)
+    dump_kernel_to_path(k2, kpath)
+    res = app.reload_model("ab")
+    assert res["generation"] == 2
+    # the swap retained generation 1 and opened the A/B window
+    assert res["retained_generations"] == [1]
+    assert res["ab_window"] == {"prev": 1, "fraction": 1.0}
+    # fraction=1.0: ALL unpinned traffic keeps routing to the previous
+    # generation -- deterministic, so assert exact outputs
+    body = app.handle_infer("ab", json.dumps(
+        {"inputs": x.tolist()}).encode(), headers={})
+    assert body["generation"] == 1
+    np.testing.assert_array_equal(np.asarray(body["outputs"]), out1)
+    # an explicit pin beats the window, both directions
+    body = app.handle_infer("ab", json.dumps(
+        {"inputs": x.tolist()}).encode(),
+        headers={"X-HPNN-Generation": "2"})
+    assert body["generation"] == 2
+    out2 = np.asarray(body["outputs"])
+    assert not np.array_equal(out2, out1)
+    # unknown pin is a distinct 404
+    from hpnn_tpu.serve.server import _HTTPError
+
+    with pytest.raises(_HTTPError) as exc:
+        app.handle_infer("ab", json.dumps(
+            {"inputs": x.tolist()}).encode(),
+            headers={"X-HPNN-Generation": "9"})
+    assert exc.value.status == 404
+    # per-generation counters saw both lanes
+    snap = app.metrics.snapshot()
+    assert snap["generations"]["ab"] == {"1": 1, "2": 1}
+    # promote closes the window: unpinned traffic moves to current
+    model.promote()
+    body = app.handle_infer("ab", json.dumps(
+        {"inputs": x.tolist()}).encode(), headers={})
+    assert body["generation"] == 2
+    # rollback swaps generation 1's kernel back in as a NEW generation
+    res = model.rollback(1)
+    assert res["generation"] == 3 and res["rolled_back_to"] == 1
+    assert res["ab_window"] is None  # rollback never reopens a window
+    np.testing.assert_array_equal(app.infer("ab", x), out1)
+    app.close()
+
+
+def test_topology_change_clears_generation_pins(tmp_path):
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    conf, kpath = _serve_conf(tmp_path, name="topo")
+    app = ServeApp(max_batch=4, ab_fraction=0.5)
+    model = app.add_model(conf, warmup=False)
+    app.infer("topo", np.zeros((1, N_IN)))
+    k2, _ = generate_kernel(5, N_IN, [N_HID], N_OUT)
+    dump_kernel_to_path(k2, kpath)
+    app.reload_model("topo")
+    assert model.generation_table()["retained"] == [1]
+    k3, _ = generate_kernel(6, N_IN, [N_HID + 2], N_OUT)
+    dump_kernel_to_path(k3, kpath)
+    res = app.reload_model("topo")
+    assert res["topology_changed"] is True
+    # old-shape generations cannot serve the new geometry: all cleared
+    t = model.generation_table()
+    assert t["retained"] == [] and t["ab_window"] is None
+    app.close()
+
+
+def test_rollback_defaults_to_latest_retained_without_ab_window(tmp_path):
+    """--ab-fraction 0 (the default) opens no A/B window, but
+    generations ARE retained: a bare rollback must use the most recent
+    one instead of refusing with 'no retained generation (None)'."""
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    conf, kpath = _serve_conf(tmp_path, name="rb")
+    app = ServeApp(max_batch=4)  # ab_fraction defaults to 0.0
+    model = app.add_model(conf, warmup=False)
+    # jobs enabled = generations retained even at ab_fraction 0
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    out1 = app.infer("rb", x)
+    k2, _ = generate_kernel(4321, N_IN, [N_HID], N_OUT)
+    dump_kernel_to_path(k2, kpath)
+    res = app.reload_model("rb")
+    assert res["ab_window"] is None and res["retained_generations"] == [1]
+    res = model.rollback()  # no explicit generation, no window
+    assert res["rolled_back_to"] == 1 and res["generation"] == 3
+    np.testing.assert_array_equal(app.infer("rb", x), out1)
+    app.close()
+
+
+def test_plain_server_retains_no_generations(tmp_path):
+    """Without an A/B fraction or the jobs subsystem nothing can consume
+    retained generations -- a plain --watch-ckpt server's hot swaps must
+    not hold extra device weight copies."""
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    conf, kpath = _serve_conf(tmp_path, name="pl")
+    app = ServeApp(max_batch=4)  # ab_fraction 0, jobs never enabled
+    model = app.add_model(conf, warmup=False)
+    k2, _ = generate_kernel(4321, N_IN, [N_HID], N_OUT)
+    dump_kernel_to_path(k2, kpath)
+    res = app.reload_model("pl")
+    assert res["generation"] == 2 and res["retained_generations"] == []
+    assert model.generation_table()["retained"] == []
+    app.close()
+
+
+def test_cancel_latches_between_pop_and_install(tmp_path):
+    """cancel() racing the worker's queue pop (job no longer in the
+    queue, not yet _current, status still 'queued') must latch instead
+    of 409ing while the job runs anyway."""
+    conf, _ = _serve_conf(tmp_path, name="cl")
+    app = ServeApp(max_batch=4)
+    app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    try:
+        # a queued-status job that is in neither the queue nor _current
+        # IS the race window, simulated directly
+        job = sched.store.create("cl", {})
+        snap = sched.cancel(job.job_id)
+        assert snap["status"] == "queued"
+        with sched._mu:
+            assert job.job_id in sched._pending_cancel
+        # terminal jobs still get the distinct already-<status> error
+        sched.store.update(job, status="done")
+        with pytest.raises(Exception, match="already done"):
+            sched.cancel(job.job_id)
+    finally:
+        app.close(drain=True)
+
+
+def test_rejected_submit_leaves_no_job_record(tmp_path):
+    """A submit that fails admission mid-flight (here: a bad uploaded
+    corpus file name) must leave neither a job record nor a directory --
+    the 4xx is retryable and history must not show a phantom job."""
+    conf, _ = _serve_conf(tmp_path, name="nr")
+    app = ServeApp(max_batch=4)
+    app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    try:
+        with pytest.raises(Exception, match="bad corpus file name"):
+            sched.submit("nr", {"epochs": 1},
+                         corpus_files=[(".hidden", b"x")])
+        assert sched.store.list() == []
+        assert [d for d in os.listdir(str(tmp_path / "jobs"))
+                if d.startswith("job-")] == []
+    finally:
+        app.close(drain=True)
+
+
+def test_resume_submit_honors_explicit_samples(tmp_path):
+    """A resume_job submit that names a new 'samples' path trains on IT,
+    not silently on the prior job's corpus."""
+    conf, _ = _serve_conf(tmp_path, name="rs")
+    old = tmp_path / "old_corpus"
+    new = tmp_path / "new_corpus"
+    _write_corpus(str(old), np.random.default_rng(1), 3)
+    _write_corpus(str(new), np.random.default_rng(2), 3)
+    app = ServeApp(max_batch=4)
+    model = app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    try:
+        prev = sched.store.create("rs", {"samples": str(old)})
+        os.makedirs(os.path.join(prev.path, "ckpt"), exist_ok=True)
+        with open(os.path.join(prev.path, "ckpt", "manifest.json"),
+                  "w") as fp:
+            fp.write("{}")
+        sched.store.update(prev, status="interrupted", epoch=1, epochs=2)
+        assert prev.resumable
+        clean = sched._sanitize(
+            model, {"resume_job": prev.job_id, "samples": str(new)}, None)
+        assert clean["samples"] == os.path.abspath(str(new))
+        # without an explicit path the prior corpus is inherited
+        clean = sched._sanitize(model, {"resume_job": prev.job_id}, None)
+        assert clean["samples"] == os.path.abspath(str(old))
+    finally:
+        app.close(drain=True)
+
+
+def test_generation_counter_cardinality_capped():
+    from hpnn_tpu.serve.metrics import ServeMetrics
+
+    m = ServeMetrics()
+    for g in range(1, 2 * ServeMetrics.GEN_LABELS_KEPT + 1):
+        m.count_generation("k", g)
+        m.count_generation("k", g)  # 2 requests per generation
+    gens = m.snapshot()["generations"]["k"]
+    numeric = sorted((int(k) for k in gens if k != "older"))
+    assert len(numeric) == ServeMetrics.GEN_LABELS_KEPT
+    assert numeric[-1] == 2 * ServeMetrics.GEN_LABELS_KEPT  # newest kept
+    # folded counts are preserved, not dropped
+    assert sum(gens.values()) == 4 * ServeMetrics.GEN_LABELS_KEPT
+    assert gens["older"] == 2 * ServeMetrics.GEN_LABELS_KEPT
+    assert 'generation="older"' in m.render_prometheus()
+
+
+# --- restart reports history ------------------------------------------------
+
+def test_restart_reports_historical_jobs(tmp_path):
+    root = tmp_path / "jobs"
+    store = JobStore(str(root))
+    done = store.create("tiny", {"epochs": 2})
+    store.update(done, status="done", epoch=2, errors=[0.4, 0.2])
+    crashed = store.create("tiny", {"epochs": 5})
+    store.update(crashed, status="running", epoch=3, start_epoch=0)
+    del store
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(root), capacity=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, listing = serve_bench.http_json(base + "/v1/jobs")
+        jobs = {j["job_id"]: j for j in listing["jobs"]}
+        assert jobs[done.job_id]["status"] == "done"
+        assert jobs[crashed.job_id]["status"] == "interrupted"
+        # cumulative trained epochs survive the restart
+        m = serve_bench.fetch_metrics(base)
+        assert m["jobs"]["trained_epochs_total"] == 5
+        assert m["jobs"]["by_status"] == {"done": 1, "interrupted": 1}
+        prom = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "hpnn_jobs_trained_epochs_total 5" in prom
+        assert 'hpnn_jobs_total{status="done"} 1' in prom
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- the e2e acceptance: train under traffic, byte parity, A/B --------------
+
+@pytest.fixture()
+def corpus_dir(tmp_path):
+    d = tmp_path / "samples"
+    _write_corpus(str(d), np.random.default_rng(7), N_SAMP)
+    return str(d)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("train", ["BP", "BPM"])
+def test_train_job_e2e_parity_under_traffic(tmp_path, monkeypatch,
+                                            capsys, corpus_dir, train):
+    """The acceptance run: submit over HTTP -> per-epoch snapshots
+    hot-reload under concurrent eval traffic (zero non-200s) with A/B
+    pinning honored -> final kernel.opt byte-identical to the offline
+    train_nn run -> events feed carried the error trajectory."""
+    epochs, seed = 3, 77
+    # offline reference run (the same conf the job generates)
+    offdir = tmp_path / "off"
+    offdir.mkdir()
+    tconf = _train_conf(tmp_path, corpus_dir, train=train, seed=seed)
+    monkeypatch.chdir(offdir)
+    rc = cli.train_nn_main([f"--epochs={epochs}", "--ckpt-every=1",
+                            "--ckpt-dir=ck", tconf])
+    capsys.readouterr()
+    assert rc == 0
+    off_bytes = (offdir / "kernel.opt").read_bytes()
+    monkeypatch.chdir(tmp_path)
+
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8, max_queue_rows=512, ab_fraction=1.0)
+    app.add_model(conf, warmup=True)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    stop = threading.Event()
+    failures: list = []
+    ok_count = [0]
+
+    def hammer():
+        while not stop.is_set():
+            st, body = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()})
+            if st != 200:
+                failures.append((st, body))
+            else:
+                ok_count[0] += 1
+
+    events_lines: list = []
+
+    def read_events(jid):
+        # urllib decodes the chunked framing; lines arrive until the
+        # job's terminal state closes the stream
+        with urllib.request.urlopen(
+                base + f"/v1/jobs/{jid}/events", timeout=180) as resp:
+            assert resp.headers.get("Content-Type") == \
+                "application/x-ndjson"
+            for line in resp:
+                events_lines.append(json.loads(line))
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"epochs": epochs, "seed": seed, "train": train,
+             "samples": corpus_dir, "ckpt_every": 1,
+             "hidden": [N_HID]})
+        assert st == 202, job
+        jid = job["job_id"]
+        ev = threading.Thread(target=read_events, args=(jid,))
+        ev.start()
+        snap = _wait_terminal(base, jid)
+        ev.join(timeout=60)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert snap["status"] == "done", snap
+    assert snap["epoch"] == epochs
+    # (1) byte parity with the offline CLI run
+    job_bytes = open(os.path.join(snap["path"], "kernel.opt"),
+                     "rb").read()
+    assert job_bytes == off_bytes
+    # (2) zero dropped/failed eval requests across every swap
+    assert failures == []
+    assert ok_count[0] > 0
+    # (3) >= 3 generation swaps landed in serving (one per epoch
+    # snapshot + the final record)
+    model = app.registry.get("tiny")
+    assert len(snap["generations"]) >= 3
+    assert model.generation == 1 + len(snap["generations"])
+    # (4) the error trajectory matches the checkpoint manifest
+    from hpnn_tpu import ckpt
+
+    manifest = ckpt.read_manifest(os.path.join(snap["path"], "ckpt"))
+    assert snap["errors"] == manifest["errors"]
+    assert len(snap["errors"]) == epochs
+    # (5) the events feed streamed progress and ended terminal
+    assert events_lines and events_lines[-1]["status"] == "done"
+    assert events_lines[-1]["errors"] == snap["errors"]
+    assert any(e["status"] in ("running", "snapshotting")
+               for e in events_lines)
+    # (6) A/B pinning honored after the final swap (fraction=1.0 keeps
+    # unpinned traffic on the previous generation, deterministically)
+    st, body = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()})
+    assert st == 200 and body["generation"] == model.generation - 1
+    st, pinned = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()},
+        headers={"X-HPNN-Generation": str(model.generation)})
+    assert pinned["generation"] == model.generation
+    # promote finalizes: unpinned traffic moves to the new weights
+    st, res = serve_bench.http_json(base + f"/v1/jobs/{jid}/promote",
+                                    {})
+    assert st == 200 and res["job"]["finalized"] == "promoted"
+    st, body = serve_bench.http_json(
+        base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()})
+    assert body["generation"] == model.generation
+    np.testing.assert_array_equal(np.asarray(body["outputs"]),
+                                  np.asarray(pinned["outputs"]))
+    # observability: job gauges + per-generation counters moved
+    m = serve_bench.fetch_metrics(base)
+    assert m["jobs"]["trained_epochs_total"] == epochs
+    assert m["jobs"]["by_status"]["done"] == 1
+    assert len(m["generations"]["tiny"]) >= 2
+    httpd.shutdown()
+    app.close(drain=True)
+
+
+@pytest.mark.slow
+def test_job_cancel_then_resume(tmp_path, corpus_dir):
+    """Cancel latches the stop event: the in-flight epoch finishes, a
+    final snapshot lands, the job is `cancelled` and resumable -- and a
+    resume_job submit continues it bit-exactly from the snapshot."""
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"epochs": 500, "seed": 5, "train": "BP",
+             "samples": corpus_dir, "ckpt_every": 1})
+        assert st == 202
+        jid = job["job_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            if snap["epoch"] >= 1:
+                break
+            time.sleep(0.02)
+        assert snap["epoch"] >= 1
+        st, _b = serve_bench.http_json(base + f"/v1/jobs/{jid}/cancel",
+                                       {})
+        assert st == 200
+        snap = _wait_terminal(base, jid)
+        assert snap["status"] == "cancelled"
+        assert snap["epoch"] < 500
+        assert snap["resumable"] is True
+        # cancelling a terminal job is a distinct conflict
+        st, body = serve_bench.http_json(
+            base + f"/v1/jobs/{jid}/cancel", {})
+        assert st == 409
+        # resume: continue 2 more epochs from the snapshot
+        target = snap["epoch"] + 2
+        st, job2 = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"resume_job": jid, "epochs": target})
+        assert st == 202, job2
+        snap2 = _wait_terminal(base, job2["job_id"])
+        assert snap2["status"] == "done"
+        assert snap2["epoch"] == target
+        assert snap2["resumed_from"] == jid
+        # one continued history: the trajectory covers every epoch
+        assert len(snap2["errors"]) == target
+        assert snap2["errors"][:snap["epoch"]] == snap["errors"]
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+@pytest.mark.slow
+def test_close_drains_running_job_interrupted(tmp_path, corpus_dir):
+    """Graceful drain (the SIGTERM path serve_nn wires): close() stops
+    the in-flight job at its epoch boundary, snapshots, and marks it
+    `interrupted` -- resumable, nothing killed mid-epoch."""
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    st, job = serve_bench.http_json(
+        base + "/v1/kernels/tiny/train",
+        {"epochs": 500, "seed": 5, "train": "BP",
+         "samples": corpus_dir, "ckpt_every": 1})
+    assert st == 202
+    jid = job["job_id"]
+    deadline = time.time() + 120
+    while time.time() < deadline:
+        snap = sched.get(jid)
+        if snap["epoch"] >= 1:
+            break
+        time.sleep(0.02)
+    httpd.shutdown()
+    app.close(drain=True)  # drains the scheduler first
+    snap = sched.get(jid)
+    assert snap["status"] == "interrupted"
+    assert 1 <= snap["epoch"] < 500
+    assert snap["resumable"] is True
+    # the final snapshot really is on disk at the interrupted epoch
+    from hpnn_tpu import ckpt
+
+    bundle = ckpt.load_snapshot(snap["params"]["ckpt_dir"]
+                                if snap["params"].get("ckpt_dir")
+                                else os.path.join(snap["path"], "ckpt"))
+    assert bundle is not None and bundle.epoch == snap["epoch"]
+
+
+@pytest.mark.slow
+def test_multipart_corpus_upload_trains(tmp_path):
+    """A corpus uploaded as multipart/form-data trains exactly like a
+    server-side path: the files land in the job dir and the job runs."""
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        boundary = "hpnnJobBoundary"
+        params = {"epochs": 1, "seed": 3, "train": "BP",
+                  "ckpt_every": 1}
+        chunks = [
+            f'--{boundary}\r\n'
+            'Content-Disposition: form-data; name="params"\r\n\r\n'
+            + json.dumps(params) + "\r\n"]
+        for i in range(6):
+            chunks.append(
+                f'--{boundary}\r\n'
+                'Content-Disposition: form-data; name="corpus"; '
+                f'filename="s{i:03d}"\r\n'
+                'Content-Type: application/octet-stream\r\n\r\n'
+                + _sample_text(i) + "\r\n")
+        chunks.append(f"--{boundary}--\r\n")
+        body = "".join(chunks).encode()
+        req = urllib.request.Request(
+            base + "/v1/kernels/tiny/train", data=body,
+            headers={"Content-Type":
+                     f"multipart/form-data; boundary={boundary}"})
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            assert resp.status == 202
+            job = json.loads(resp.read())
+        snap = _wait_terminal(base, job["job_id"])
+        assert snap["status"] == "done", snap
+        cdir = os.path.join(snap["path"], "corpus")
+        assert len(os.listdir(cdir)) == 6
+        assert snap["params"]["samples"] == cdir
+        assert os.path.isfile(os.path.join(snap["path"], "kernel.opt"))
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
