@@ -22,10 +22,11 @@ MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
 SERVE_TESTS = tests/test_serve.py
 CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
+OBS_TESTS = tests/test_obs.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
-	    $(CKPT_TESTS) $(JOBS_TESTS) -q
+	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -39,6 +40,15 @@ serve-check:
 # epoch-pipeline parity pins (pipeline on == HPNN_NO_EPOCH_PIPELINE=1)
 ckpt-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(CKPT_TESTS) -q
+
+# observability tier (ISSUE 8): span/recorder units, LatencyHistogram
+# edge cases, the Prometheus exposition-format lint, healthz fields,
+# the monotonic-clock audit, nn_log JSON mode, train-parity with
+# tracing on, and the live-server trace e2e (slow-marked: a training
+# job under eval traffic must yield one correlated span tree per
+# trace id in the /v1/debug/trace dump)
+obs-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(OBS_TESTS) -q
 
 # online-training tier: job store/queue/auth/A-B units + the full e2e
 # acceptance (submit over HTTP -> per-epoch hot swaps under concurrent
@@ -109,4 +119,5 @@ mfu-bench:
 	    $(if $(REAL),--real)
 
 .PHONY: check check-all serve-check ckpt-check ckpt-bench jobs-check \
-    jobs-bench native bench serve-bench io-bench epoch-bench mfu-bench
+    jobs-bench obs-check native bench serve-bench io-bench epoch-bench \
+    mfu-bench

@@ -355,13 +355,17 @@ class _EpochPipeline:
         stays on the per-epoch restaging path)."""
         import jax.numpy as jnp
 
+        from .obs import trace as obs_trace
+
         names = list_sample_dir(conf.samples)
         if not names:
             return None
         t0 = time.perf_counter()
-        rc = corpus_io.load_resident(conf.samples, names,
-                                     nn.kernel.n_inputs,
-                                     nn.kernel.n_outputs)
+        with obs_trace.span("corpus_load", samples=conf.samples,
+                            files=len(names)):
+            rc = corpus_io.load_resident(conf.samples, names,
+                                         nn.kernel.n_inputs,
+                                         nn.kernel.n_outputs)
         if rc is None or rc.n_rows == 0:
             return None
         dtype = _dtype_of(conf)
@@ -432,17 +436,22 @@ class _EpochPipeline:
                                  for w in nn.kernel.weights)
             EPOCH_METRICS["setup_h2d_bytes"] += sum(
                 w.nbytes for w in self.weights)
+        from .obs import trace as obs_trace
+
         if self.shard_rows:
             self.stage_last = time.perf_counter() - t0  # grown per shard
             new_w, stats = self._sharded_epoch(sel, kind, momentum)
         else:
-            sel_dev = jnp.asarray(sel)    # THE per-epoch H2D: int32 perm
-            xs = jnp.take(self.x_dev, sel_dev, axis=0)
-            ts = jnp.take(self.t_dev, sel_dev, axis=0)
+            with obs_trace.span("corpus_gather", rows=int(sel.size)):
+                sel_dev = jnp.asarray(sel)  # THE per-epoch H2D: int32 perm
+                xs = jnp.take(self.x_dev, sel_dev, axis=0)
+                ts = jnp.take(self.t_dev, sel_dev, axis=0)
             self.h2d_last = sel.nbytes
             self.stage_last = time.perf_counter() - t0
-            new_w, stats = self.train_fn(self.weights, xs, ts, kind,
-                                         momentum, alpha=0.2)
+            with obs_trace.span("device_launch", rows=int(sel.size),
+                                mode=self.mode):
+                new_w, stats = self.train_fn(self.weights, xs, ts, kind,
+                                             momentum, alpha=0.2)
         self.weights = new_w
         fut = corpus_io.io_pool().submit(
             _render_training_lines, self.events_last, stats, kind,
@@ -470,6 +479,8 @@ class _EpochPipeline:
             return (jnp.asarray(X[idx], dtype=self.dtype),
                     jnp.asarray(T[idx], dtype=self.dtype))
 
+        from .obs import trace as obs_trace
+
         w, parts, h2d = self.weights, [], 0
         nxt = pool.submit(prep, 0)
         for lo in range(0, n, k):
@@ -479,7 +490,10 @@ class _EpochPipeline:
                 nxt = pool.submit(prep, lo + k)
             h2d += xs.nbytes + ts.nbytes
             self.stage_last += time.perf_counter() - t0
-            w, st = self.train_fn(w, xs, ts, kind, momentum, alpha=0.2)
+            with obs_trace.span("device_launch", shard_lo=lo,
+                                rows=int(xs.shape[0]), mode="sharded"):
+                w, st = self.train_fn(w, xs, ts, kind, momentum,
+                                      alpha=0.2)
             parts.append(st)
         self.h2d_last = h2d
         if len(parts) == 1:
@@ -496,7 +510,13 @@ class _EpochPipeline:
         weight carry back to ``nn.kernel.weights`` (float64, the form
         snapshots and kernel dumps read).  Returns the epoch summaries
         joined, oldest first."""
+        from .obs import trace as obs_trace
+
         sums = []
+        with obs_trace.span("stats_drain", pending=len(self.pending)):
+            return self._join_inner(nn, sums)
+
+    def _join_inner(self, nn, sums: list) -> list[dict]:
         for item in self.pending:
             if isinstance(item, tuple):
                 tag, payload = item

@@ -26,10 +26,12 @@ from :meth:`flush` (the CLI flushes before declaring the run done).
 from __future__ import annotations
 
 import threading
+import time
 
 import numpy as np
 
 from ..io.conf import NN_TRAIN_BPM
+from ..obs import trace as obs_trace
 from ..utils import nn_log
 from ..utils.nn_log import nn_out
 from . import snapshot as snap
@@ -97,12 +99,18 @@ class CheckpointManager:
         nn_out(f"CKPT: snapshot {snap.snapshot_tag(epoch)}\n")
         if sync or not self.use_pool:
             self.flush()
-            self._write(job)
+            with obs_trace.span("ckpt.snapshot_write", epoch=job["epoch"],
+                                sync=True):
+                self._write(job)
             return
         from concurrent.futures import Future
 
         from ..io.corpus import io_pool
 
+        # snapshot-write spans parent under the CALLER's epoch span even
+        # though the write runs on a pool thread: capture the context
+        # here, record explicitly there (ISSUE 8)
+        ctx = obs_trace.current_ctx()
         # bundles must land in epoch order, but the chain may never PARK
         # a pool worker waiting on its predecessor (queued snapshots
         # would otherwise occupy io_pool threads and starve the corpus
@@ -114,18 +122,26 @@ class CheckpointManager:
             prev = self._future
             self._future = fut
         if prev is None:
-            io_pool().submit(self._run_job, job, fut, None)
+            io_pool().submit(self._run_job, job, fut, None, ctx)
         else:
             prev.add_done_callback(
-                lambda p: io_pool().submit(self._run_job, job, fut, p))
+                lambda p: io_pool().submit(self._run_job, job, fut, p,
+                                           ctx))
 
-    def _run_job(self, job: dict, fut, prev) -> None:
+    def _run_job(self, job: dict, fut, prev, ctx=None) -> None:
         if prev is not None and prev.exception() is not None:
             fut.set_exception(prev.exception())  # first failure wins
             return
         try:
+            t0 = time.monotonic()
             with nn_log.capture():  # the writer never prints
                 self._write(job)
+            if obs_trace.enabled():
+                obs_trace.record(
+                    "ckpt.snapshot_write", t0, time.monotonic(),
+                    trace_id=ctx[0] if ctx else None,
+                    parent_id=ctx[1] if ctx else None,
+                    epoch=job["epoch"], sync=False)
         except BaseException as exc:  # noqa: BLE001 -- surfaced at flush
             fut.set_exception(exc)
         else:

@@ -105,6 +105,7 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
     """
     from ..api import (pipeline_active, pipeline_defer_out, pipeline_join,
                        train_kernel)
+    from ..obs import trace as obs_trace
 
     conf = nn.conf
     if rng_state is not None:
@@ -139,30 +140,39 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
     try:
         for epoch in range(start_epoch + 1, epochs + 1):
             last_epoch = epoch
-            if banner:
-                text = f"EPOCH {epoch:8d}/{epochs:8d}\n"
-                if not pipeline_defer_out(nn, text):
-                    nn_out(text)
-            if not train_kernel(nn):
-                drain()
-                return False, False
-            if pipeline_active(nn):
-                pending.append(epoch)
-                # join only where the unpipelined loop would need the
-                # host state: a due snapshot, the final epoch, a latched
-                # signal, or the deterministic kill hook about to fire
-                due = (manager is not None and manager.every
-                       and epoch % manager.every == 0)
-                if (due or epoch == epochs or stop.is_set()
-                        or (kill_at and epoch == kill_at)):
+            # the per-epoch span root (ISSUE 8): train_kernel's phases
+            # (load/gather/device launch), the deferred-stats drain, the
+            # snapshot write and the jobs scheduler's epoch callback
+            # (hot swap + eval yield) all nest under it via the
+            # thread-local span stack -- a no-op when tracing is off
+            epoch_span = obs_trace.span("train.epoch", epoch=epoch,
+                                        epochs=epochs)
+            with epoch_span:
+                if banner:
+                    text = f"EPOCH {epoch:8d}/{epochs:8d}\n"
+                    if not pipeline_defer_out(nn, text):
+                        nn_out(text)
+                if not train_kernel(nn):
                     drain()
-            else:
-                stats = getattr(nn, "last_epoch_stats", None)
-                mean_err = stats.get("mean_final") if stats else None
-                if manager is not None:
-                    manager.epoch_done(nn, epoch, mean_err)
-            if on_epoch is not None:
-                on_epoch(epoch, manager)
+                    return False, False
+                if pipeline_active(nn):
+                    pending.append(epoch)
+                    # join only where the unpipelined loop would need
+                    # the host state: a due snapshot, the final epoch, a
+                    # latched signal, or the deterministic kill hook
+                    # about to fire
+                    due = (manager is not None and manager.every
+                           and epoch % manager.every == 0)
+                    if (due or epoch == epochs or stop.is_set()
+                            or (kill_at and epoch == kill_at)):
+                        drain()
+                else:
+                    stats = getattr(nn, "last_epoch_stats", None)
+                    mean_err = stats.get("mean_final") if stats else None
+                    if manager is not None:
+                        manager.epoch_done(nn, epoch, mean_err)
+                if on_epoch is not None:
+                    on_epoch(epoch, manager)
             if kill_at and epoch == kill_at and epoch < epochs:
                 # exercise the REAL signal path at a deterministic
                 # boundary (test hook; see module docstring)

@@ -6,10 +6,11 @@ Flag-compatible rebuilds of the reference demo binaries
     train_nn [-h] [-v]... [-x] [-O n] [-B n] [-S n]
              [--compile-cache DIR] [--corpus-cache DIR]
              [--epochs N] [--ckpt-every N] [--ckpt-dir DIR]
-             [--ckpt-keep N] [--resume [PATH]] [conf]
+             [--ckpt-keep N] [--resume [PATH]]
+             [--profile-dir DIR] [conf]
     run_nn   [-h] [-v]... [-O n] [-B n] [-S n]
              [--compile-cache DIR] [--corpus-cache DIR]
-             [--ckpt-dir DIR] [conf]
+             [--ckpt-dir DIR] [--profile-dir DIR] [conf]
 
 * flags combine (``-vvv``) and -O/-B/-S accept attached (``-O4``) or
   separated (``-O 4``) values, like the reference parser
@@ -55,6 +56,8 @@ def _help_text(name: str, train: bool) -> str:
         "\tdir: least-recently-used packs past the cap are evicted (the",
         "\tin-flight run's pack never is; 0: no cap).",
         "--ckpt-dir DIR \tcheckpoint directory (default ./ckpt).",
+        "--profile-dir DIR \tcapture the whole run as a jax.profiler",
+        "\ttrace into DIR (TensorBoard-loadable; chip-side on TPU).",
     ]
     if train:
         lines += [
@@ -90,7 +93,8 @@ def _help_text(name: str, train: bool) -> str:
 
 _LONG_OPTS = {"--compile-cache": "compile_cache",
               "--corpus-cache": "corpus_cache",
-              "--ckpt-dir": "ckpt_dir"}
+              "--ckpt-dir": "ckpt_dir",
+              "--profile-dir": "profile_dir"}
 # integer-valued long options (value validated like the reference's
 # numeric switches); min value enforced at parse time.  Most are
 # train_nn-only; _SHARED_INT_OPTS also parse for run_nn.
@@ -276,6 +280,17 @@ def train_nn_main(argv: list[str] | None = None) -> int:
         return 0
     filename, _verbose, extras = parsed
     _apply_extras(extras)
+    from .obs.profiler import profile_run
+
+    # --profile-dir D: the whole run (configure + train + dump) under a
+    # jax.profiler capture; a start failure warns and runs unprofiled
+    with profile_run(extras.get("profile_dir")):
+        return _train_nn_body(filename, extras)
+
+
+def _train_nn_body(filename: str, extras: dict) -> int:
+    from .utils.trace import phase
+
     epochs = extras.get("epochs") or 1
     epochs_given = extras.get("epochs") is not None
     resume = extras.get("resume")
@@ -407,6 +422,15 @@ def run_nn_main(argv: list[str] | None = None) -> int:
         return 0
     filename, _verbose, extras = parsed
     _apply_extras(extras)
+    from .obs.profiler import profile_run
+
+    with profile_run(extras.get("profile_dir")):
+        return _run_nn_body(filename, extras)
+
+
+def _run_nn_body(filename: str, extras: dict) -> int:
+    from .utils.trace import phase
+
     with phase("configure"):
         neural = configure(filename)
     if neural is None:
@@ -521,7 +545,17 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--auth-token", default=None, metavar="TOKEN",
                     help="require this bearer token (or X-HPNN-Token) on "
                     "every mutating endpoint: reload, train submits, job "
-                    "actions.  Default: $HPNN_SERVE_TOKEN; unset = open")
+                    "actions, profile captures.  Default: "
+                    "$HPNN_SERVE_TOKEN; unset = open")
+    ap.add_argument("--trace", action="store_true", default=False,
+                    help="enable span tracing + the flight recorder "
+                    "(GET /v1/debug/trace; every infer request gets a "
+                    "trace id, X-HPNN-Trace-Id honored/echoed).  "
+                    "Default: $HPNN_TRACE; off costs nothing")
+    ap.add_argument("--profile-dir", default=None, metavar="DIR",
+                    help="destination for POST /v1/debug/profile "
+                    "jax.profiler captures (default: a fresh temp dir "
+                    "per capture)")
     args = ap.parse_args(argv)
 
     from .serve.server import ServeApp, make_server
@@ -552,7 +586,9 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                    fast_threshold=args.fast_threshold,
                    mesh_devices=(None if args.mesh < 0 else args.mesh),
                    auth_token=auth_token,
-                   ab_fraction=args.ab_fraction)
+                   ab_fraction=args.ab_fraction,
+                   trace=args.trace or None,
+                   profile_dir=args.profile_dir)
     n_ok = 0
     for conf in args.confs:
         with phase("register"):
@@ -619,11 +655,26 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
             prev_handlers[_sig] = _signal.signal(_sig, _drain_signal)
         except (ValueError, OSError):  # pragma: no cover - non-main thread
             pass
+    # flight-recorder post-mortem (ISSUE 8): on SIGTERM/SIGINT drain or
+    # a fault escaping serve_forever, the span ring is dumped as NDJSON
+    # next to the job dir (or the cwd when jobs are off) -- the last
+    # window of activity survives the process
+    dump_dir = args.job_dir if args.jobs > 0 else "."
+    dumped = False
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:  # pragma: no cover - handler owns SIGINT
         sys.stdout.write("SERVE: draining...\n")
         sys.stdout.flush()
+    except Exception:
+        from .obs import trace as obs_trace
+
+        path = obs_trace.dump_to_dir(dump_dir, reason="fault")
+        dumped = True  # ONE post-mortem per process, fault-tagged
+        if path:
+            sys.stderr.write(f"SERVE: flight recorder dumped to "
+                             f"{path}\n")
+        raise
     finally:
         for _sig, old in prev_handlers.items():
             try:
@@ -632,6 +683,14 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                 pass
         httpd.shutdown()
         app.close(drain=True)
+        if not dumped:
+            from .obs import trace as obs_trace
+
+            path = obs_trace.dump_to_dir(dump_dir, reason="shutdown")
+            if path:
+                sys.stdout.write(f"SERVE: flight recorder dumped to "
+                                 f"{path}\n")
+                sys.stdout.flush()
         runtime.deinit_all()
     return 0
 

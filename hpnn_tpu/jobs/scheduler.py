@@ -34,6 +34,7 @@ import os
 import threading
 import time
 
+from ..obs import trace as obs_trace
 from ..utils import nn_log
 from ..utils.nn_log import nn_out, nn_warn
 from .queue import JobQueue, JobQueueFull
@@ -273,6 +274,17 @@ class JobScheduler:
                     self._pending_cancel.discard(job.job_id)
 
     def _run_job(self, job: JobState, stop: threading.Event) -> None:
+        # one trace per job, keyed by the job id itself: every epoch
+        # span, snapshot write and hot swap on this (scheduler) thread
+        # nests under it -- `GET /v1/debug/trace?trace=job:<id>` is the
+        # job's whole execution tree (ISSUE 8)
+        with obs_trace.span("jobs.run", trace_id=f"job:{job.job_id}",
+                            job=job.job_id, kernel=job.kernel,
+                            epochs=job.epochs):
+            self._run_job_traced(job, stop)
+
+    def _run_job_traced(self, job: JobState,
+                        stop: threading.Event) -> None:
         from ..api import train_job
 
         self.store.update(job, status="running", started=time.time())
@@ -334,13 +346,16 @@ class JobScheduler:
     def _yield_to_eval(self, stop: threading.Event) -> None:
         """The preemption gate: while eval traffic is queued, the next
         epoch waits (bounded) -- serving latency beats training
-        throughput on a shared device."""
-        deadline = time.monotonic() + self.preempt_wait_s
-        while not stop.is_set() and time.monotonic() < deadline:
-            depths = [b.depth() for b in self.app.batchers.values()]
-            if not any(depths):
-                return
-            time.sleep(0.001)
+        throughput on a shared device.  The wait is a span
+        (``jobs.yield_to_eval``): generation-swap / device contention
+        shows up in the job's trace as time spent here."""
+        with obs_trace.span("jobs.yield_to_eval"):
+            deadline = time.monotonic() + self.preempt_wait_s
+            while not stop.is_set() and time.monotonic() < deadline:
+                depths = [b.depth() for b in self.app.batchers.values()]
+                if not any(depths):
+                    return
+                time.sleep(0.001)
 
     def _write_console(self, job: JobState, entries: list) -> None:
         try:

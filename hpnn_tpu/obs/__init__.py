@@ -1,0 +1,48 @@
+"""Observability subsystem: structured span tracing, an in-process
+flight recorder, and on-demand device profiling (ISSUE 8 tentpole).
+
+Three layers, stacked so each can be used without the next:
+
+* :mod:`.trace` -- spans.  ``span("name")`` times a region and records
+  it (name, trace id, parent, monotonic start, duration, attributes)
+  into a bounded ring buffer -- the *flight recorder* -- that is
+  dumpable as NDJSON at any time (``GET /v1/debug/trace`` on a live
+  server, or :func:`dump_to_dir` from a signal handler).  Tracing is
+  OFF by default and the off path is a single global ``is None`` check
+  returning a shared no-op singleton -- zero allocation, so the serving
+  hot path pays nothing when idle.
+* the serve/train drivers thread trace CONTEXT through their hot paths:
+  a serve request's trace id (``X-HPNN-Trace-Id``, or generated) links
+  the HTTP handler's spans to the batcher's and the registry's even
+  though they run on different threads (explicit ``trace_id``/
+  ``parent_id`` on :func:`record`); training epochs nest their phases
+  through the thread-local span stack.
+* :mod:`.profiler` -- ``jax.profiler`` wrapped for one-shot live
+  captures (``POST /v1/debug/profile``) and whole-run captures
+  (``train_nn --profile-dir D``), so a chip-side XLA trace can be
+  pulled from a running server without restarting it.
+
+``HPNN_TRACE=1`` enables tracing at ``init_all`` / server start;
+``HPNN_TRACE_BUFFER=N`` sizes the ring (default 8192 spans).
+"""
+
+from .trace import (  # noqa: F401
+    current_ctx,
+    disable,
+    dump_ndjson,
+    dump_to_dir,
+    enable,
+    enable_from_env,
+    enabled,
+    new_span_id,
+    new_trace_id,
+    record,
+    snapshot,
+    span,
+)
+
+__all__ = [
+    "current_ctx", "disable", "dump_ndjson", "dump_to_dir", "enable",
+    "enable_from_env", "enabled", "new_span_id", "new_trace_id",
+    "record", "snapshot", "span",
+]

@@ -1,0 +1,148 @@
+"""On-demand device profiling: ``jax.profiler`` behind a process lock.
+
+Two consumers:
+
+* ``POST /v1/debug/profile {"seconds": N}`` on a live server --
+  :func:`capture` starts an XLA/TSL trace, sleeps N seconds on the
+  HTTP handler thread (the device keeps serving; the profiler observes
+  from the side), stops, and reports the artifact directory.  One
+  capture at a time process-wide: the underlying profiler is a global
+  singleton, so a second concurrent start would abort it.
+* ``train_nn/serve_nn --profile-dir D`` -- :func:`profile_run` wraps a
+  whole run (started after init, stopped in the CLI's finally).
+
+The captured directory is TensorBoard-loadable (``plugins/profile``)
+and on TPU includes the chip-side trace; on CPU hosts it still records
+host/XLA activity, so the plumbing is testable off-chip.
+
+``jax.profiler`` availability is probed at call time and failures are
+reported as :class:`ProfilerUnavailable` -- the serving layer maps it
+to an HTTP status instead of a traceback, and a CLI run warns and
+continues untraced (profiling is an observation, never a reason to
+fail the run).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+
+_lock = threading.Lock()
+_active: dict | None = None
+
+# bound a live-server capture: a forgotten 1e9-second profile must not
+# pin the (singleton) profiler forever
+MAX_CAPTURE_S = 300.0
+
+
+class ProfilerUnavailable(RuntimeError):
+    """jax.profiler could not start (missing dep / backend refusal)."""
+
+
+class ProfilerBusy(RuntimeError):
+    """A capture is already running (the profiler is a singleton)."""
+
+
+def _start_trace(out_dir: str) -> None:
+    try:
+        import jax
+
+
+        jax.profiler.start_trace(out_dir)
+    except Exception as exc:  # noqa: BLE001 -- anything here means "no
+        # profile", and the caller chose between 501 and a warning
+        raise ProfilerUnavailable(
+            f"jax.profiler failed to start: {type(exc).__name__}: {exc}")
+
+
+def _stop_trace() -> None:
+    import jax
+
+    jax.profiler.stop_trace()
+
+
+def active() -> dict | None:
+    """The in-flight capture's public record, or None."""
+    with _lock:
+        if _active is None:
+            return None
+        return {k: v for k, v in _active.items()
+                if not k.startswith("_")}
+
+
+def start(out_dir: str) -> dict:
+    """Begin a capture into ``out_dir``; raises ProfilerBusy /
+    ProfilerUnavailable."""
+    global _active
+    with _lock:
+        if _active is not None:
+            raise ProfilerBusy(
+                f"profile already running into {_active['dir']}")
+        # "started" is a display/persist timestamp (wall); the elapsed
+        # math in stop() uses the monotonic anchor
+        _active = {"dir": out_dir, "started": time.time(),
+                   "_mono": time.monotonic()}
+    try:
+        _start_trace(out_dir)
+    except BaseException:
+        with _lock:
+            _active = None
+        raise
+    return active()
+
+
+def stop() -> dict:
+    """End the in-flight capture; returns its record (raises
+    ProfilerUnavailable when none is running)."""
+    global _active
+    with _lock:
+        rec = _active
+    if rec is None:
+        raise ProfilerUnavailable("no profile is running")
+    try:
+        _stop_trace()
+    finally:
+        with _lock:
+            _active = None
+    mono0 = rec.get("_mono")
+    rec = {k: v for k, v in rec.items() if not k.startswith("_")}
+    rec["seconds"] = round(time.monotonic() - mono0, 3) \
+        if mono0 is not None else 0.0
+    return rec
+
+
+def capture(seconds: float, out_dir: str) -> dict:
+    """One-shot capture: start, sleep ``seconds`` (clamped to
+    ``MAX_CAPTURE_S``), stop.  Blocking -- the debug endpoint runs it on
+    the request's own handler thread."""
+    seconds = min(max(0.0, float(seconds)), MAX_CAPTURE_S)
+    start(out_dir)
+    try:
+        time.sleep(seconds)
+    finally:
+        rec = stop()
+    return rec
+
+
+@contextlib.contextmanager
+def profile_run(out_dir: str | None):
+    """Whole-run capture for the CLIs (``--profile-dir D``); a None dir
+    is a no-op so call sites stay unconditional.  Start failures warn
+    and run unprofiled; the stop is best-effort on the way out."""
+    if not out_dir:
+        yield
+        return
+    try:
+        start(out_dir)
+    except (ProfilerBusy, ProfilerUnavailable) as exc:
+        from ..utils.nn_log import nn_warn
+
+        nn_warn(f"profile: {exc}; run continues unprofiled\n")
+        yield
+        return
+    try:
+        yield
+    finally:
+        with contextlib.suppress(Exception):
+            stop()

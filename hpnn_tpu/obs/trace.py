@@ -1,0 +1,291 @@
+"""Span tracing + the in-process flight recorder.
+
+A *span* is one timed region: ``{"name", "trace", "span", "parent",
+"ts", "dur_s", "thread", ...attrs}``.  Spans belong to a *trace* (one
+request, one training job, one CLI run); parentage makes the dump a
+tree.  Completed spans land in a bounded ring buffer -- the flight
+recorder -- oldest evicted first, so a long-lived server always holds
+the most recent window of activity and a crash dump shows what the
+process was doing right before the fault.
+
+Design constraints (the serving p99 budget):
+
+* **off = free.**  The global state is one module attribute; when it is
+  ``None``, :func:`span` returns a shared no-op singleton and
+  :func:`record` returns immediately -- no object allocation, no lock,
+  no clock read.  The acceptance floor (serve_bench p99 regression
+  < 5 % with tracing disabled) is held by this guard.
+* **on = cheap.**  A span is one small object, two monotonic clock
+  reads, and one deque append under a lock at completion.  Nothing is
+  formatted until a dump is requested.
+* **observe, never perturb.**  Recording never prints, never touches
+  the device, and never raises into the traced code path (ring append
+  failures are impossible by construction; attribute rendering happens
+  at dump time inside the dump call).
+
+Cross-thread correlation: the implicit parent is thread-local (nested
+``with span(...)`` blocks form a stack), and code that hops threads --
+the micro-batcher completing a request admitted by an HTTP thread --
+passes ``trace_id``/``parent_id`` explicitly to :func:`record` with
+measured start/end times.  Trace ids are caller-meaningful strings
+(a request's ``X-HPNN-Trace-Id``, a job id); :func:`new_trace_id`
+mints a random one when the caller has none.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from collections import deque
+
+_DEFAULT_CAPACITY = 8192
+
+# the whole on/off switch: a _State when tracing, None when off
+_state: "_State | None" = None
+_tls = threading.local()
+
+
+class _State:
+    __slots__ = ("ring", "lock", "capacity", "wall_base", "mono_base")
+
+    def __init__(self, capacity: int):
+        self.capacity = int(capacity)
+        self.ring: deque[dict] = deque(maxlen=self.capacity)
+        self.lock = threading.Lock()
+        # one wall/monotonic anchor pair per enable(): span timestamps
+        # are monotonic (elapsed math must survive clock steps) and the
+        # dump renders them as wall time through this anchor
+        self.wall_base = time.time()
+        self.mono_base = time.monotonic()
+
+    def wall(self, mono: float) -> float:
+        return self.wall_base + (mono - self.mono_base)
+
+
+def enabled() -> bool:
+    return _state is not None
+
+
+def enable(capacity: int | None = None) -> None:
+    """Turn tracing on (idempotent; a repeat call with a different
+    capacity re-rings, dropping recorded spans)."""
+    global _state
+    if capacity is None:
+        try:
+            capacity = int(os.environ.get("HPNN_TRACE_BUFFER",
+                                          str(_DEFAULT_CAPACITY)))
+        except ValueError:
+            capacity = _DEFAULT_CAPACITY
+        capacity = max(16, capacity)
+    if _state is not None and _state.capacity == capacity:
+        return
+    _state = _State(capacity)
+
+
+def disable() -> None:
+    global _state
+    _state = None
+
+
+def enable_from_env() -> bool:
+    """Enable when ``HPNN_TRACE`` is set truthy (the init_all / server
+    startup hook); returns the resulting enabled state."""
+    if os.environ.get("HPNN_TRACE", "") not in ("", "0"):
+        enable()
+    return enabled()
+
+
+def new_trace_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_ctx() -> tuple[str, str] | None:
+    """The innermost active span's ``(trace_id, span_id)`` on this
+    thread, or None -- what cross-thread code captures to parent its
+    explicit :func:`record` calls."""
+    st = getattr(_tls, "stack", None)
+    if not st:
+        return None
+    top = st[-1]
+    return (top.trace_id, top.span_id)
+
+
+class _NoopSpan:
+    """Shared do-nothing span: what :func:`span` hands out while
+    tracing is off.  One module-level instance, so the disabled path
+    allocates NOTHING (asserted in tests/test_obs.py)."""
+
+    __slots__ = ()
+    trace_id = ""
+    span_id = ""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def annotate(self, **attrs):
+        return self
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("name", "trace_id", "span_id", "parent_id", "attrs",
+                 "_t0", "_st")
+
+    def __init__(self, st: _State, name: str, trace_id: str | None,
+                 parent_id: str | None, attrs: dict | None):
+        self.name = name
+        self.span_id = uuid.uuid4().hex[:16]
+        self.attrs = attrs
+        self._st = st
+        self._t0 = 0.0
+        if trace_id is None:
+            ctx = current_ctx()
+            if ctx is not None:
+                trace_id, parent_id = ctx[0], ctx[1]
+            else:
+                trace_id = new_trace_id()
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+
+    def annotate(self, **attrs) -> "Span":
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.monotonic()
+        _stack().append(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        t1 = time.monotonic()
+        st = _stack()
+        if st and st[-1] is self:
+            st.pop()
+        if exc_type is not None:
+            self.annotate(error=f"{exc_type.__name__}: {exc}")
+        _append(self._st, self.name, self.trace_id, self.span_id,
+                self.parent_id, self._t0, t1, self.attrs)
+        return False
+
+
+def span(name: str, trace_id: str | None = None,
+         parent_id: str | None = None, **attrs):
+    """Context manager timing one region.  With tracing off this is the
+    shared no-op singleton; on, the span nests under this thread's
+    innermost active span unless ``trace_id``/``parent_id`` pin it
+    explicitly."""
+    st = _state
+    if st is None:
+        return _NOOP
+    return Span(st, name, trace_id, parent_id, attrs or None)
+
+
+def _append(st: _State, name: str, trace_id: str, span_id: str,
+            parent_id: str | None, t0: float, t1: float,
+            attrs: dict | None) -> None:
+    rec = {
+        "name": name,
+        "trace": trace_id,
+        "span": span_id,
+        "parent": parent_id,
+        "ts": round(st.wall(t0), 6),
+        "dur_s": round(t1 - t0, 9),
+        "thread": threading.current_thread().name,
+    }
+    if attrs:
+        rec.update(attrs)
+    with st.lock:
+        st.ring.append(rec)
+
+
+def record(name: str, t0: float, t1: float,
+           trace_id: str | None = None, parent_id: str | None = None,
+           span_id: str | None = None, **attrs) -> str:
+    """Record a completed span from measured ``time.monotonic()``
+    endpoints -- the cross-thread form (the batcher timing a batch
+    segment for each member request).  ``span_id`` lets a caller
+    pre-mint the id (the HTTP handler hands its root span's id to the
+    batcher BEFORE the root completes).  Returns the span id ("" when
+    tracing is off)."""
+    st = _state
+    if st is None:
+        return ""
+    if trace_id is None:
+        ctx = current_ctx()
+        if ctx is not None:
+            trace_id, parent_id = ctx[0], ctx[1]
+        else:
+            trace_id = new_trace_id()
+    if span_id is None:
+        span_id = uuid.uuid4().hex[:16]
+    _append(st, name, trace_id, span_id, parent_id, t0, t1,
+            attrs or None)
+    return span_id
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def snapshot(trace_id: str | None = None,
+             limit: int | None = None) -> list[dict]:
+    """Recorded spans, oldest first; ``trace_id`` filters to one trace,
+    ``limit`` keeps the newest N."""
+    st = _state
+    if st is None:
+        return []
+    with st.lock:
+        spans = list(st.ring)
+    if trace_id is not None:
+        spans = [s for s in spans if s["trace"] == trace_id]
+    if limit is not None:
+        # limit <= 0 means "at most nothing" -- spans[-0:] would be the
+        # WHOLE list, not an empty one
+        spans = spans[-limit:] if limit > 0 else []
+    return spans
+
+
+def dump_ndjson(trace_id: str | None = None,
+                limit: int | None = None) -> str:
+    """The flight-recorder dump: one JSON object per line (NDJSON),
+    oldest span first -- what ``GET /v1/debug/trace`` serves."""
+    spans = snapshot(trace_id=trace_id, limit=limit)
+    if not spans:
+        return ""
+    return "\n".join(json.dumps(s, sort_keys=True) for s in spans) + "\n"
+
+
+def dump_to_dir(dirpath: str, reason: str = "dump") -> str | None:
+    """Write the recorder to ``<dirpath>/trace-<reason>-<pid>.ndjson``
+    (the SIGTERM/fault auto-dump).  Best-effort: returns the path, or
+    None when tracing is off / nothing is recorded / the write fails --
+    a dying process must not die harder because its post-mortem failed."""
+    text = dump_ndjson()
+    if not text:
+        return None
+    path = os.path.join(dirpath, f"trace-{reason}-{os.getpid()}.ndjson")
+    try:
+        os.makedirs(dirpath, exist_ok=True)
+        with open(path, "w") as fp:
+            fp.write(text)
+    except OSError:
+        return None
+    return path

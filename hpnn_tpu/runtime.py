@@ -135,6 +135,11 @@ def init_all(init_verbose: int = 0) -> int:
     """
     init_runtime()
     nn_log.set_verbosity(init_verbose)
+    from .obs import trace as obs_trace
+
+    # HPNN_TRACE=1: span tracing + flight recorder from process start
+    # (the serve CLI can also enable it later via --trace)
+    obs_trace.enable_from_env()
     try:
         import jax
 

@@ -45,7 +45,8 @@ from collections import deque
 
 import numpy as np
 
-from ..utils.nn_log import nn_dbg, nn_warn
+from ..obs import trace as obs_trace
+from ..utils.nn_log import nn_dbg, nn_event, nn_warn
 from .metrics import ServeMetrics
 from .registry import ServedModel
 
@@ -64,16 +65,23 @@ class ServeClosed(Exception):
 
 class _Pending:
     __slots__ = ("xs", "rows", "deadline", "gen", "served_gen", "t_enq",
-                 "t_dispatch", "event", "result", "error")
+                 "t_dispatch", "event", "result", "error", "trace",
+                 "bucket")
 
     def __init__(self, xs: np.ndarray, deadline: float,
-                 gen: int | None = None):
+                 gen: int | None = None,
+                 trace: tuple[str, str] | None = None):
         self.xs = xs
         self.rows = xs.shape[0]
         self.deadline = deadline
         self.gen = gen            # pinned model generation (A/B), or None
         self.served_gen = gen     # generation that actually served it
         #                           (captured at dispatch for unpinned)
+        self.trace = trace        # (trace_id, root_span_id) or None --
+        #                           the HTTP layer's span context; the
+        #                           worker parents this request's batch
+        #                           spans under it (ISSUE 8)
+        self.bucket = 0           # batch bucket served (set at dispatch)
         self.t_enq = time.monotonic()
         self.t_dispatch = 0.0
         self.event = threading.Event()
@@ -124,19 +132,25 @@ class MicroBatcher:
     # --- client side ----------------------------------------------------
     def submit(self, xs: np.ndarray, timeout_s: float,
                gen: int | None = None,
-               return_gen: bool = False) -> np.ndarray:
+               return_gen: bool = False,
+               trace: tuple[str, str] | None = None) -> np.ndarray:
         """Enqueue (rows, n_inputs) float64 inputs and block until the
         batch containing them completes.  Raises QueueFull /
         DeadlineExceeded / ServeClosed; any model exception propagates.
 
         ``gen`` pins the request to one model generation (A/B pinning):
         the worker keeps batches generation-homogeneous, so a pinned
-        request can never ride a batch served by different weights."""
+        request can never ride a batch served by different weights.
+
+        ``trace`` is the HTTP layer's span context ``(trace_id,
+        root_span_id)``: the worker records this request's queue-wait /
+        batch / device segments as child spans under it (ISSUE 8)."""
         rows = xs.shape[0]
         if not 1 <= rows <= self.max_batch:
             raise ValueError(
                 f"request rows {rows} outside [1, {self.max_batch}]")
-        p = _Pending(xs, time.monotonic() + timeout_s, gen=gen)
+        p = _Pending(xs, time.monotonic() + timeout_s, gen=gen,
+                     trace=trace)
         with self._cv:
             if self._closing:
                 raise ServeClosed(f"kernel '{self.model.name}' draining")
@@ -154,7 +168,22 @@ class MicroBatcher:
                 f"no result within {timeout_s:.3f}s")
         if p.error is not None:
             raise p.error
-        self.metrics.latency.observe(time.monotonic() - p.t_enq)
+        lat = time.monotonic() - p.t_enq
+        tid = trace[0] if trace else None
+        self.metrics.latency.observe(lat, trace_id=tid)
+        if p.bucket:
+            # slow-span flag: compare against this kernel+bucket's p99
+            # BEFORE this observation joins the distribution (one
+            # registry-lock trip: the histogram serves both the
+            # threshold and observe)
+            h = self.metrics.bucket_latency(self.model.name, p.bucket)
+            thr = self.metrics.slow_threshold_s(h)
+            h.observe(lat, trace_id=tid)
+            if thr is not None and lat > thr:
+                nn_event("slow_request", kernel=self.model.name,
+                         bucket=p.bucket, latency_ms=round(lat * 1e3, 3),
+                         threshold_ms=round(thr * 1e3, 3),
+                         generation=p.served_gen, trace=tid or "")
         return (p.result, p.served_gen) if return_gen else p.result
 
     # --- worker ---------------------------------------------------------
@@ -214,8 +243,8 @@ class MicroBatcher:
 
     def _dispatch(self, batch: list[_Pending]):
         """Expire stale requests, pad + launch the rest asynchronously.
-        Returns (live, handle, t0) or None when nothing was dispatched.
-        Runs entirely OFF the queue lock."""
+        Returns (live, handle, t0, t_asm1, t_launched) or None when
+        nothing was dispatched.  Runs entirely OFF the queue lock."""
         now = time.monotonic()
         live: list[_Pending] = []
         for p in batch:
@@ -230,6 +259,7 @@ class MicroBatcher:
             return None
         xs = (live[0].xs if len(live) == 1
               else np.concatenate([p.xs for p in live]))
+        t_asm1 = time.monotonic()  # expiry + concat done: assembly wall
         try:
             # unpinned batches keep the two-argument call so registry
             # stand-ins (tests, custom backends) need not know about
@@ -257,15 +287,24 @@ class MicroBatcher:
         else:
             g = getattr(handle, "served_gen", None)
             g = live[0].gen if g is None else g
+        bucket = getattr(handle, "bucket", 0)
         for p in live:
             p.served_gen = g
-        return live, handle, now
+            p.bucket = bucket
+        return live, handle, now, t_asm1, time.monotonic()
 
     def _complete(self, inflight) -> None:
         """D2H-sync one in-flight batch and deliver its slices.  The
         sync happens here, off the queue lock, AFTER the next batch was
-        already dispatched -- that ordering is the pipeline."""
-        live, handle, t0 = inflight
+        already dispatched -- that ordering is the pipeline.
+
+        Observability (ISSUE 8): the batch's measured segments feed the
+        per-phase histograms (once per batch) and, for every member
+        request that carries a trace context, land as child spans under
+        its root -- annotated with the batch composition (bucket, rows,
+        request count), tier, generation and compile-cache outcome."""
+        live, handle, t0, t_asm1, t_launched = inflight
+        t_c0 = time.monotonic()
         try:
             outs = self.model.registry.collect(handle)
         except Exception as exc:  # device/model failure surfaces at D2H
@@ -275,18 +314,61 @@ class MicroBatcher:
                 p.error = exc
                 p.event.set()
             return
+        t_c1 = time.monotonic()
         rows = sum(p.rows for p in live)
         # batch counters fire on COMPLETION, not dispatch: a batch that
         # dies at D2H must not inflate rows_total / fill ratio (PR-1
         # ordering, preserved across the pipeline split)
         self.metrics.count_batch(rows, handle.bucket)
-        self.metrics.count_device(rows, handle.bucket,
-                                  time.monotonic() - t0)
+        self.metrics.count_device(rows, handle.bucket, t_c1 - t0)
+        # getattr: registry stand-ins (tests, custom backends) need not
+        # know about the observability annotations
+        pad_s = getattr(handle, "pad_h2d_s", 0.0)
+        self.metrics.observe_phase("batch_assembly", t_asm1 - t0)
+        self.metrics.observe_phase("pad_h2d", pad_s)
+        self.metrics.observe_phase("device", t_c0 - t_launched)
+        self.metrics.observe_phase("d2h", t_c1 - t_c0)
+        tracing = obs_trace.enabled()
+        if tracing:
+            batch_attrs = {
+                "kernel": self.model.name,
+                "bucket": handle.bucket,
+                "batch_rows": rows,
+                "batch_requests": len(live),
+                "tier": getattr(handle, "tier", "strict"),
+                "cache_hit": bool(getattr(handle, "cache_hit", True)),
+                "generation": live[0].served_gen,
+            }
         off = 0
         for p in live:
             p.result = outs[off:off + p.rows]
             off += p.rows
-            self.metrics.queue_latency.observe(p.t_dispatch - p.t_enq)
+            # queue_latency doubles as the "queue_wait" phase (aliased
+            # at snapshot time -- never observed twice)
+            self.metrics.queue_latency.observe(
+                p.t_dispatch - p.t_enq,
+                trace_id=p.trace[0] if p.trace else None)
+            if tracing and p.trace is not None:
+                tid, root = p.trace
+                obs_trace.record("queue_wait", p.t_enq, p.t_dispatch,
+                                 trace_id=tid, parent_id=root,
+                                 rows=p.rows)
+                obs_trace.record("batch_assembly", t0, t_asm1,
+                                 trace_id=tid, parent_id=root,
+                                 **batch_attrs)
+                # the registry-measured window only: the gap between
+                # batch_assembly and pad_h2d is the callable lookup --
+                # an XLA compile on cache_hit=false, NOT padding time
+                obs_trace.record("pad_h2d", t_launched - pad_s,
+                                 t_launched, trace_id=tid,
+                                 parent_id=root, bucket=handle.bucket)
+                obs_trace.record("device_launch", t_launched, t_c0,
+                                 trace_id=tid, parent_id=root,
+                                 **batch_attrs)
+                obs_trace.record("d2h", t_c0, t_c1, trace_id=tid,
+                                 parent_id=root, bucket=handle.bucket)
+            # spans recorded BEFORE the wakeup: once the submitter
+            # returns, this request's tree is already in the recorder
             p.event.set()
 
     def _loop(self) -> None:

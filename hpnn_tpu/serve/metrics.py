@@ -17,7 +17,9 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import threading
+import time
 from typing import Callable
 
 # log-spaced latency bounds: 100 us .. ~107 s, factor 1.26 (log10 step
@@ -30,14 +32,44 @@ _N_BUCKETS = 61
 _REQUEST_OUTCOMES = ("ok", "queue_full", "deadline", "bad_request",
                      "not_found", "error")
 
+# request-path phases (ISSUE 8): per-phase latency distributions join
+# /metrics so a slow p99 can be attributed without turning tracing on.
+# parse/respond are per-request; the batch-level segments are observed
+# once per device batch (4 histogram observes per launch -- noise next
+# to the launch itself).  queue_wait is NOT a histogram here: the
+# pre-existing ``queue_latency`` histogram already measures exactly
+# that interval and is aliased into the phases snapshot (one observe,
+# one distribution, two names would drift only by being a bug)
+PHASES = ("parse", "batch_assembly", "pad_h2d", "device", "d2h",
+          "respond")
+
+
+def _escape_label(value) -> str:
+    """Prometheus label-value escaping (exposition format: backslash,
+    double quote, newline)."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
 
 class LatencyHistogram:
-    """Log-bucketed latency histogram with percentile estimation."""
+    """Log-bucketed latency histogram with percentile estimation.
+
+    Exemplars (ISSUE 8): an ``observe`` carrying a trace id competes to
+    be the histogram's *exemplar* -- the slowest recent traced
+    observation.  "Recent" is an age window (:data:`EXEMPLAR_MAX_AGE_S`):
+    a new traced observation takes the slot when it is at least as slow
+    as the incumbent OR the incumbent has aged out, so the exemplar
+    always points at a trace id worth pulling from the flight recorder
+    (``/v1/debug/trace?trace=<id>``) rather than an all-time record
+    from hours ago."""
+
+    EXEMPLAR_MAX_AGE_S = 60.0
 
     def __init__(self):
         self._counts = [0] * (_N_BUCKETS + 1)  # +1 overflow bucket
         self._sum = 0.0
         self._n = 0
+        self._exemplar: tuple[float, str, float] | None = None
         self._lock = threading.Lock()
 
     @staticmethod
@@ -52,11 +84,26 @@ class LatencyHistogram:
         """Upper edge of bucket i (seconds)."""
         return _BUCKET_MIN_S * _BUCKET_FACTOR ** i
 
-    def observe(self, seconds: float) -> None:
+    def observe(self, seconds: float, trace_id: str | None = None) -> None:
         with self._lock:
             self._counts[self._bucket(seconds)] += 1
             self._sum += seconds
             self._n += 1
+            if trace_id:
+                ex = self._exemplar
+                now = time.monotonic()  # age math: never wall-clock
+                if (ex is None or seconds >= ex[0]
+                        or now - ex[2] > self.EXEMPLAR_MAX_AGE_S):
+                    self._exemplar = (seconds, trace_id, now)
+
+    def exemplar(self) -> dict | None:
+        """The slowest recent traced observation, or None."""
+        with self._lock:
+            ex = self._exemplar
+        if ex is None:
+            return None
+        return {"seconds": round(ex[0], 6), "trace_id": ex[1],
+                "age_s": round(max(0.0, time.monotonic() - ex[2]), 3)}
 
     @property
     def count(self) -> int:
@@ -83,13 +130,17 @@ class LatencyHistogram:
     def snapshot(self) -> dict:
         with self._lock:
             n, s = self._n, self._sum
-        return {
+        out = {
             "count": n,
             "sum_seconds": round(s, 6),
             "mean_ms": round(s / n * 1e3, 3) if n else 0.0,
             "p50_ms": round(self.percentile(50) * 1e3, 3),
             "p99_ms": round(self.percentile(99) * 1e3, 3),
         }
+        ex = self.exemplar()
+        if ex is not None:
+            out["exemplar"] = ex
+        return out
 
 
 class ServeMetrics:
@@ -101,6 +152,16 @@ class ServeMetrics:
         self.latency = LatencyHistogram()        # whole-request wall
         self.queue_latency = LatencyHistogram()  # enqueue -> dispatch
         self.device_time = LatencyHistogram()    # dispatch -> D2H complete
+        # per-phase request-path latency (ISSUE 8): where the time went
+        # without tracing on -- see PHASES
+        self.phases: dict[str, LatencyHistogram] = {
+            p: LatencyHistogram() for p in PHASES}
+        # per-(kernel, bucket) whole-request latency: the slow-span flag
+        # compares a request against ITS OWN kernel+bucket p99 (a 512-row
+        # batch and a 1-row request have different honest tails, and two
+        # kernels sharing a bucket size can have wildly different costs)
+        self._bucket_latency: dict[tuple[str, int],
+                                   LatencyHistogram] = {}
         self.requests = {k: 0 for k in _REQUEST_OUTCOMES}
         self.rows_total = 0
         self.batches_total = 0
@@ -150,6 +211,45 @@ class ServeMetrics:
             acc[0] += 1
             acc[1] += rows
             acc[2] += seconds
+
+    def observe_phase(self, phase: str, seconds: float,
+                      trace_id: str | None = None) -> None:
+        """One request-path phase duration (see PHASES; unknown names
+        are dropped rather than minting unbounded series)."""
+        h = self.phases.get(phase)
+        if h is not None:
+            h.observe(seconds, trace_id=trace_id)
+
+    def bucket_latency(self, kernel: str, bucket: int) -> LatencyHistogram:
+        """The whole-request latency histogram for one (kernel, batch
+        bucket) pair."""
+        key = (kernel, bucket)
+        with self._lock:
+            h = self._bucket_latency.get(key)
+            if h is None:
+                h = self._bucket_latency[key] = LatencyHistogram()
+            return h
+
+    # the slow-span flag needs a stable distribution before it may fire:
+    # below this many observations a bucket has no meaningful p99
+    SLOW_SPAN_MIN_COUNT = 50
+
+    def slow_threshold_s(self, hist: LatencyHistogram) -> float | None:
+        """``HPNN_SLOW_SPAN_MULT`` x the given bucket histogram's p99,
+        or None while the flag cannot fire (too few observations, knob
+        set to 0, or a malformed knob value).  Takes the histogram, not
+        the bucket id, so the caller pays the registry lock once for
+        both the threshold check and its own observe."""
+        env = os.environ.get("HPNN_SLOW_SPAN_MULT", "")
+        try:
+            mult = float(env) if env else 4.0
+        except ValueError:
+            return None
+        if mult <= 0.0:
+            return None
+        if hist.count < self.SLOW_SPAN_MIN_COUNT:
+            return None
+        return mult * hist.percentile(99)
 
     def count_cache(self, hit: bool) -> None:
         with self._lock:
@@ -254,6 +354,18 @@ class ServeMetrics:
         out["queue_latency"] = self.queue_latency.snapshot()
         out["device_time"] = self.device_time.snapshot()
         out["buckets"] = self.bucket_stats()
+        out["phases"] = {p: h.snapshot() for p, h in self.phases.items()
+                         if h.count}
+        if self.queue_latency.count:
+            # queue_wait IS queue_latency (see PHASES): aliased, never
+            # double-observed
+            out["phases"]["queue_wait"] = out["queue_latency"]
+        with self._lock:
+            blat = dict(self._bucket_latency)
+        by_kernel: dict = {}
+        for (kernel, b), h in sorted(blat.items()):
+            by_kernel.setdefault(kernel, {})[str(b)] = h.snapshot()
+        out["latency_by_bucket"] = by_kernel
         return out
 
     def render_json(self) -> str:
@@ -268,7 +380,8 @@ class ServeMetrics:
         ]
         for outcome, n in sorted(snap["requests"].items()):
             lines.append(
-                f'hpnn_serve_requests_total{{outcome="{outcome}"}} {n}')
+                f'hpnn_serve_requests_total'
+                f'{{outcome="{_escape_label(outcome)}"}} {n}')
         lines += [
             "# HELP hpnn_serve_rows_total Input rows batched to device.",
             "# TYPE hpnn_serve_rows_total counter",
@@ -302,7 +415,8 @@ class ServeMetrics:
         ]
         for name, info in sorted(snap["models"].items()):
             lines.append(
-                f'hpnn_serve_model_generation{{kernel="{name}"}} '
+                f'hpnn_serve_model_generation'
+                f'{{kernel="{_escape_label(name)}"}} '
                 f"{info['generation']}")
         lines += [
             "# HELP hpnn_serve_model_last_reload_timestamp_seconds "
@@ -312,7 +426,8 @@ class ServeMetrics:
         for name, info in sorted(snap["models"].items()):
             lines.append(
                 "hpnn_serve_model_last_reload_timestamp_seconds"
-                f'{{kernel="{name}"}} {info["last_reload_ts"]}')
+                f'{{kernel="{_escape_label(name)}"}} '
+                f'{info["last_reload_ts"]}')
         lines += [
             "# HELP hpnn_serve_generation_requests_total Requests "
             "routed per model generation (A/B pinning).",
@@ -324,7 +439,8 @@ class ServeMetrics:
                     key=lambda kv: -1 if kv[0] == "older" else int(kv[0])):
                 lines.append(
                     "hpnn_serve_generation_requests_total"
-                    f'{{kernel="{kernel}",generation="{gen}"}} {n}')
+                    f'{{kernel="{_escape_label(kernel)}",'
+                    f'generation="{_escape_label(gen)}"}} {n}')
         if snap.get("jobs") is not None:
             j = snap["jobs"]
             running = j.get("running") or {}
@@ -362,13 +478,17 @@ class ServeMetrics:
                 "# TYPE hpnn_jobs_total gauge",
             ]
             for status, n in sorted(j.get("by_status", {}).items()):
-                lines.append(f'hpnn_jobs_total{{status="{status}"}} {n}')
+                lines.append(
+                    f'hpnn_jobs_total'
+                    f'{{status="{_escape_label(status)}"}} {n}')
         lines += [
             "# HELP hpnn_serve_queue_depth Requests waiting per kernel.",
             "# TYPE hpnn_serve_queue_depth gauge",
         ]
         for name, depth in sorted(snap["queue_depth"].items()):
-            lines.append(f'hpnn_serve_queue_depth{{kernel="{name}"}} {depth}')
+            lines.append(
+                f'hpnn_serve_queue_depth'
+                f'{{kernel="{_escape_label(name)}"}} {depth}')
         lines += [
             "# HELP hpnn_serve_bucket_rows_per_sec Device rows/sec per "
             "batch bucket.",
@@ -401,4 +521,46 @@ class ServeMetrics:
                 f"hpnn_serve_{key}_seconds_sum {h['sum_seconds']}",
                 f"hpnn_serve_{key}_seconds_count {h['count']}",
             ]
+        if snap["phases"]:
+            lines += [
+                "# HELP hpnn_serve_phase_seconds Request-path phase "
+                "latency (parse/queue_wait/batch_assembly/pad_h2d/"
+                "device/d2h/respond).",
+                "# TYPE hpnn_serve_phase_seconds summary",
+            ]
+            for ph, h in sorted(snap["phases"].items()):
+                lab = _escape_label(ph)
+                lines += [
+                    f'hpnn_serve_phase_seconds{{phase="{lab}",'
+                    f'quantile="0.5"}} {h["p50_ms"] / 1e3}',
+                    f'hpnn_serve_phase_seconds{{phase="{lab}",'
+                    f'quantile="0.99"}} {h["p99_ms"] / 1e3}',
+                    f'hpnn_serve_phase_seconds_sum{{phase="{lab}"}} '
+                    f'{h["sum_seconds"]}',
+                    f'hpnn_serve_phase_seconds_count{{phase="{lab}"}} '
+                    f'{h["count"]}',
+                ]
+        if snap["latency_by_bucket"]:
+            lines += [
+                "# HELP hpnn_serve_bucket_latency_seconds Whole-request "
+                "latency per kernel and batch bucket.",
+                "# TYPE hpnn_serve_bucket_latency_seconds summary",
+            ]
+            for kernel, buckets in sorted(
+                    snap["latency_by_bucket"].items()):
+                klab = _escape_label(kernel)
+                for bucket, h in sorted(buckets.items(),
+                                        key=lambda kv: int(kv[0])):
+                    pre = (f'hpnn_serve_bucket_latency_seconds'
+                           f'{{kernel="{klab}",bucket="{bucket}"')
+                    lines += [
+                        f'{pre},quantile="0.5"}} {h["p50_ms"] / 1e3}',
+                        f'{pre},quantile="0.99"}} {h["p99_ms"] / 1e3}',
+                        f'hpnn_serve_bucket_latency_seconds_sum'
+                        f'{{kernel="{klab}",bucket="{bucket}"}} '
+                        f'{h["sum_seconds"]}',
+                        f'hpnn_serve_bucket_latency_seconds_count'
+                        f'{{kernel="{klab}",bucket="{bucket}"}} '
+                        f'{h["count"]}',
+                    ]
         return "\n".join(lines) + "\n"

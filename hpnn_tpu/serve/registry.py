@@ -108,17 +108,27 @@ class _ScratchPool:
 
 class _InFlight:
     """One dispatched bucket: the device-side result plus the scratch
-    buffer to recycle once the result is collected."""
+    buffer to recycle once the result is collected.  Carries the batch's
+    observability annotations (ISSUE 8) -- tier/cache outcome and the
+    measured pad+launch wall -- so the batcher can stamp them onto the
+    member requests' spans and the per-phase histograms without a second
+    trip into the registry."""
 
-    __slots__ = ("out", "rows", "bucket", "served_gen", "_buf", "_pool")
+    __slots__ = ("out", "rows", "bucket", "served_gen", "tier",
+                 "cache_hit", "pad_h2d_s", "_buf", "_pool")
 
     def __init__(self, out, rows: int, bucket: int,
-                 buf, pool: _ScratchPool, served_gen: int | None = None):
+                 buf, pool: _ScratchPool, served_gen: int | None = None,
+                 tier: str = "strict", cache_hit: bool = True,
+                 pad_h2d_s: float = 0.0):
         self.out = out
         self.rows = rows
         self.bucket = bucket
         self.served_gen = served_gen  # pinned dispatch: the generation
         #                               whose weights actually launched
+        self.tier = tier
+        self.cache_hit = cache_hit
+        self.pad_h2d_s = pad_h2d_s
         self._buf = buf
         self._pool = pool
 
@@ -672,7 +682,7 @@ class ModelRegistry:
             fn = self._cache.get(key)
             if fn is not None:
                 self.metrics.count_cache(hit=True)
-                return fn
+                return fn, tier, True
             from .. import ops
 
             kind = model.kind
@@ -729,7 +739,7 @@ class ModelRegistry:
             nn_dbg(f"serve: compile-cache miss "
                    f"(model={model.name} bucket={bucket} tier={tier} "
                    f"path={path})\n")
-            return fn
+            return fn, tier, False
 
     def dispatch(self, model: ServedModel, xs: np.ndarray,
                  gen: int | None = None) -> _InFlight:
@@ -747,7 +757,12 @@ class ModelRegistry:
         assert 1 <= rows <= self.max_batch, rows
         bucket = bucket_rows(rows, self.max_batch)
         pinned = gen is not None
-        fn = self._callable_for(model, bucket, pinned=pinned)
+        fn, tier, cache_hit = self._callable_for(model, bucket,
+                                                 pinned=pinned)
+        # pad + H2D/launch wall, measured per BATCH (two clock reads):
+        # feeds the per-phase p50/p99 gauges and the member requests'
+        # pad_h2d spans when tracing is on
+        t0 = _time.monotonic()
         pool = model.scratch_pool()
         buf = pool.acquire(bucket)
         buf[:rows] = xs
@@ -760,7 +775,9 @@ class ModelRegistry:
         else:
             out = fn(buf)
         return _InFlight(out, rows, bucket, buf, pool,
-                         served_gen=served_gen)
+                         served_gen=served_gen, tier=tier,
+                         cache_hit=cache_hit,
+                         pad_h2d_s=_time.monotonic() - t0)
 
     def collect(self, handle: _InFlight) -> np.ndarray:
         """Materialize a dispatched bucket as float64 host rows (the D2H

@@ -11,10 +11,28 @@ Endpoints:
 * ``GET /healthz``  -- readiness + registered kernel list: ``200 ok``
   only once every background warmup finished (``503 warming`` before,
   ``503 draining`` during shutdown), so load balancers admit traffic
-  when the compile cache is hot.
+  when the compile cache is hot.  The body also reports ``uptime_s``
+  (monotonic, since app construction), ``queue_depth`` (queued rows per
+  kernel -- the batchers' live gauges), and ``active_jobs`` (queued +
+  running training jobs; 0 when jobs are disabled); the ok/warming
+  status contract is unchanged by these fields.
 * ``GET /metrics``  -- Prometheus text; ``?format=json`` for the JSON
   snapshot (what scripts/serve_bench.py consumes); includes per-kernel
-  model generation + last-reload-timestamp gauges and reload counters.
+  model generation + last-reload-timestamp gauges, reload counters, and
+  per-phase (parse/queue-wait/pad+H2D/device/D2H/respond) latency
+  summaries; the JSON snapshot's histograms carry trace-id exemplars
+  (the slowest recent traced request).
+* ``GET /v1/debug/trace[?trace=ID&limit=N]`` -- the observability
+  flight recorder (hpnn_tpu.obs) as NDJSON, one completed span per
+  line; 404 until tracing is enabled (``--trace`` / ``HPNN_TRACE=1``).
+  Each infer request's trace id (``X-HPNN-Trace-Id`` request header, or
+  generated) is echoed in the response header + body, and its span tree
+  (parse -> queue-wait -> batch-assembly -> pad/H2D -> device launch ->
+  D2H -> respond) is recorded here.
+* ``POST /v1/debug/profile`` -- ``{"seconds": N, "dir": PATH?}``:
+  capture a chip-side XLA/TSL profile from the live server via
+  jax.profiler (auth-guarded; 409 while one runs, 501 when the
+  profiler is unavailable); default destination is ``--profile-dir``.
 * ``POST /v1/kernels/<name>/reload`` -- hot-swap the model's weights
   from disk (optional body ``{"kernel": "<path>"}``) without dropping
   in-flight traffic; same-topology swaps reuse every compiled batch
@@ -53,6 +71,7 @@ Status mapping (distinct by failure class, so clients can react):
   404   unknown kernel / job / pinned generation
   409   reload failed / job action in a conflicting state
   429   queue full (backpressure -- retry later; Retry-After: 1)
+  501   device profiler unavailable on this host/backend
   503   server draining (shutdown in progress) / jobs disabled
   504   deadline exceeded (queued or computed past the timeout)
   ====  ==========================================================
@@ -156,10 +175,25 @@ class ServeApp:
                  mesh_devices: int | None = 0,
                  warmup_workers: int | None = None,
                  auth_token: str | None = None,
-                 ab_fraction: float = 0.0):
+                 ab_fraction: float = 0.0,
+                 trace: bool | None = None,
+                 profile_dir: str | None = None):
         self.metrics = metrics or ServeMetrics()
         self.auth_token = auth_token or None
         self.jobs = None  # JobScheduler once enable_jobs() runs
+        self.started_mono = time.monotonic()  # /healthz uptime_s
+        self.profile_dir = profile_dir  # /v1/debug/profile default dest
+        # span tracing (ISSUE 8): explicit flag wins -- True enables,
+        # False disables (even when HPNN_TRACE was set at init_all);
+        # None defers to the env
+        from ..obs import trace as obs_trace
+
+        if trace:
+            obs_trace.enable()
+        elif trace is None:
+            obs_trace.enable_from_env()
+        else:
+            obs_trace.disable()
         mesh = None
         if parity == "fast" and mesh_devices != 0:  # 0: explicitly off
             from ..parallel.mesh import data_mesh
@@ -336,8 +370,13 @@ class ServeApp:
         if not rel:
             state["gen"] = gen
             return None
+        from ..obs import trace as obs_trace
+
         try:
-            result = self.reload_model(name, os.path.join(ckpt_dir, rel))
+            with obs_trace.span("serve.hot_swap", kernel=name,
+                                manifest_generation=gen):
+                result = self.reload_model(name,
+                                           os.path.join(ckpt_dir, rel))
         except Exception as exc:
             # do NOT mark the generation consumed: a transient failure
             # (mid-prune bundle, FS hiccup) on the run's LAST bump would
@@ -377,12 +416,61 @@ class ServeApp:
                f"(every {interval_s:g}s)\n")
         return t
 
+    # --- observability ---------------------------------------------------
+    def uptime_s(self) -> float:
+        return time.monotonic() - self.started_mono
+
+    def handle_debug_profile(self, body: bytes) -> dict:
+        """POST /v1/debug/profile: capture an on-device (XLA/TSL)
+        profile from the LIVE server for ``{"seconds": N}`` -- traffic
+        keeps flowing; the profiler observes from the side.  Optional
+        ``{"dir": PATH}`` overrides the server's ``--profile-dir``; with
+        neither, a fresh temp directory is minted and returned.  409
+        while another capture runs (the profiler is a process
+        singleton), 501 when jax.profiler cannot start here."""
+        from ..obs import profiler
+
+        req = {}
+        if body.strip():
+            try:
+                req = json.loads(body.decode("utf-8"))
+            except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+                raise _HTTPError(400, "bad_request", f"bad JSON: {exc}")
+            if not isinstance(req, dict):
+                raise _HTTPError(400, "bad_request",
+                                 "body must be an object")
+        try:
+            seconds = float(req.get("seconds", 1.0))
+        except (TypeError, ValueError):
+            raise _HTTPError(400, "bad_request", "bad 'seconds'")
+        if not 0.0 < seconds <= profiler.MAX_CAPTURE_S:
+            raise _HTTPError(
+                400, "bad_request",
+                f"'seconds' must be in (0, {profiler.MAX_CAPTURE_S:g}]")
+        out_dir = req.get("dir") or self.profile_dir
+        if out_dir is None:
+            import tempfile
+
+            out_dir = tempfile.mkdtemp(prefix="hpnn-profile-")
+        try:
+            rec = profiler.capture(seconds, out_dir)
+        except profiler.ProfilerBusy as exc:
+            raise _HTTPError(409, "profile_busy", str(exc))
+        except profiler.ProfilerUnavailable as exc:
+            raise _HTTPError(501, "profile_unavailable", str(exc))
+        rec["requested_seconds"] = seconds
+        return rec
+
     # --- request handling (transport-independent) ----------------------
     def handle_infer(self, name: str, body: bytes,
-                     headers=None) -> dict:
+                     headers=None,
+                     trace_ctx: tuple[str, str] | None = None) -> dict:
+        from ..obs import trace as obs_trace
+
         b = self.batchers.get(name)
         if b is None:
             raise _HTTPError(404, "not_found", f"unknown kernel '{name}'")
+        t_parse0 = time.monotonic()
         try:
             req = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as exc:
@@ -430,9 +518,16 @@ class ServeApp:
                 timeout_s = float(req["timeout_ms"]) / 1e3
             except (TypeError, ValueError):
                 raise _HTTPError(400, "bad_request", "bad timeout_ms")
+        t_parse1 = time.monotonic()
+        self.metrics.observe_phase("parse", t_parse1 - t_parse0)
+        if trace_ctx is not None:
+            obs_trace.record("parse", t_parse0, t_parse1,
+                             trace_id=trace_ctx[0],
+                             parent_id=trace_ctx[1], rows=int(xs.shape[0]))
         try:
             outs, served_gen = b.submit(xs, timeout_s, gen=gen,
-                                        return_gen=True)
+                                        return_gen=True,
+                                        trace=trace_ctx)
         except QueueFull as exc:
             raise _HTTPError(429, "queue_full", str(exc))
         except DeadlineExceeded as exc:
@@ -444,12 +539,15 @@ class ServeApp:
         if served_gen is None:  # registry stand-ins without generations
             served_gen = gen if gen is not None else model.generation
         self.metrics.count_generation(name, served_gen)
-        return {
+        out = {
             "kernel": name,
             "generation": int(served_gen),
             "outputs": outs.tolist(),
             "argmax": [int(i) for i in np.argmax(outs, axis=1)],
         }
+        if trace_ctx is not None:
+            out["trace"] = trace_ctx[0]
+        return out
 
     def handle_reload(self, name: str, body: bytes) -> dict:
         """POST /v1/kernels/<name>/reload: optional JSON body
@@ -603,12 +701,45 @@ class _Handler(BaseHTTPRequestHandler):
                 status = "warming"
             else:
                 status = "ok"
+            # ok/warming/draining status contract unchanged (ISSUE 8
+            # satellite): the new fields ride along for load balancers
+            # and autoscalers -- uptime, per-kernel queue backlog, and
+            # how many training jobs hold/await the device
+            jobs = self.app.jobs
             body = {"status": status,
                     "kernels": self.app.registry.names(),
-                    "parity": self.app.registry.parity}
+                    "parity": self.app.registry.parity,
+                    "uptime_s": round(self.app.uptime_s(), 3),
+                    "queue_depth": {name: b.depth() for name, b in
+                                    self.app.batchers.items()},
+                    "active_jobs": 0 if jobs is None else
+                    jobs.queue.depth() + (1 if jobs._current else 0)}
             if warming:
                 body["warming"] = warming
             self._reply(200 if status == "ok" else 503, body)
+            return
+        if path == "/v1/debug/trace":
+            from ..obs import trace as obs_trace
+
+            if not obs_trace.enabled():
+                self._reply(404, {"error": "tracing is disabled (start "
+                                  "serve_nn with --trace or HPNN_TRACE=1)",
+                                  "reason": "tracing_disabled"})
+                return
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+            limit = None
+            if params.get("limit"):
+                try:
+                    limit = int(params["limit"])
+                except ValueError:
+                    self._reply(400, {"error": "bad limit",
+                                      "reason": "bad_request"})
+                    return
+            text = obs_trace.dump_ndjson(
+                trace_id=params.get("trace") or None, limit=limit)
+            self._reply(200, text.encode("utf-8"),
+                        content_type="application/x-ndjson")
             return
         if path == "/metrics":
             if "format=json" in query:
@@ -705,7 +836,8 @@ class _Handler(BaseHTTPRequestHandler):
         r = _RELOAD_RE.match(path)
         t = _TRAIN_RE.match(path)
         a = _JOB_ACTION_RE.match(path)
-        if (r or t or a) and not self.app.authorized(self.headers):
+        prof = path == "/v1/debug/profile"
+        if (r or t or a or prof) and not self.app.authorized(self.headers):
             # every mutating endpoint sits behind the auth token when
             # one is configured; infer/metrics/healthz stay open
             self._reply(401, {"error": "missing or invalid auth token",
@@ -744,23 +876,73 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             self._reply(200, out)
             return
+        if prof:
+            try:
+                out = self.app.handle_debug_profile(body)
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+                return
+            self._reply(200, out)
+            return
         m = _INFER_RE.match(path)
         if m is None:
             self.app.metrics.count_request("not_found")
             self._reply(404, {"error": f"no route {self.path}"})
             return
+        from ..obs import trace as obs_trace
+
+        # trace id: accepted from the client (X-HPNN-Trace-Id) or minted
+        # when tracing is on; echoed back either way so a client can
+        # always correlate its request with a later recorder dump.  The
+        # root span context rides down through batcher + registry --
+        # with tracing OFF trace_ctx stays None and this whole block is
+        # one header read (the zero-cost guard).
+        trace_hdr = (self.headers.get("X-HPNN-Trace-Id") or "").strip()
+        trace_ctx = None
+        if obs_trace.enabled():
+            trace_ctx = (trace_hdr or obs_trace.new_trace_id(),
+                         obs_trace.new_span_id())
+        echo = ({"X-HPNN-Trace-Id": trace_ctx[0]} if trace_ctx
+                else ({"X-HPNN-Trace-Id": trace_hdr} if trace_hdr
+                      else None))
+        t_req0 = time.monotonic()
         try:
             out = self.app.handle_infer(m.group(1), body,
-                                        headers=self.headers)
+                                        headers=self.headers,
+                                        trace_ctx=trace_ctx)
         except _HTTPError as exc:
             self.app.metrics.count_request(exc.outcome)
-            headers = {"Retry-After": "1"} if exc.status == 429 else None
+            headers = dict(echo or {})
+            if exc.status == 429:
+                headers["Retry-After"] = "1"
+            if trace_ctx is not None:
+                obs_trace.record("serve.request", t_req0,
+                                 time.monotonic(), trace_id=trace_ctx[0],
+                                 span_id=trace_ctx[1],
+                                 kernel=m.group(1), outcome=exc.outcome,
+                                 status=exc.status)
             self._reply(exc.status,
                         {"error": str(exc), "reason": exc.outcome},
-                        extra_headers=headers)
+                        extra_headers=headers or None)
             return
         self.app.metrics.count_request("ok")
-        self._reply(200, out)
+        if trace_ctx is not None:
+            # the root completes BEFORE the response bytes leave: by the
+            # time the client can query /v1/debug/trace, its tree is in
+            # the recorder (the respond span lands right after the write)
+            obs_trace.record("serve.request", t_req0, time.monotonic(),
+                             trace_id=trace_ctx[0], span_id=trace_ctx[1],
+                             kernel=m.group(1), outcome="ok",
+                             generation=out.get("generation"))
+        t_resp0 = time.monotonic()
+        self._reply(200, out, extra_headers=echo)
+        t_resp1 = time.monotonic()
+        self.app.metrics.observe_phase("respond", t_resp1 - t_resp0)
+        if trace_ctx is not None:
+            obs_trace.record("respond", t_resp0, t_resp1,
+                             trace_id=trace_ctx[0],
+                             parent_id=trace_ctx[1])
 
 
 class _Server(ThreadingHTTPServer):

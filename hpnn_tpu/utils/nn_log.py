@@ -16,13 +16,23 @@ not pull in jax.
 The tutorials scrape this grammar with grep/awk (e.g.
 ``tutorials/mnist/tutorial.bash:179-183`` counts PASS lines), so these exact
 strings are a de-facto API of the framework.
+
+``HPNN_LOG_JSON=1`` (ISSUE 8) switches EMISSION to one JSON object per
+line (``{"ts","level","msg"}``) for log pipelines; gating/capture are
+unchanged and the default stays byte-identical to the reference.
+:func:`nn_event` emits structured operational events (the serve layer's
+slow-request flag) -- JSON objects in JSON mode, an ``NN(WARN)`` line
+otherwise.
 """
 
 from __future__ import annotations
 
 import contextlib
+import json
+import os
 import sys
 import threading
+import time
 
 _verbosity = 0
 _is_main_process: bool | None = None
@@ -70,6 +80,50 @@ def _emit(stream, text: str) -> None:
         stream.flush()
 
 
+# --- machine-readable mode (HPNN_LOG_JSON=1) --------------------------------
+# The reference grammar is a de-facto API scraped with grep/awk; log
+# pipelines want one JSON object per line instead.  The knob rewrites the
+# EMISSION format only: verbosity gates, rank-0 gating and capture/replay
+# are identical in both modes, so flipping it can never change WHICH
+# lines appear -- only how they are rendered.  Off (the default) is
+# byte-identical to the reference stream.
+
+def log_json_enabled() -> bool:
+    return os.environ.get("HPNN_LOG_JSON", "") not in ("", "0")
+
+
+def _write(stream, level: str, prefix: str, text: str) -> None:
+    """One gated log line: reference-format ``prefix + text``, or a JSON
+    object when HPNN_LOG_JSON=1."""
+    if log_json_enabled():
+        _emit(stream, json.dumps({"ts": round(time.time(), 3),
+                                  "level": level, "msg": text}) + "\n")
+    else:
+        _emit(stream, prefix + text)
+
+
+def nn_event(event: str, **fields) -> None:
+    """A structured operational event (e.g. the serve layer's
+    slow-request flag).  HPNN_LOG_JSON=1 emits one ungated JSON line
+    (machine consumers opted in; an event is data, not chatter); text
+    mode renders ``event: k=v ...`` through :func:`nn_warn`, so the
+    normal verbosity gate applies."""
+    if log_json_enabled():
+        # render the FULL record before the capture check: a captured
+        # event replays byte-identically to a direct emission (one
+        # schema; ts = original emission time)
+        rec = {"ts": round(time.time(), 3), "level": "event",
+               "event": event}
+        rec.update(fields)
+        line = json.dumps(rec)
+        if _capture("event", line):
+            return
+        _emit(sys.stdout, line + "\n")
+        return
+    body = " ".join(f"{k}={v}" for k, v in fields.items())
+    nn_warn(f"{event}: {body}\n")
+
+
 # --- deferred emission (thread-local capture) -------------------------------
 # The parallel corpus loader (io/corpus.py) parses files on worker threads
 # but must keep the console stream byte-identical to the serial loader:
@@ -96,6 +150,9 @@ def replay(entries) -> None:
     fns = {"dbg": nn_dbg, "out": nn_out, "cout": nn_cout,
            "warn": nn_warn, "error": nn_error, "raw": nn_raw}
     for level, text in entries:
+        if level == "event":  # captured structured event (JSON mode)
+            _emit(sys.stdout, text if text.endswith("\n") else text + "\n")
+            continue
         fns[level](text)
 
 
@@ -111,14 +168,14 @@ def nn_dbg(text: str) -> None:
     if _capture("dbg", text):
         return
     if _verbosity > 2:
-        _emit(sys.stdout, "NN(DBG): " + text)
+        _write(sys.stdout, "dbg", "NN(DBG): ", text)
 
 
 def nn_out(text: str) -> None:
     if _capture("out", text):
         return
     if _verbosity > 1:
-        _emit(sys.stdout, "NN: " + text)
+        _write(sys.stdout, "out", "NN: ", text)
 
 
 def nn_cout(text: str) -> None:
@@ -126,20 +183,20 @@ def nn_cout(text: str) -> None:
     if _capture("cout", text):
         return
     if _verbosity > 1:
-        _emit(sys.stdout, text)
+        _write(sys.stdout, "cout", "", text)
 
 
 def nn_warn(text: str) -> None:
     if _capture("warn", text):
         return
     if _verbosity > 0:
-        _emit(sys.stdout, "NN(WARN): " + text)
+        _write(sys.stdout, "warn", "NN(WARN): ", text)
 
 
 def nn_error(text: str) -> None:
     if _capture("error", text):
         return
-    _emit(sys.stderr, "NN(ERR): " + text)
+    _write(sys.stderr, "error", "NN(ERR): ", text)
 
 
 def nn_raw(text: str) -> None:
@@ -151,4 +208,7 @@ def nn_raw(text: str) -> None:
     if _capture("raw", text):
         return
     if text:
-        _emit(sys.stdout, text)
+        if log_json_enabled():
+            _write(sys.stdout, "raw", "", text)
+        else:
+            _emit(sys.stdout, text)

@@ -24,6 +24,11 @@ Here both are runtime knobs instead of recompile-and-insert:
 
 Both print on the main process only, whatever the verbosity -- like the
 reference's macros, which bypass the ``_OUT`` verbosity gates.
+
+ISSUE 8: ``phase`` additionally records a structured span into the
+observability flight recorder (``hpnn_tpu.obs``) whenever span tracing
+is enabled (``HPNN_TRACE=1`` / ``serve_nn --trace``) -- the #PROF print
+side is unchanged and the two knobs are independent.
 """
 
 from __future__ import annotations
@@ -71,19 +76,30 @@ def trace_weights(weights, tag: str) -> None:
 
 
 @contextmanager
-def phase(name: str):
-    """Time a driver phase when HPNN_PROFILE=1; prints ``#PROF:`` lines.
+def phase(name: str, **attrs):
+    """Time a driver phase: prints ``#PROF:`` lines when HPNN_PROFILE=1,
+    and records a real span into the flight recorder when span tracing
+    is on (hpnn_tpu.obs -- ISSUE 8 upgraded these timers into spans:
+    same call sites, the span nests under this thread's active span so
+    per-epoch phase trees come out of the existing phase structure).
+    ``attrs`` land on the span; the #PROF line format is unchanged.
 
     Device work launched inside the phase is only fully counted if the
     phase ends in a host read (the drivers' phases all do -- weights come
     back as np arrays); async dispatches that escape the block land in a
     later phase, same caveat as any wall-clock timer under JAX.
     """
-    if not profile_enabled():
-        yield
+    from ..obs import trace as obs_trace
+
+    prof = profile_enabled()
+    sp = obs_trace.span(name, **attrs)  # shared no-op when tracing off
+    if not prof:
+        with sp:
+            yield
         return
     t0 = time.perf_counter()
     try:
-        yield
+        with sp:
+            yield
     finally:
         _emit(f"#PROF: {name} {time.perf_counter() - t0:.3f}s\n")

@@ -1,0 +1,805 @@
+"""Observability subsystem (ISSUE 8): span tracing, flight recorder,
+per-phase metrics, exposition lint, and the trace e2e.
+
+Fast tier: span/recorder semantics (incl. the zero-allocation off
+guard), LatencyHistogram edge cases + concurrency, the Prometheus
+exposition-format lint, healthz fields, the monotonic-clock audit over
+serve/jobs/ckpt, nn_log's JSON mode, the slow-span flag, and the
+byte-parity pin (train_nn output identical with tracing on vs off, BP
+and BPM).  Slow tier: the acceptance e2e -- one trace id submitted with
+an infer request under live job traffic yields a complete parent/child
+span tree in the /v1/debug/trace NDJSON dump, server + batcher +
+registry correlated -- and a live jax.profiler capture through
+POST /v1/debug/profile.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import cli, obs
+from hpnn_tpu.obs import trace as obs_trace
+from hpnn_tpu.serve.metrics import (
+    _BUCKET_MIN_S,
+    _N_BUCKETS,
+    LatencyHistogram,
+    ServeMetrics,
+)
+from hpnn_tpu.serve.server import ServeApp, serve_in_thread
+from hpnn_tpu.utils import nn_log
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """Every test starts and ends with tracing OFF and verbosity 0."""
+    obs.disable()
+    nn_log.set_verbosity(0)
+    yield
+    obs.disable()
+    nn_log.set_verbosity(0)
+
+
+def _write_corpus(dirpath, rng, n):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n")
+            fp.write(" ".join(f"{v:7.5f}" for v in x) + "\n")
+            fp.write(f"[output] {N_OUT}\n")
+            fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+def _serve_conf(tmp_path, name="tiny", seed=1234):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    return str(conf)
+
+
+def _http_json(url, payload=None, headers=None):
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data,
+                                 headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return (resp.status, json.loads(resp.read().decode()),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode()), dict(exc.headers)
+
+
+# --- span / flight-recorder semantics ---------------------------------------
+
+def test_span_nesting_parent_child_and_attrs():
+    obs.enable(capacity=64)
+    with obs.span("outer", kind="test"):
+        with obs.span("inner"):
+            time.sleep(0.002)
+    spans = obs.snapshot()
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+    inner, outer = spans
+    assert inner["trace"] == outer["trace"]
+    assert inner["parent"] == outer["span"]
+    assert outer["parent"] is None
+    assert outer["kind"] == "test"
+    assert inner["dur_s"] >= 0.002
+    assert outer["dur_s"] >= inner["dur_s"]
+
+
+def test_ring_is_bounded_oldest_evicted():
+    obs.enable(capacity=16)
+    for i in range(40):
+        with obs.span(f"s{i}"):
+            pass
+    spans = obs.snapshot()
+    assert len(spans) == 16
+    assert spans[0]["name"] == "s24" and spans[-1]["name"] == "s39"
+    # limit edge cases: 0 is "nothing", not "everything" (the -0 slice
+    # trap), negatives likewise
+    assert obs.snapshot(limit=0) == []
+    assert obs.snapshot(limit=-3) == []
+    assert [s["name"] for s in obs.snapshot(limit=2)] == ["s38", "s39"]
+
+
+def test_serveapp_trace_false_wins_over_env():
+    obs.enable()  # e.g. HPNN_TRACE picked up at init_all
+    app = ServeApp(max_batch=4, trace=False)
+    assert not obs.enabled()  # explicit opt-out disables
+    app.close()
+
+
+def test_disabled_is_zero_allocation_noop():
+    assert not obs.enabled()
+    # the off path hands out ONE shared singleton -- no allocation
+    assert obs.span("a") is obs.span("b", x=1)
+    with obs.span("c") as sp:
+        sp.annotate(y=2)
+    assert obs.record("d", 0.0, 1.0) == ""
+    assert obs.snapshot() == []
+    assert obs.dump_ndjson() == ""
+
+
+def test_record_explicit_context_and_dump_filter(tmp_path):
+    obs.enable(capacity=64)
+    t0 = time.monotonic()
+    root = obs_trace.new_span_id()
+    obs.record("root", t0, t0 + 0.5, trace_id="t-1", span_id=root)
+    obs.record("child", t0, t0 + 0.1, trace_id="t-1", parent_id=root,
+               bucket=8)
+    obs.record("other", t0, t0 + 0.1, trace_id="t-2")
+    dump = obs.dump_ndjson(trace_id="t-1")
+    lines = [json.loads(ln) for ln in dump.splitlines()]
+    assert {ln["name"] for ln in lines} == {"root", "child"}
+    child = next(ln for ln in lines if ln["name"] == "child")
+    assert child["parent"] == root and child["bucket"] == 8
+    assert abs(child["dur_s"] - 0.1) < 1e-6
+    path = obs.dump_to_dir(str(tmp_path / "dumps"), reason="test")
+    assert path is not None and os.path.isfile(path)
+    with open(path) as fp:
+        assert len(fp.read().splitlines()) == 3
+
+
+def test_phase_records_span_and_keeps_prof_line(monkeypatch, capsys):
+    from hpnn_tpu.utils.trace import phase
+
+    obs.enable(capacity=16)
+    monkeypatch.setenv("HPNN_PROFILE", "1")
+    with phase("unit_phase"):
+        pass
+    out = capsys.readouterr().out
+    assert re.search(r"#PROF: unit_phase [0-9.]+s", out)
+    assert [s["name"] for s in obs.snapshot()] == ["unit_phase"]
+    # spans alone (HPNN_PROFILE off) never print
+    monkeypatch.delenv("HPNN_PROFILE")
+    with phase("quiet_phase"):
+        pass
+    assert capsys.readouterr().out == ""
+    assert obs.snapshot()[-1]["name"] == "quiet_phase"
+
+
+# --- LatencyHistogram edge cases (satellite) --------------------------------
+
+def test_histogram_empty_percentile_is_zero():
+    h = LatencyHistogram()
+    assert h.percentile(50) == 0.0
+    assert h.percentile(99) == 0.0
+    snap = h.snapshot()
+    assert snap["count"] == 0 and snap["p99_ms"] == 0.0
+    assert snap["mean_ms"] == 0.0 and "exemplar" not in snap
+
+
+def test_histogram_below_first_bucket_edge():
+    h = LatencyHistogram()
+    h.observe(0.0)
+    h.observe(1e-9)
+    h.observe(-1.0)  # garbage input degrades, never raises
+    assert h.count == 3
+    # everything landed in bucket 0: the estimate is its upper edge
+    assert h.percentile(50) == pytest.approx(_BUCKET_MIN_S)
+    assert h.percentile(99.9) == pytest.approx(_BUCKET_MIN_S)
+
+
+def test_histogram_above_last_bucket_edge():
+    h = LatencyHistogram()
+    h.observe(1e9)  # far past the ~107 s top edge -> overflow bucket
+    top = _BUCKET_MIN_S * (10.0 ** 0.1) ** _N_BUCKETS
+    assert h.percentile(50) == pytest.approx(top)
+    assert h.snapshot()["p99_ms"] == pytest.approx(top * 1e3, rel=1e-6)
+    # the sum keeps the TRUE value even though the bucket saturates
+    assert h.total == pytest.approx(1e9)
+
+
+def test_histogram_snapshot_stable_under_concurrent_observe():
+    h = LatencyHistogram()
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        i = 0
+        while not stop.is_set():
+            h.observe(1e-4 * (1 + i % 100), trace_id=f"t{i}")
+            i += 1
+
+    def snapshotter():
+        try:
+            while not stop.is_set():
+                snap = h.snapshot()
+                assert snap["count"] >= 0
+                assert snap["sum_seconds"] >= 0.0
+                h.percentile(99)
+        except Exception as exc:  # pragma: no cover - the assertion
+            errors.append(exc)
+
+    threads = [threading.Thread(target=hammer) for _ in range(3)] + [
+        threading.Thread(target=snapshotter) for _ in range(2)]
+    for t in threads:
+        t.start()
+    time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert errors == []
+    final = h.snapshot()
+    assert final["count"] == h.count > 0
+    assert final["exemplar"]["trace_id"].startswith("t")
+
+
+def test_histogram_exemplar_tracks_slowest_recent():
+    h = LatencyHistogram()
+    h.observe(0.010, trace_id="fast")
+    h.observe(0.500, trace_id="slow")
+    h.observe(0.020, trace_id="later-fast")
+    ex = h.snapshot()["exemplar"]
+    assert ex["trace_id"] == "slow"
+    assert ex["seconds"] == pytest.approx(0.5)
+    # untagged observations never displace a traced exemplar
+    h.observe(9.0)
+    assert h.snapshot()["exemplar"]["trace_id"] == "slow"
+
+
+# --- Prometheus exposition lint (satellite) ---------------------------------
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+
+
+def _parse_sample(line):
+    """Parse one exposition sample into (name, labelset, value) --
+    honoring backslash escapes inside label values; raises on any
+    malformed syntax."""
+    m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{)?", line)
+    assert m, f"bad metric name: {line!r}"
+    name, rest = m.group(1), line[m.end():]
+    labels = []
+    if m.group(2):  # parse {k="v",...} with escape handling
+        while True:
+            lm = re.match(r'^([a-zA-Z_][a-zA-Z0-9_]*)="', rest)
+            assert lm, f"bad label in: {line!r}"
+            lname, rest = lm.group(1), rest[lm.end():]
+            val, i, esc = [], 0, False
+            while i < len(rest):
+                c = rest[i]
+                if esc:
+                    assert c in ('\\', '"', 'n'), \
+                        f"bad escape \\{c} in: {line!r}"
+                    val.append(c)
+                    esc = False
+                elif c == "\\":
+                    esc = True
+                elif c == '"':
+                    break
+                else:
+                    assert c != "\n", f"raw newline in label: {line!r}"
+                    val.append(c)
+                i += 1
+            assert i < len(rest), f"unterminated label value: {line!r}"
+            labels.append((lname, "".join(val)))
+            rest = rest[i + 1:]
+            if rest.startswith(","):
+                rest = rest[1:]
+                continue
+            assert rest.startswith("}"), f"bad label block: {line!r}"
+            rest = rest[1:]
+            break
+    assert rest.startswith(" "), f"no sample value: {line!r}"
+    value = rest.strip()
+    float(value)  # must parse as a number
+    return name, tuple(sorted(labels)), value
+
+
+def lint_prometheus(text):
+    """The exposition-format lint: every sample's family has # HELP and
+    # TYPE, names and label names are valid, label values escaped, and
+    no (name, labelset) series appears twice."""
+    helps, types, series = {}, {}, set()
+    families_used = set()
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            fam = line.split()[2]
+            assert _NAME_RE.match(fam)
+            helps[fam] = True
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            fam, kind = parts[2], parts[3]
+            assert _NAME_RE.match(fam)
+            assert kind in ("counter", "gauge", "summary", "histogram",
+                            "untyped")
+            assert fam not in types, f"duplicate TYPE for {fam}"
+            types[fam] = kind
+            continue
+        assert not line.startswith("#"), f"stray comment: {line!r}"
+        name, labels, _value = _parse_sample(line)
+        # summary child series fold into their family name
+        fam = name
+        for suffix in ("_sum", "_count"):
+            base = name[:-len(suffix)] if name.endswith(suffix) else None
+            if base and base in types:
+                fam = base
+        assert fam in types, f"sample {name} has no # TYPE"
+        assert fam in helps, f"sample {name} has no # HELP"
+        for lname, _ in labels:
+            assert _LABEL_NAME_RE.match(lname)
+        key = (name, labels)
+        assert key not in series, f"duplicate series {key}"
+        series.add(key)
+        families_used.add(fam)
+    # no orphan metadata: every declared family emitted >= 0 samples --
+    # HELP/TYPE without samples is legal, but both must pair up
+    assert set(helps) == set(types)
+    return series
+
+
+def _populated_metrics():
+    """A ServeMetrics exercised the way a live server would be: traffic
+    served, a generation swapped, a job run -- plus hostile label
+    values."""
+    m = ServeMetrics()
+    for outcome in ("ok", "ok", "queue_full", "unknown_generation"):
+        m.count_request(outcome)
+    m.latency.observe(0.01, trace_id="trace-1")
+    m.queue_latency.observe(0.001)
+    m.device_time.observe(0.004)
+    m.count_batch(3, 4)
+    m.count_device(3, 4, 0.004)
+    m.count_cache(True)
+    m.count_cache(False)
+    for ph in ("parse", "device", "respond"):
+        m.observe_phase(ph, 0.002)
+    m.bucket_latency("tiny", 4).observe(0.012, trace_id="trace-2")
+    # kernel name with every character the exposition format must escape
+    evil = 'k"er\\nal\n2'
+    m.set_model_info(evil, 3, 1700000000.0)
+    m.set_model_info("tiny", 2, 1700000000.0)
+    m.count_reload(True)
+    m.count_generation("tiny", 1)
+    m.count_generation("tiny", 2)
+    m.count_generation(evil, 1)
+    for g in range(3, 25):  # trip the "older" fold
+        m.count_generation("tiny", g)
+    m.register_queue("tiny", lambda: 2)
+    m.register_queue(evil, lambda: 0)
+    m.set_jobs_source(lambda: {
+        "queue_depth": 1,
+        "running": {"job": "job-000001", "kernel": "tiny", "epoch": 2,
+                    "epochs": 4, "mean_err": 0.125},
+        "by_status": {"done": 1, "running": 1},
+        "trained_epochs_total": 6,
+    })
+    return m
+
+
+def test_prometheus_exposition_lint_populated():
+    m = _populated_metrics()
+    series = lint_prometheus(m.render_prometheus())
+    names = {name for name, _ in series}
+    # the families the scrape story depends on are all present
+    for want in ("hpnn_serve_requests_total", "hpnn_serve_phase_seconds",
+                 "hpnn_serve_bucket_latency_seconds_count",
+                 "hpnn_jobs_total", "hpnn_serve_generation_requests_total",
+                 "hpnn_serve_model_generation"):
+        assert want in names, want
+    # the hostile kernel name survived escaping and re-parses exactly
+    gen_labels = [dict(labels) for name, labels in series
+                  if name == "hpnn_serve_model_generation"]
+    assert any(d["kernel"] == 'k"er\\nal\n2'.replace("\n", "n")
+               or d["kernel"] == 'k"er\\nal\n2' for d in gen_labels)
+
+
+def test_prometheus_lint_catches_bad_output():
+    with pytest.raises(AssertionError):
+        lint_prometheus('orphan_metric{x="1"} 4\n')
+    with pytest.raises(AssertionError):
+        lint_prometheus("# HELP d d\n# TYPE d counter\nd 1\nd 1\n")
+
+
+def test_json_snapshot_has_exemplars_and_phases():
+    m = _populated_metrics()
+    snap = json.loads(m.render_json())
+    assert snap["latency"]["exemplar"]["trace_id"] == "trace-1"
+    assert snap["latency_by_bucket"]["tiny"]["4"]["exemplar"][
+        "trace_id"] == "trace-2"
+    # queue_wait is the queue_latency histogram aliased into phases --
+    # same distribution, never double-observed
+    assert set(snap["phases"]) == {"parse", "queue_wait", "device",
+                                   "respond"}
+    assert snap["phases"]["queue_wait"] == snap["queue_latency"]
+
+
+# --- monotonic-clock audit (satellite) --------------------------------------
+
+# wall-clock time.time() is allowed ONLY for persisted/displayed
+# timestamps; every elapsed-interval computation must be monotonic.
+# Each allowed call site names the timestamp it persists (wall_base is
+# the obs recorder's wall/mono anchor pair):
+_WALL_CLOCK_ALLOWED = re.compile(
+    r"(created|started|finished|loaded_at|\"updated\"|wall_base|"
+    r"conf\.seed|int\(time\.time\(\)\))")
+
+
+def test_elapsed_time_is_monotonic_in_serve_jobs_ckpt():
+    offenders = []
+    for sub in ("serve", "jobs", "ckpt", "obs"):
+        root = os.path.join(REPO, "hpnn_tpu", sub)
+        for fname in sorted(os.listdir(root)):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(root, fname)
+            with open(path) as fp:
+                for lineno, line in enumerate(fp, 1):
+                    if "time.time()" not in line:
+                        continue
+                    if _WALL_CLOCK_ALLOWED.search(line):
+                        continue
+                    offenders.append(f"{sub}/{fname}:{lineno}: "
+                                     f"{line.strip()}")
+    assert offenders == [], (
+        "wall-clock time.time() outside the persisted-timestamp "
+        "allowlist (use time.monotonic() for elapsed intervals):\n"
+        + "\n".join(offenders))
+
+
+# --- nn_log JSON mode + slow-span flag (tentpole pieces) --------------------
+
+def test_nn_log_json_mode(monkeypatch, capsys):
+    nn_log.set_verbosity(1)
+    nn_log.nn_warn("plain text\n")
+    assert capsys.readouterr().out == "NN(WARN): plain text\n"
+    monkeypatch.setenv("HPNN_LOG_JSON", "1")
+    nn_log.nn_warn("machine text\n")
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["level"] == "warn" and rec["msg"] == "machine text\n"
+    assert isinstance(rec["ts"], float)
+    # gates unchanged: below the warn verbosity nothing is emitted
+    nn_log.set_verbosity(0)
+    nn_log.nn_warn("gated\n")
+    assert capsys.readouterr().out == ""
+
+
+def test_nn_event_structured_vs_text(monkeypatch, capsys):
+    monkeypatch.setenv("HPNN_LOG_JSON", "1")
+    nn_log.set_verbosity(0)
+    # JSON mode: events are ungated machine output
+    nn_log.nn_event("slow_request", kernel="tiny", bucket=4,
+                    latency_ms=12.5, trace="abc")
+    rec = json.loads(capsys.readouterr().out)
+    assert rec["event"] == "slow_request" and rec["trace"] == "abc"
+    assert rec["bucket"] == 4
+    # text mode: routed through nn_warn, so the verbosity gate applies
+    monkeypatch.delenv("HPNN_LOG_JSON")
+    nn_log.nn_event("slow_request", kernel="tiny")
+    assert capsys.readouterr().out == ""
+    nn_log.set_verbosity(1)
+    nn_log.nn_event("slow_request", kernel="tiny")
+    assert capsys.readouterr().out == \
+        "NN(WARN): slow_request: kernel=tiny\n"
+
+
+def test_slow_span_threshold_gating(monkeypatch):
+    m = ServeMetrics()
+    h = m.bucket_latency("tiny", 8)
+    # below the min count: never fires
+    assert m.slow_threshold_s(h) is None
+    for _ in range(m.SLOW_SPAN_MIN_COUNT):
+        h.observe(0.010)
+    thr = m.slow_threshold_s(h)
+    assert thr is not None and thr > 0.010  # default mult 4 x p99
+    monkeypatch.setenv("HPNN_SLOW_SPAN_MULT", "0")
+    assert m.slow_threshold_s(h) is None  # knob off
+    monkeypatch.setenv("HPNN_SLOW_SPAN_MULT", "nonsense")
+    assert m.slow_threshold_s(h) is None  # malformed knob degrades
+
+
+def test_slow_request_flag_fires_through_batcher(tmp_path, monkeypatch,
+                                                 capsys):
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4)
+    app.add_model(conf, warmup=True)
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    b = app.batchers["tiny"]
+    for _ in range(ServeMetrics.SLOW_SPAN_MIN_COUNT):
+        b.submit(x, 5.0)
+    capsys.readouterr()
+    monkeypatch.setenv("HPNN_LOG_JSON", "1")
+    # any latency beats an (effectively) zero threshold
+    monkeypatch.setenv("HPNN_SLOW_SPAN_MULT", "1e-9")
+    b.submit(x, 5.0)
+    events = [json.loads(ln) for ln in
+              capsys.readouterr().out.splitlines()
+              if '"slow_request"' in ln]
+    assert events and events[0]["kernel"] == "tiny"
+    assert events[0]["latency_ms"] > events[0]["threshold_ms"]
+    app.close()
+
+
+# --- healthz + debug endpoints ----------------------------------------------
+
+def test_healthz_gains_uptime_queues_jobs(tmp_path):
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4)
+    app.add_model(conf, warmup=False)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    st, body, _ = _http_json(base + "/healthz")
+    assert st == 200 and body["status"] == "ok"  # contract unchanged
+    assert body["kernels"] == ["tiny"]
+    assert body["uptime_s"] >= 0.0
+    assert body["queue_depth"] == {"tiny": 0}
+    assert body["active_jobs"] == 0
+    httpd.shutdown()
+    app.close()
+
+
+def test_debug_trace_404_when_disabled(tmp_path):
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4, trace=False)
+    app.add_model(conf, warmup=False)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    st, body, _ = _http_json(base + "/v1/debug/trace")
+    assert st == 404 and body["reason"] == "tracing_disabled"
+    # a client-supplied trace id is still echoed with tracing off
+    st, body, hdrs = _http_json(
+        base + "/v1/kernels/tiny/infer",
+        {"inputs": [[0.0] * N_IN]},
+        headers={"X-HPNN-Trace-Id": "client-id-1"})
+    assert st == 200
+    assert hdrs.get("X-HPNN-Trace-Id") == "client-id-1"
+    assert "trace" not in body  # nothing recorded, nothing promised
+    httpd.shutdown()
+    app.close()
+
+
+def test_profile_endpoint_validation_and_auth(tmp_path):
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4, auth_token="s3cret")
+    app.add_model(conf, warmup=False)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    st, body, _ = _http_json(base + "/v1/debug/profile", {"seconds": 1})
+    assert st == 401  # auth-guarded like every mutating endpoint
+    auth = {"Authorization": "Bearer s3cret"}
+    st, body, _ = _http_json(base + "/v1/debug/profile",
+                             {"seconds": "NaN?"}, headers=auth)
+    assert st == 400
+    st, body, _ = _http_json(base + "/v1/debug/profile",
+                             {"seconds": -2}, headers=auth)
+    assert st == 400
+    httpd.shutdown()
+    app.close()
+
+
+def test_infer_trace_id_span_tree_single_request(tmp_path):
+    """Fast-tier slice of the acceptance: one traced request yields the
+    full parse -> queue_wait -> batch segments -> respond tree, root
+    and children correlated by the submitted trace id."""
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4, trace=True)
+    app.add_model(conf, warmup=True)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    st, body, hdrs = _http_json(
+        base + "/v1/kernels/tiny/infer",
+        {"inputs": [[0.1] * N_IN]},
+        headers={"X-HPNN-Trace-Id": "req-42"})
+    assert st == 200 and body["trace"] == "req-42"
+    assert hdrs["X-HPNN-Trace-Id"] == "req-42"
+    want = {"serve.request", "parse", "queue_wait", "batch_assembly",
+            "pad_h2d", "device_launch", "d2h", "respond"}
+    spans = _wait_for_spans(base, "req-42", want)
+    byname = {s["name"]: s for s in spans}
+    root = byname["serve.request"]
+    assert root["parent"] is None and root["outcome"] == "ok"
+    for name in want - {"serve.request"}:
+        assert byname[name]["parent"] == root["span"], name
+        assert byname[name]["trace"] == "req-42"
+    # registry annotations rode the batch spans
+    dev = byname["device_launch"]
+    assert dev["bucket"] == 1 and dev["tier"] == "strict"
+    assert dev["generation"] == 1 and isinstance(dev["cache_hit"], bool)
+    httpd.shutdown()
+    app.close()
+
+
+def _wait_for_spans(base, trace_id, want, timeout_s=10.0):
+    """Fetch the NDJSON dump until every wanted span name shows up (the
+    respond span lands a hair after the response bytes)."""
+    deadline = time.monotonic() + timeout_s
+    spans = []
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(
+                base + f"/v1/debug/trace?trace={trace_id}",
+                timeout=30) as resp:
+            assert resp.headers.get("Content-Type") == \
+                "application/x-ndjson"
+            spans = [json.loads(ln) for ln in
+                     resp.read().decode().splitlines()]
+        if want <= {s["name"] for s in spans}:
+            return spans
+        time.sleep(0.02)
+    raise AssertionError(
+        f"missing spans for {trace_id}: have "
+        f"{sorted({s['name'] for s in spans})}, want {sorted(want)}")
+
+
+# --- byte parity: tracing observes, never perturbs (acceptance) -------------
+
+@pytest.mark.parametrize("train", ["BP", "BPM"])
+def test_train_nn_byte_identical_with_tracing(tmp_path, monkeypatch,
+                                              capsys, train):
+    corpus = tmp_path / "samples"
+    _write_corpus(str(corpus), np.random.default_rng(11), N_SAMP)
+    conf = tmp_path / "nn.conf"
+    conf.write_text(
+        "[name] tiny\n[type] ANN\n[init] generate\n[seed] 4321\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        f"[train] {train}\n[dtype] f64\n[sample_dir] {corpus}\n")
+
+    def run(workdir, trace_env):
+        os.makedirs(workdir, exist_ok=True)
+        monkeypatch.chdir(workdir)
+        if trace_env:
+            monkeypatch.setenv("HPNN_TRACE", "1")
+        else:
+            monkeypatch.delenv("HPNN_TRACE", raising=False)
+        nn_log.set_verbosity(0)
+        rc = cli.train_nn_main(["-vv", "--epochs", "2", str(conf)])
+        assert rc == 0
+        out = capsys.readouterr().out
+        with open(os.path.join(workdir, "kernel.opt"), "rb") as fp:
+            kern = fp.read()
+        obs.disable()
+        return out, kern
+
+    out_off, kern_off = run(str(tmp_path / "off"), False)
+    out_on, kern_on = run(str(tmp_path / "on"), True)
+    assert out_on == out_off       # console stream byte-identical
+    assert kern_on == kern_off     # kernel.opt byte-identical
+    assert kern_off  # sanity: the run actually produced a kernel
+
+
+# --- the acceptance e2e: trace under live job traffic (slow tier) -----------
+
+@pytest.mark.slow
+def test_trace_e2e_under_live_job_traffic(tmp_path, monkeypatch):
+    """ISSUE 8 acceptance: a single trace id submitted with an infer
+    request while a training job runs yields a complete parent/child
+    span tree in /v1/debug/trace -- server, batcher and registry spans
+    all correlated -- and the job's own trace (``job:<id>``) carries
+    its epoch/snapshot/hot-swap tree."""
+    monkeypatch.chdir(tmp_path)
+    corpus = tmp_path / "samples"
+    _write_corpus(str(corpus), np.random.default_rng(7), N_SAMP)
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8, max_queue_rows=512, trace=True)
+    app.add_model(conf, warmup=True)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    stop = threading.Event()
+    failures: list = []
+
+    def hammer(i):
+        n = 0
+        while not stop.is_set():
+            st, _b, _h = _http_json(
+                base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()},
+                headers={"X-HPNN-Trace-Id": f"bg-{i}-{n}"})
+            if st != 200:
+                failures.append(st)
+            n += 1
+
+    threads = [threading.Thread(target=hammer, args=(i,))
+               for i in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        st, job, _ = _http_json(
+            base + "/v1/kernels/tiny/train",
+            {"epochs": 2, "seed": 77, "train": "BP",
+             "samples": str(corpus), "ckpt_every": 1,
+             "hidden": [N_HID]})
+        assert st == 202, job
+        jid = job["job_id"]
+        # the probe request rides in WHILE the job runs
+        st, body, hdrs = _http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": x.tolist()},
+            headers={"X-HPNN-Trace-Id": "probe-1"})
+        assert st == 200 and hdrs["X-HPNN-Trace-Id"] == "probe-1"
+        want = {"serve.request", "parse", "queue_wait", "batch_assembly",
+                "pad_h2d", "device_launch", "d2h", "respond"}
+        spans = _wait_for_spans(base, "probe-1", want)
+        byname = {s["name"]: s for s in spans}
+        root = byname["serve.request"]
+        # complete tree: every non-root span parents at the root and
+        # carries the probe's trace id (server thread, batcher worker
+        # and registry annotations all correlated)
+        for s in spans:
+            assert s["trace"] == "probe-1"
+            if s["name"] != "serve.request":
+                assert s["parent"] == root["span"], s["name"]
+        assert byname["device_launch"]["bucket"] >= 1
+        assert byname["device_launch"]["tier"] == "strict"
+        # wait out the job, then its own trace tree
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            st, snap, _ = _http_json(base + f"/v1/jobs/{jid}")
+            if snap["status"] in ("done", "failed", "cancelled",
+                                  "interrupted"):
+                break
+            time.sleep(0.05)
+        assert snap["status"] == "done", snap
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert failures == []
+    job_spans = _wait_for_spans(
+        base, f"job:{jid}",
+        {"jobs.run", "train.epoch", "serve.hot_swap",
+         "jobs.yield_to_eval"})
+    names = {s["name"] for s in job_spans}
+    assert "ckpt.snapshot_write" in names or "stats_drain" in names
+    jr = next(s for s in job_spans if s["name"] == "jobs.run")
+    epochs = [s for s in job_spans if s["name"] == "train.epoch"]
+    assert len(epochs) == 2
+    assert all(e["parent"] == jr["span"] for e in epochs)
+    # exposition lint against THIS server: it has served traffic,
+    # swapped generations (per-epoch hot reloads) and run a job -- the
+    # satellite's required state, on real output
+    with urllib.request.urlopen(base + "/metrics", timeout=30) as resp:
+        series = lint_prometheus(resp.read().decode())
+    fams = {name for name, _ in series}
+    assert "hpnn_jobs_trained_epochs_total" in fams
+    assert "hpnn_serve_model_generation" in fams
+    assert "hpnn_serve_phase_seconds" in fams
+    httpd.shutdown()
+    app.close(drain=True)
+
+
+@pytest.mark.slow
+def test_profile_capture_live_server(tmp_path):
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4,
+                   profile_dir=str(tmp_path / "prof"))
+    app.add_model(conf, warmup=False)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    st, body, _ = _http_json(base + "/v1/debug/profile",
+                             {"seconds": 0.2})
+    if st == 501:
+        pytest.skip(f"jax.profiler unavailable here: {body}")
+    assert st == 200, body
+    assert body["dir"] == str(tmp_path / "prof")
+    found = []
+    for root, _dirs, files in os.walk(body["dir"]):
+        found.extend(files)
+    assert found, "profile capture produced no artifact files"
+    httpd.shutdown()
+    app.close()
