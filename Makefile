@@ -20,19 +20,29 @@ MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_pallas_convergence.py tests/test_cli_e2e.py \
              tests/test_tile_convergence.py
 SERVE_TESTS = tests/test_serve.py
+SERVE_MESH_TESTS = tests/test_mesh.py
 CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
 OBS_TESTS = tests/test_obs.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
-	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) -q
+	    $(SERVE_MESH_TESTS) $(CKPT_TESTS) $(JOBS_TESTS) \
+	    $(OBS_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
 # scripts/serve_bench.py's client pool)
 serve-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(SERVE_TESTS) -q
+
+# multi-host serve-mesh tier (ISSUE 9): QoS/pool/backend units + the
+# acceptance pins -- single-worker mesh byte-identical to the local
+# fast tier, worker-loss failover with zero non-200s, fleet-coherent
+# generation reload across two workers, quota/lane/deadline semantics.
+# The kill -9 subprocess e2e is slow-marked (runs here, not in tier 1)
+mesh-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(SERVE_MESH_TESTS) -q
 
 # checkpoint tier: snapshot atomicity/retention units, serve hot reload,
 # the resume-parity e2e (kill-at-epoch-k + --resume == uninterrupted,
@@ -118,6 +128,15 @@ mfu-bench:
 	python scripts/mfu_bench.py --out MFU_BENCH.json \
 	    $(if $(REAL),--real)
 
-.PHONY: check check-all serve-check ckpt-check ckpt-bench jobs-check \
-    jobs-bench obs-check native bench serve-bench io-bench epoch-bench \
-    mfu-bench
+# multi-host serve mesh: router overhead vs the single-process fast
+# tier, 2-worker scaling, and kill -9 failover (zero non-200 floor +
+# ejection latency); emits MESH_BENCH.json, rc!=0 when a floor misses.
+# Default forces CPU everywhere; `make mesh-bench REAL=1` keeps the
+# ambient platform so the workers run on chips
+mesh-bench:
+	python scripts/mesh_bench.py --out MESH_BENCH.json \
+	    $(if $(REAL),--real)
+
+.PHONY: check check-all serve-check mesh-check ckpt-check ckpt-bench \
+    jobs-check jobs-bench obs-check native bench serve-bench io-bench \
+    epoch-bench mfu-bench mesh-bench

@@ -9,6 +9,9 @@ Usage: serve_nn [-v]... [-a addr] [-p port] [-b max-batch] [-q queue-rows]
                 [--watch-ckpt [NAME=]DIR] [--watch-interval S]
                 [--jobs N] [--job-dir DIR] [--ab-fraction F]
                 [--auth-token TOKEN]
+                [--mesh-role router|worker] [--router HOST:PORT]
+                [--advertise HOST:PORT] [--workers N]
+                [--quota-rows F] [--quota-burst N]
                 [conf (default ./nn.conf)]...
 
 Takes the same nn.conf files as run_nn; see hpnn_tpu/serve/ and the
@@ -17,7 +20,11 @@ backpressure semantics, and the parity/mesh policy knobs.  With
 ``--jobs N`` the server also trains: POST /v1/kernels/<name>/train
 submits an online training job (hpnn_tpu/jobs) whose epoch-boundary
 snapshots hot-swap into serving with A/B generation pinning -- the
-README "Online training service" section has the walkthrough.
+README "Online training service" section has the walkthrough.  With
+``--mesh-role`` the server joins a multi-host serve mesh
+(hpnn_tpu/serve/mesh): a router fans requests over registered worker
+hosts with failover and fleet-coherent hot reload -- the README
+"Multi-host serving" section has the router+2-workers walkthrough.
 """
 import os
 import sys

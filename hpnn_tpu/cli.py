@@ -556,6 +556,36 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     help="destination for POST /v1/debug/profile "
                     "jax.profiler captures (default: a fresh temp dir "
                     "per capture)")
+    ap.add_argument("--mesh-role", choices=("router", "worker"),
+                    default=None,
+                    help="multi-host serve mesh: 'router' fans infer "
+                    "requests over registered worker hosts (no local "
+                    "compute; /healthz warms until --workers N are "
+                    "live); 'worker' serves normally AND registers "
+                    "with --router (heartbeat + generation catch-up)")
+    ap.add_argument("--router", default=None, metavar="HOST:PORT",
+                    help="the router to register with (required for "
+                    "--mesh-role worker)")
+    ap.add_argument("--advertise", default=None, metavar="HOST:PORT",
+                    help="address the router should reach THIS worker "
+                    "at (default: 127.0.0.1:<bound port>)")
+    ap.add_argument("--workers", type=int, default=1, metavar="N",
+                    help="router quorum: /healthz reports 'warming' "
+                    "until N workers are live (default 1)")
+    ap.add_argument("--mesh-health-interval", type=float, default=1.0,
+                    metavar="S",
+                    help="router worker health-check poll period "
+                    "(default 1.0s; ejection after "
+                    "HPNN_MESH_EJECT_AFTER consecutive misses)")
+    ap.add_argument("--quota-rows", type=float, default=0.0, metavar="F",
+                    help="per-client token-bucket quota in rows/sec "
+                    "(keyed by X-HPNN-Client, the auth token, or the "
+                    "peer address; over-quota requests get 429 with a "
+                    "refill-derived Retry-After; 0: no quota)")
+    ap.add_argument("--quota-burst", type=float, default=None,
+                    metavar="N",
+                    help="quota bucket burst capacity in rows "
+                    "(default: max(2 x rate, 64))")
     args = ap.parse_args(argv)
 
     from .serve.server import ServeApp, make_server
@@ -576,6 +606,11 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                          f"{args.ab_fraction} (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    if args.mesh_role == "worker" and not args.router:
+        sys.stderr.write("--mesh-role worker requires --router "
+                         "HOST:PORT (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
     auth_token = args.auth_token or os.environ.get("HPNN_SERVE_TOKEN") \
         or None
     app = ServeApp(max_batch=args.max_batch,
@@ -588,7 +623,20 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                    auth_token=auth_token,
                    ab_fraction=args.ab_fraction,
                    trace=args.trace or None,
-                   profile_dir=args.profile_dir)
+                   profile_dir=args.profile_dir,
+                   quota_rows=args.quota_rows,
+                   quota_burst=args.quota_burst)
+    if args.mesh_role == "router":
+        # before add_model: batchers are wired to the worker pool at
+        # creation.  (A router never computes locally -- add_model
+        # itself skips warmup when a mesh router is enabled, so no
+        # warmup_mode override is needed here.)
+        app.enable_mesh_router(
+            required_workers=max(1, args.workers),
+            health_interval_s=args.mesh_health_interval)
+        sys.stdout.write(f"SERVE: mesh router (quorum "
+                         f"{max(1, args.workers)} worker(s); workers "
+                         "register via POST /v1/mesh/register)\n")
     n_ok = 0
     for conf in args.confs:
         with phase("register"):
@@ -632,6 +680,17 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                          f"auth={tok})\n")
     httpd = make_server(args.addr, args.port, app)
     host, port = httpd.server_address[:2]
+    if args.mesh_role == "worker":
+        # register AFTER the socket is bound (the advertised default
+        # needs the real port) but before serve_forever: the heartbeat
+        # loop retries until the router is reachable
+        from .serve.mesh.worker import WorkerAgent
+
+        advertise = args.advertise or f"127.0.0.1:{port}"
+        app.mesh_worker = WorkerAgent(app, args.router,
+                                      advertise).start()
+        sys.stdout.write(f"SERVE: mesh worker (router {args.router}, "
+                         f"advertising {advertise})\n")
     # unconditional: the bound port is the serving contract (with -p 0
     # it is the only way a launcher learns where to point clients)
     sys.stdout.write(f"SERVE: listening on http://{host}:{port}\n")

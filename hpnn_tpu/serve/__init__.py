@@ -23,19 +23,33 @@ This package keeps the compiled state resident and feeds it full batches:
   see ``hpnn_tpu/ckpt``), ``GET /healthz``, ``GET /metrics``;
 * :mod:`metrics`   -- per-request latency histograms (p50/p99), queue
   depth, batch fill ratio, compile-cache hits/misses, reject/timeout
-  counts, exported on ``/metrics``.
+  counts, per-lane QoS gauges and the desired-worker autoscaling
+  signal, exported on ``/metrics``;
+* :mod:`mesh`      -- the multi-host serve mesh (ISSUE 9): every
+  batcher launches through a *backend* (``batcher.LocalBackend`` is the
+  in-process device path); a ``serve_nn --mesh-role router`` swaps in
+  ``mesh.backend.RemoteBackend`` to fan batches over registered worker
+  hosts with bucket-affinity placement, health-driven ejection,
+  retry-once failover and fleet-coherent hot reload.
 
 Everything imports lazily off the hot path so pure-IO users of hpnn_tpu
 never pull in the HTTP stack.
 """
 
-from .batcher import DeadlineExceeded, MicroBatcher, QueueFull, ServeClosed
+from .batcher import (
+    DeadlineExceeded,
+    LocalBackend,
+    MicroBatcher,
+    QueueFull,
+    ServeClosed,
+)
 from .metrics import LatencyHistogram, ServeMetrics
 from .registry import ModelRegistry, ServedModel
 from .server import ServeApp, make_server
 
 __all__ = [
-    "DeadlineExceeded", "MicroBatcher", "QueueFull", "ServeClosed",
+    "DeadlineExceeded", "LocalBackend", "MicroBatcher", "QueueFull",
+    "ServeClosed",
     "LatencyHistogram", "ServeMetrics",
     "ModelRegistry", "ServedModel",
     "ServeApp", "make_server",

@@ -24,21 +24,39 @@ set into one GEMM chain"):
   (:class:`ServeClosed`), lets the worker finish everything already
   admitted, then joins the thread.  Nothing admitted is ever silently
   dropped.
-* **pipelined dispatch** -- the worker keeps ONE batch in flight on the
-  device while it pads + H2Ds the next (the registry's
-  ``dispatch``/``collect`` split, double-buffered by the scratch pool):
-  batch N+1's host work overlaps batch N's device compute, and the D2H
-  sync happens entirely off the queue lock.  Results are delivered in
-  dispatch order by construction (one worker, FIFO pops, depth-1
-  pipeline), so pipelining can never reorder responses -- asserted in
+* **QoS lanes + EDF** (mesh subsystem) -- the queue dequeues by
+  ``(lane, deadline)``: the high lane drains before normal before low,
+  and within a lane the earliest DEADLINE goes first (EDF), so a
+  short-deadline request overtakes a lazy bulk one.  Requests with the
+  default lane and the default timeout keep exact FIFO order (equal
+  lanes + equal timeouts make deadline order enqueue order), so a
+  server that never sees a priority header behaves as before.
+* **per-request deadlines end to end** -- ``X-HPNN-Deadline-Ms`` (or
+  ``timeout_ms``) sets the request's OWN deadline: admission rejects an
+  already-expired one (504 without queueing), EDF orders by it, and
+  expiry in the queue still drops before the device.
+* **drain-rate Retry-After** -- the batcher tracks an EWMA of completed
+  rows/sec; a queue-full rejection carries ``retry_after_s`` = current
+  backlog / drain rate, so the 429's Retry-After header tells clients
+  when capacity will actually exist.
+* **pipelined dispatch through a backend** -- batches launch through a
+  *backend* (:class:`LocalBackend` = the registry's dispatch/collect
+  split; the mesh router swaps in ``mesh.backend.RemoteBackend``, an
+  HTTP RPC to a worker host).  The worker keeps up to
+  ``backend.pipeline_depth()`` batches in flight (1 for the local
+  device: pad+H2D of batch N+1 overlaps compute of N; one per live
+  worker for the mesh) and completes them strictly in dispatch order,
+  so pipelining can never reorder responses -- asserted in
   ``tests/test_serve.py``.
 
 One batcher (and one worker thread) per served model: batches must be
-model-homogeneous, and per-model FIFO keeps tail latency analyzable.
+model-homogeneous, and per-model ordering keeps tail latency
+analyzable.
 """
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import deque
@@ -63,14 +81,42 @@ class ServeClosed(Exception):
     """The batcher is shutting down and no longer admits requests."""
 
 
+class LocalBackend:
+    """The in-process launch path: exactly the registry
+    ``dispatch``/``collect`` calls the batcher made before backends
+    existed (registry stand-ins in tests keep working unchanged).  The
+    mesh router replaces this with ``mesh.backend.RemoteBackend``."""
+
+    kind = "local"
+
+    def __init__(self, model):
+        self.model = model
+
+    def pipeline_depth(self) -> int:
+        return 1  # one device: depth-1 double buffering
+
+    def dispatch(self, xs: np.ndarray, gen=None, trace=None,
+                 deadline=None, lane=None):
+        # unpinned batches keep the two-argument call so registry
+        # stand-ins (tests, custom backends) need not know about
+        # generation pinning
+        if gen is None:
+            return self.model.registry.dispatch(self.model, xs)
+        return self.model.registry.dispatch(self.model, xs, gen=gen)
+
+    def collect(self, handle):
+        return self.model.registry.collect(handle)
+
+
 class _Pending:
     __slots__ = ("xs", "rows", "deadline", "gen", "served_gen", "t_enq",
                  "t_dispatch", "event", "result", "error", "trace",
-                 "bucket")
+                 "bucket", "lane", "seq")
 
     def __init__(self, xs: np.ndarray, deadline: float,
                  gen: int | None = None,
-                 trace: tuple[str, str] | None = None):
+                 trace: tuple[str, str] | None = None,
+                 lane: int = 1):
         self.xs = xs
         self.rows = xs.shape[0]
         self.deadline = deadline
@@ -82,6 +128,8 @@ class _Pending:
         #                           worker parents this request's batch
         #                           spans under it (ISSUE 8)
         self.bucket = 0           # batch bucket served (set at dispatch)
+        self.lane = lane          # QoS lane (0=high 1=normal 2=low)
+        self.seq = 0              # admission order (EDF tie-break)
         self.t_enq = time.monotonic()
         self.t_dispatch = 0.0
         self.event = threading.Event()
@@ -94,7 +142,8 @@ class MicroBatcher:
                  metrics: ServeMetrics | None = None,
                  max_queue_rows: int = 256,
                  max_batch: int | None = None,
-                 linger_s: float = 0.0):
+                 linger_s: float = 0.0,
+                 backend=None):
         self.model = model
         self.metrics = metrics or model.registry.metrics
         self.max_queue_rows = int(max_queue_rows)
@@ -102,8 +151,18 @@ class MicroBatcher:
         assert self.max_batch <= model.registry.max_batch, \
             "batcher max_batch cannot exceed the registry bucket cap"
         self.linger_s = float(linger_s)
-        self._q: deque[_Pending] = deque()
+        self.backend = backend if backend is not None \
+            else LocalBackend(model)
+        # EDF queue: kept sorted by (lane, deadline, seq) via
+        # bisect.insort(key=...) -- dequeue order IS list order
+        self._q: list[_Pending] = []
+        self._seq = 0
         self._qrows = 0
+        self._lane_rows: dict[int, int] = {0: 0, 1: 0, 2: 0}
+        # drain-rate EWMA (rows/sec over completed batches): feeds the
+        # Retry-After a queue-full 429 carries and the autoscale gauge
+        self._drain_rate = 0.0
+        self._t_last_complete: float | None = None
         self._cv = threading.Condition()
         self._closing = False
         self._paused = False
@@ -116,6 +175,31 @@ class MicroBatcher:
     def depth(self) -> int:
         """Queued ROWS (not requests): the unit admission is counted in."""
         return self._qrows
+
+    def lane_depths(self) -> dict[str, int]:
+        """Queued rows per QoS lane (the /metrics per-lane gauge)."""
+        from .mesh.qos import LANE_NAMES
+
+        with self._cv:
+            return {LANE_NAMES[k]: v for k, v in
+                    sorted(self._lane_rows.items())}
+
+    def drain_rate(self) -> float:
+        """EWMA of completed rows/sec (0.0 until the first batch)."""
+        with self._cv:
+            return self._drain_rate
+
+    def retry_after_s(self) -> float:
+        """How long until the CURRENT backlog drains at the measured
+        rate -- what a 429's Retry-After header should say.  Clamped to
+        [1, 60]; 1 when nothing has completed yet."""
+        with self._cv:
+            return self._retry_after_locked()
+
+    def _retry_after_locked(self) -> float:
+        if self._drain_rate <= 0.0:
+            return 1.0
+        return min(60.0, max(1.0, self._qrows / self._drain_rate))
 
     def pause(self) -> None:
         """Hold dispatch (queue keeps admitting until full).  Test /
@@ -133,7 +217,8 @@ class MicroBatcher:
     def submit(self, xs: np.ndarray, timeout_s: float,
                gen: int | None = None,
                return_gen: bool = False,
-               trace: tuple[str, str] | None = None) -> np.ndarray:
+               trace: tuple[str, str] | None = None,
+               lane: int = 1) -> np.ndarray:
         """Enqueue (rows, n_inputs) float64 inputs and block until the
         batch containing them completes.  Raises QueueFull /
         DeadlineExceeded / ServeClosed; any model exception propagates.
@@ -142,6 +227,11 @@ class MicroBatcher:
         the worker keeps batches generation-homogeneous, so a pinned
         request can never ride a batch served by different weights.
 
+        ``lane`` is the QoS lane (0=high, 1=normal, 2=low): dequeue is
+        lane-ordered, earliest-deadline-first within a lane.  An
+        already-expired ``timeout_s`` (the per-request deadline header)
+        is rejected at admission -- a 504 without ever queueing.
+
         ``trace`` is the HTTP layer's span context ``(trace_id,
         root_span_id)``: the worker records this request's queue-wait /
         batch / device segments as child spans under it (ISSUE 8)."""
@@ -149,16 +239,26 @@ class MicroBatcher:
         if not 1 <= rows <= self.max_batch:
             raise ValueError(
                 f"request rows {rows} outside [1, {self.max_batch}]")
+        if timeout_s <= 0.0:
+            raise DeadlineExceeded(
+                f"deadline already expired at admission "
+                f"({timeout_s * 1e3:.1f} ms remaining)")
         p = _Pending(xs, time.monotonic() + timeout_s, gen=gen,
-                     trace=trace)
+                     trace=trace, lane=int(lane))
         with self._cv:
             if self._closing:
                 raise ServeClosed(f"kernel '{self.model.name}' draining")
             if self._qrows + rows > self.max_queue_rows:
-                raise QueueFull(
+                exc = QueueFull(
                     f"queue at {self._qrows}/{self.max_queue_rows} rows")
-            self._q.append(p)
+                exc.retry_after_s = self._retry_after_locked()
+                raise exc
+            p.seq = self._seq = self._seq + 1
+            bisect.insort(self._q, p,
+                          key=lambda q: (q.lane, q.deadline, q.seq))
             self._qrows += rows
+            self._lane_rows[p.lane] = \
+                self._lane_rows.get(p.lane, 0) + rows
             self._cv.notify_all()
         # grace covers the in-flight batch ahead of us: the worker either
         # answers or expires us at ITS next dispatch, so wait generously
@@ -187,19 +287,45 @@ class MicroBatcher:
         return (p.result, p.served_gen) if return_gen else p.result
 
     # --- worker ---------------------------------------------------------
+    def _reap_expired_locked(self) -> None:
+        """Fail + remove every queued request whose deadline already
+        passed -- the WHOLE queue, not just the head.  Under sustained
+        higher-lane load a low-lane entry may never reach the head, so
+        head-only expiry (the FIFO era's dispatch-time drop) would leave
+        dead rows counted against max_queue_rows forever, shrinking
+        usable capacity toward zero.  Caller holds the lock."""
+        now = time.monotonic()
+        if not any(now > p.deadline for p in self._q):
+            return
+        keep: list[_Pending] = []
+        for p in self._q:
+            if now > p.deadline:
+                self._qrows -= p.rows
+                self._lane_rows[p.lane] = \
+                    max(0, self._lane_rows.get(p.lane, 0) - p.rows)
+                p.error = DeadlineExceeded(
+                    f"expired {now - p.deadline:.3f}s before dispatch")
+                p.event.set()
+            else:
+                keep.append(p)
+        self._q = keep
+
     def _pop_locked(self) -> list[_Pending]:
-        """Pop up to max_batch rows FIFO, never splitting a request and
-        never mixing pinned generations in one batch (the launch serves
-        ONE weights tuple; a lane change ends the batch and the next
-        worker iteration picks the rest up -- FIFO order preserved).
-        Caller holds the lock."""
+        """Pop up to max_batch rows in EDF order (lane, then deadline),
+        never splitting a request and never mixing pinned generations in
+        one batch (the launch serves ONE weights tuple; a generation
+        change ends the batch and the next worker iteration picks the
+        rest up -- dequeue order preserved).  Caller holds the lock."""
+        self._reap_expired_locked()
         batch, rows = [], 0
         while self._q and rows + self._q[0].rows <= self.max_batch:
             if batch and self._q[0].gen != batch[0].gen:
                 break
-            p = self._q.popleft()
+            p = self._q.pop(0)
             rows += p.rows
             batch.append(p)
+            self._lane_rows[p.lane] = \
+                max(0, self._lane_rows.get(p.lane, 0) - p.rows)
         self._qrows -= rows
         return batch
 
@@ -261,14 +387,18 @@ class MicroBatcher:
               else np.concatenate([p.xs for p in live]))
         t_asm1 = time.monotonic()  # expiry + concat done: assembly wall
         try:
-            # unpinned batches keep the two-argument call so registry
-            # stand-ins (tests, custom backends) need not know about
-            # generation pinning
-            if live[0].gen is None:
-                handle = self.model.registry.dispatch(self.model, xs)
-            else:
-                handle = self.model.registry.dispatch(self.model, xs,
-                                                      gen=live[0].gen)
+            # the head request's trace/lane and the batch's MOST
+            # GENEROUS deadline ride along (the local backend ignores
+            # them, the remote backend propagates them across the worker
+            # RPC).  max, not min: a near-expired member must not 504
+            # the whole coalesced batch -- like the local path, the
+            # launch runs to completion and each member's OWN deadline
+            # is enforced client-side (submit's wait) and at the next
+            # dispatch's reap, never batch-wide
+            handle = self.backend.dispatch(
+                xs, gen=live[0].gen, trace=live[0].trace,
+                deadline=max(p.deadline for p in live),
+                lane=live[0].lane)
         except Exception as exc:  # dispatch-time failure: fail the
             # batch's requests, keep serving the next one
             nn_warn(f"serve: batch dispatch failed for "
@@ -306,8 +436,9 @@ class MicroBatcher:
         live, handle, t0, t_asm1, t_launched = inflight
         t_c0 = time.monotonic()
         try:
-            outs = self.model.registry.collect(handle)
-        except Exception as exc:  # device/model failure surfaces at D2H
+            outs = self.backend.collect(handle)
+        except Exception as exc:  # device/model/worker failure surfaces
+            # at collect time
             nn_warn(f"serve: batch failed for "
                     f"'{self.model.name}': {exc}\n")
             for p in live:
@@ -315,7 +446,31 @@ class MicroBatcher:
                 p.event.set()
             return
         t_c1 = time.monotonic()
+        # a remote backend learns the ACTUAL serving generation from the
+        # worker's response -- refresh the dispatch-time stamp so labels
+        # and A/B counters report what really served
+        g2 = getattr(handle, "served_gen", None)
+        if g2 is not None:
+            for p in live:
+                p.served_gen = g2
         rows = sum(p.rows for p in live)
+        with self._cv:  # drain-rate EWMA (Retry-After + autoscale)
+            # the inter-completion gap is the honest rate under
+            # saturation, but after an idle period it includes the
+            # idle wall and would collapse the estimate (one 8-row
+            # batch after 60 s quiet reads 0.13 rows/s and Retry-After
+            # / desired-workers blow up by orders of magnitude); when
+            # the gap dwarfs the batch's own service time, the service
+            # time IS the capacity measure
+            svc = max(t_c1 - t0, 1e-6)
+            if self._t_last_complete is not None:
+                gap = t_c1 - self._t_last_complete
+                dt = svc if gap > 4.0 * svc else max(gap, 1e-6)
+                inst = rows / dt
+                self._drain_rate = (
+                    inst if self._drain_rate <= 0.0
+                    else 0.7 * self._drain_rate + 0.3 * inst)
+            self._t_last_complete = t_c1
         # batch counters fire on COMPLETION, not dispatch: a batch that
         # dies at D2H must not inflate rows_total / fill ratio (PR-1
         # ordering, preserved across the pipeline split)
@@ -372,22 +527,28 @@ class MicroBatcher:
             p.event.set()
 
     def _loop(self) -> None:
-        """Depth-1 pipelined worker: dispatch batch N+1 (host padding +
-        H2D + async launch) BEFORE collecting batch N's result, so host
-        work overlaps device compute.  FIFO pops + in-order completion
-        mean responses can never be reordered."""
-        inflight = None
+        """Pipelined worker: dispatch the NEXT batch (host padding + H2D
+        + async launch, or the worker RPC) BEFORE collecting the oldest
+        in-flight one, keeping up to ``backend.pipeline_depth()``
+        batches in flight -- 1 for a local device (the depth-1 double
+        buffer: host work overlaps device compute), one per live worker
+        for a mesh router (concurrent fan-out).  Ordered pops +
+        in-dispatch-order completion mean responses can never be
+        reordered."""
+        inflight: deque = deque()
         while True:
-            if inflight is None:
+            if not inflight:
                 batch = self._take_batch()
                 if batch is None:
                     return  # closing, queue drained, nothing in flight
             else:
                 batch = self._take_batch_nowait()
             nxt = self._dispatch(batch) if batch else None
-            if inflight is not None:
-                self._complete(inflight)
-            inflight = nxt
+            if nxt is not None:
+                inflight.append(nxt)
+            depth = max(1, int(self.backend.pipeline_depth()))
+            if inflight and (nxt is None or len(inflight) > depth):
+                self._complete(inflight.popleft())
 
     # --- lifecycle ------------------------------------------------------
     def close(self, drain: bool = True, timeout_s: float = 30.0) -> None:
@@ -398,10 +559,11 @@ class MicroBatcher:
             self._paused = False
             if not drain:
                 while self._q:
-                    p = self._q.popleft()
+                    p = self._q.pop()
                     p.error = ServeClosed("server shutting down")
                     p.event.set()
                 self._qrows = 0
+                self._lane_rows = {0: 0, 1: 0, 2: 0}
             self._cv.notify_all()
         self._thread.join(timeout=timeout_s)
         if self._thread.is_alive():  # pragma: no cover - watchdog only

@@ -1,0 +1,39 @@
+"""Multi-host serve mesh: request fan-out over worker hosts.
+
+The reference's distributed story is intra-layer sharding reassembled
+with ``MPI_Allgather`` after every layer (``src/ann.c:913-926``) -- the
+network-parallel split whose all-to-all cost caps scaling.  Serving
+wants the OTHER axis: fan whole *requests* over replicated workers
+(the ``HPNN_DISTRIBUTED`` analog for ``serve_nn``), with weights kept
+fleet-coherent by broadcasting the checkpoint manifest generation
+instead of reassembling activations.
+
+* :mod:`backend`  -- the dispatch/collect interface the micro-batcher
+  drives; ``RemoteBackend`` is the HTTP worker RPC (retry-once on
+  worker loss, trace id propagated across the hop).  The in-process
+  twin, ``LocalBackend``, lives in ``serve.batcher`` -- every server
+  always runs through a backend now.
+* :mod:`router`   -- ``WorkerPool`` (registration, health-check-driven
+  ejection/readmission, bucket-affinity + least-depth placement) and
+  ``MeshRouter`` (fleet-coherent reload: broadcast to workers at an
+  explicit target generation, then flip the router).
+* :mod:`worker`   -- ``WorkerAgent``: the heartbeat registration loop a
+  ``serve_nn --mesh-role worker`` process runs, including generation
+  catch-up after ejection/restart.
+* :mod:`qos`      -- priority lanes, per-client token-bucket quotas,
+  deadline parsing, and the desired-worker autoscaling signal.
+
+Everything here is stdlib + numpy; jax is only ever touched by the
+workers' own registries.
+"""
+
+from .backend import NoLiveWorker, RemoteBackend, RemoteHTTPError
+from .qos import LANE_NAMES, LANES, QuotaTable, desired_workers
+from .router import MeshRouter, WorkerPool
+from .worker import WorkerAgent
+
+__all__ = [
+    "NoLiveWorker", "RemoteBackend", "RemoteHTTPError",
+    "LANES", "LANE_NAMES", "QuotaTable", "desired_workers",
+    "MeshRouter", "WorkerPool", "WorkerAgent",
+]

@@ -1,0 +1,207 @@
+"""Traffic QoS policy pieces: priority lanes, per-client token-bucket
+quotas, deadline parsing, and the autoscaling signal.
+
+These are deliberately transport-free (stdlib + arithmetic only) so the
+same policy objects serve the single-process server and the mesh router
+-- the HTTP layer parses headers into lane/deadline values here, the
+micro-batcher orders its queue by them, and /metrics derives the
+desired-worker gauge from the queue state they shape.
+
+* **Lanes** -- ``X-HPNN-Priority: high|normal|low`` (or ``0|1|2``).
+  Lower lane number dequeues first; within a lane the micro-batcher
+  dequeues earliest-deadline-first (EDF), so an urgent short-deadline
+  request overtakes a lazy bulk one without starving whole lanes of
+  accounting (per-lane queue depth is a /metrics gauge).
+* **Quotas** -- one token bucket per client key (the auth token, the
+  ``X-HPNN-Client`` header, or the peer address as a last resort),
+  charged per ROW (the unit admission and batching are counted in).
+  A denied request gets 429 ``quota_exceeded`` with a ``Retry-After``
+  computed from the bucket's own refill rate -- the client is told
+  exactly when tokens exist again.
+* **Autoscaling signal** -- :func:`desired_workers` converts (queued
+  rows, measured drain rate, live workers) into "how many workers the
+  current backlog needs to drain within HPNN_MESH_TARGET_DRAIN_S".
+  It is a *signal*, not a controller: smoothing/hysteresis belong to
+  whatever autoscaler consumes the gauge.
+"""
+
+from __future__ import annotations
+
+import math
+import os
+import threading
+import time
+
+# lane numbering: dequeue order, lowest first.  "normal" is the default
+# for requests that carry no X-HPNN-Priority header.
+LANE_HIGH, LANE_NORMAL, LANE_LOW = 0, 1, 2
+LANES = {"high": LANE_HIGH, "normal": LANE_NORMAL, "low": LANE_LOW}
+LANE_NAMES = {v: k for k, v in LANES.items()}
+
+
+def parse_priority(value: str | None) -> int:
+    """Header value -> lane number; None/empty is the normal lane.
+    Raises ValueError on anything else (the HTTP layer 400s -- a typo'd
+    priority silently served as normal would be an invisible QoS bug)."""
+    if value is None:
+        return LANE_NORMAL
+    v = value.strip().lower()
+    if not v:
+        return LANE_NORMAL
+    if v in LANES:
+        return LANES[v]
+    if v in ("0", "1", "2"):
+        return int(v)
+    raise ValueError(
+        f"bad priority {value!r} (use high|normal|low or 0|1|2)")
+
+
+def parse_deadline_ms(value: str) -> float:
+    """``X-HPNN-Deadline-Ms`` header value -> seconds remaining.
+    Raises ValueError on non-numeric input; zero/negative values parse
+    (the server maps them to an immediate 504 -- an already-expired
+    deadline is a deadline outcome, not a malformed request)."""
+    v = float(value.strip())
+    if not math.isfinite(v):
+        raise ValueError(f"bad deadline {value!r}")
+    return v / 1e3
+
+
+def client_key(headers, peer: str | None = None) -> str:
+    """Quota bucket key precedence: explicit client id header, then the
+    auth token (one quota per credential), then the peer address --
+    anonymous same-host clients share one bucket, which is the honest
+    default when nothing identifies them."""
+    if headers:
+        cid = headers.get("X-HPNN-Client")
+        if cid:
+            return f"client:{cid.strip()}"
+        auth = headers.get("Authorization") or headers.get("X-HPNN-Token")
+        if auth:
+            return f"token:{auth.strip()}"
+    return f"peer:{peer or 'anon'}"
+
+
+class TokenBucket:
+    """Classic token bucket: ``rate`` tokens/s refill, ``burst`` cap.
+    ``allow(cost)`` either spends and admits, or reports how long until
+    ``cost`` tokens exist (the Retry-After the 429 carries)."""
+
+    __slots__ = ("rate", "burst", "tokens", "t_last", "last_used")
+
+    def __init__(self, rate: float, burst: float):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self.tokens = float(burst)
+        self.t_last = time.monotonic()
+        self.last_used = self.t_last  # LRU age for table eviction
+
+    def allow(self, cost: float = 1.0,
+              now: float | None = None) -> tuple[bool, float]:
+        now = time.monotonic() if now is None else now
+        self.tokens = min(self.burst, self.tokens
+                          + max(0.0, now - self.t_last) * self.rate)
+        self.t_last = now
+        self.last_used = now
+        # a cost above the burst can never fit the bucket, but it must
+        # neither be un-admittable forever (a 429 whose Retry-After can
+        # never come true) nor under-billed (a burst-sized charge would
+        # let large requests sustain cost/burst times the quota).  DEBT
+        # model: such a request is admitted only when the bucket is
+        # FULL, and charged its true cost -- tokens go negative and the
+        # client pays the whole thing back at the refill rate before
+        # anything else is admitted.  Long-run rate stays exact.
+        threshold = min(cost, self.burst)
+        if self.tokens >= threshold:
+            self.tokens -= cost
+            return True, 0.0
+        wait = ((threshold - self.tokens) / self.rate if self.rate > 0
+                else 60.0)
+        return False, max(wait, 1e-3)
+
+    def refund(self, cost: float) -> None:
+        """Give tokens back (a charged request that was never served --
+        e.g. rejected by queue admission right after the quota spend)."""
+        self.tokens = min(self.burst, self.tokens + cost)
+
+
+class QuotaTable:
+    """Per-client token buckets, bounded.  Past ``max_clients`` distinct
+    keys the least-recently-used bucket is evicted -- an adversarial
+    client minting fresh ids must not grow server memory without bound
+    (a freshly (re)minted bucket starts at full burst, so eviction can
+    only ever be too GENEROUS, never wrongly deny)."""
+
+    def __init__(self, rows_per_s: float, burst: float | None = None,
+                 max_clients: int = 1024):
+        if rows_per_s <= 0:
+            raise ValueError(f"quota rate must be > 0: {rows_per_s}")
+        self.rate = float(rows_per_s)
+        # default burst: 2s of rate, but never below one max-ish request
+        self.burst = float(burst) if burst else max(2.0 * self.rate, 64.0)
+        self.max_clients = int(max_clients)
+        self._buckets: dict[str, TokenBucket] = {}
+        self._lock = threading.Lock()
+
+    def allow(self, key: str, cost: float = 1.0) -> tuple[bool, float]:
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is None:
+                b = self._buckets[key] = TokenBucket(self.rate, self.burst)
+                if len(self._buckets) > self.max_clients:
+                    lru = min(self._buckets,
+                              key=lambda k: self._buckets[k].last_used)
+                    del self._buckets[lru]
+            return b.allow(cost)
+
+    def refund(self, key: str, cost: float) -> None:
+        """Return a charge that never bought service (the queue-full
+        path: quota spent, then admission rejected the rows anyway --
+        without the refund, obedient Retry-After clients burn their
+        quota on 429s and get double-penalized for backpressure)."""
+        with self._lock:
+            b = self._buckets.get(key)
+            if b is not None:
+                b.refund(cost)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"clients": len(self._buckets),
+                    "rows_per_s": self.rate, "burst": self.burst}
+
+
+def desired_workers(queued_rows: int, drain_rows_per_s: float,
+                    live_workers: int,
+                    target_drain_s: float | None = None,
+                    max_workers: int | None = None) -> int:
+    """The autoscaling gauge: workers the CURRENT backlog needs so it
+    drains within ``target_drain_s`` at the measured per-worker rate.
+
+    * no backlog -> 1 (the floor; idle capacity is the autoscaler's
+      scale-down decision to smooth, not this signal's);
+    * backlog but no measured rate yet -> ``live + 1`` (something is
+      queued and nothing is draining: ask for more and let the next
+      sample refine);
+    * otherwise ``ceil(backlog / (per_worker_rate * target))``, clamped
+      to [1, HPNN_MESH_MAX_WORKERS].
+    """
+    if target_drain_s is None:
+        try:
+            target_drain_s = float(
+                os.environ.get("HPNN_MESH_TARGET_DRAIN_S", "") or 1.0)
+        except ValueError:
+            target_drain_s = 1.0
+    if max_workers is None:
+        try:
+            max_workers = int(
+                os.environ.get("HPNN_MESH_MAX_WORKERS", "") or 64)
+        except ValueError:
+            max_workers = 64
+    live = max(1, int(live_workers))
+    if queued_rows <= 0:
+        return 1
+    if drain_rows_per_s <= 0:
+        return min(live + 1, max_workers)
+    per_worker = drain_rows_per_s / live
+    need = math.ceil(queued_rows / max(per_worker * target_drain_s, 1e-9))
+    return max(1, min(int(need), max_workers))

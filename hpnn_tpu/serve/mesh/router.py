@@ -1,0 +1,403 @@
+"""Router-side mesh state: the worker pool and the fleet coordinator.
+
+:class:`WorkerPool` is the routing table.  Workers announce themselves
+(``POST /v1/mesh/register``, repeated as a heartbeat) and the pool
+health-checks every known worker's ``/healthz`` on a poll loop:
+
+* **placement** -- ``pick(kernel, bucket)`` routes a batch with
+  bucket-affinity + least-depth: among live workers at the minimum
+  in-flight depth, the worker that last served this (kernel, bucket) is
+  preferred -- its jit cache is hot for exactly this padded shape -- and
+  ties rotate round-robin.  Workers whose registered weights generation
+  matches the router's are preferred over stale ones (availability
+  still wins: a stale worker beats no worker).
+* **ejection / readmission** -- a transport failure during dispatch
+  ejects immediately (connection refused is decisive); health-check
+  failures eject after ``HPNN_MESH_EJECT_AFTER`` consecutive misses.
+  A later healthy ``/healthz`` (or a fresh registration -- the worker
+  restarted) readmits, and the worker's own heartbeat loop catches its
+  weights generation up before it reports current again.
+
+:class:`MeshRouter` owns the pool plus fleet-coherent reload: a reload
+on the router (manual POST or the ckpt-manifest watcher) broadcasts
+``{"kernel": path, "set_generation": G}`` to every live worker FIRST,
+ejects any worker that fails to land it, and only then flips the
+router's own generation label -- so the fleet never serves two
+generations under one label longer than the broadcast takes, and
+``X-HPNN-Generation`` pins mean the same weights on every host.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+from ...utils.nn_log import nn_dbg, nn_out, nn_warn
+from .backend import (
+    TRANSPORT_ERRORS,
+    NoLiveWorker,
+    RemoteBackend,
+    get_json,
+    post_json,
+)
+
+STATE_LIVE = "live"
+STATE_WARMING = "warming"   # registered, /healthz still 503-warming
+STATE_DEAD = "dead"
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+class Worker:
+    """One registered worker host."""
+
+    __slots__ = ("wid", "addr", "state", "fails", "inflight", "routed",
+                 "failovers", "kernels", "created_at", "last_seen")
+
+    def __init__(self, addr: str):
+        self.wid = addr  # the advertised addr IS the identity
+        self.addr = addr
+        self.state = STATE_LIVE
+        self.fails = 0
+        self.inflight = 0
+        self.routed = 0
+        self.failovers = 0
+        self.kernels: dict[str, dict] = {}
+        self.created_at = time.time()  # displayed registration timestamp
+        self.last_seen = time.monotonic()
+
+    def to_dict(self) -> dict:
+        return {"addr": self.addr, "state": self.state,
+                "consecutive_failures": self.fails,
+                "inflight": self.inflight, "routed": self.routed,
+                "failovers": self.failovers,
+                "registered_at": round(self.created_at, 3),
+                "kernels": {n: dict(v) for n, v in self.kernels.items()}}
+
+
+class WorkerPool:
+    def __init__(self, eject_after: int | None = None,
+                 auth_token: str | None = None):
+        self.eject_after = (eject_after if eject_after is not None
+                            else _env_int("HPNN_MESH_EJECT_AFTER", 2))
+        self.auth_token = auth_token
+        self._workers: dict[str, Worker] = {}
+        self._affinity: dict[tuple[str, int], str] = {}
+        self._rr = 0
+        self._lock = threading.Lock()
+        self.failovers_total = 0
+        # RPC executor: bounded, shared by every RemoteBackend.  Its
+        # width is the HARD cap on concurrent worker RPCs (the backend's
+        # pipeline depth clamps to it): fleets past 16 workers need
+        # HPNN_MESH_RPC_THREADS raised to keep one batch in flight per
+        # worker.  Threads block on HTTP, not CPU, so they are cheap.
+        self.rpc_threads = _env_int("HPNN_MESH_RPC_THREADS", 16)
+        self.executor = ThreadPoolExecutor(
+            max_workers=self.rpc_threads,
+            thread_name_prefix="hpnn-mesh-rpc")
+        self._closed = False
+        self._health_thread: threading.Thread | None = None
+
+    # --- membership ------------------------------------------------------
+    def register(self, addr: str, kernels: dict | None = None) -> Worker:
+        """Create or refresh a worker entry (registration doubles as the
+        heartbeat).  A re-registering dead worker is readmitted -- the
+        process restarted or the partition healed.  A WARMING worker
+        stays warming: its heartbeat only proves the process is up; the
+        health loop promotes it when /healthz says ok (otherwise the
+        2s heartbeat would flap the 1s health demotion live/warming
+        and the router's quorum readiness with it)."""
+        with self._lock:
+            w = self._workers.get(addr)
+            if w is None:
+                w = self._workers[addr] = Worker(addr)
+                nn_out(f"mesh: worker {addr} registered\n")
+            elif w.state == STATE_DEAD:
+                nn_out(f"mesh: worker {addr} readmitted "
+                       "(re-registration)\n")
+            if w.state != STATE_WARMING:
+                w.state = STATE_LIVE
+            w.fails = 0
+            w.last_seen = time.monotonic()
+            if kernels:
+                w.kernels = {str(k): dict(v) for k, v in kernels.items()
+                             if isinstance(v, dict)}
+            return w
+
+    def workers(self) -> list[Worker]:
+        with self._lock:
+            return list(self._workers.values())
+
+    def live_count(self) -> int:
+        with self._lock:
+            return sum(1 for w in self._workers.values()
+                       if w.state == STATE_LIVE)
+
+    def table(self) -> dict:
+        with self._lock:
+            return {w.wid: w.to_dict() for w in self._workers.values()}
+
+    # --- placement -------------------------------------------------------
+    def pick(self, kernel: str, bucket: int,
+             exclude: set | None = None,
+             want_gen: int | None = None) -> Worker:
+        """Bucket-affinity + least-depth placement (see module doc)."""
+        with self._lock:
+            live = [w for w in self._workers.values()
+                    if w.state == STATE_LIVE
+                    and (not exclude or w.wid not in exclude)]
+            if not live:
+                raise NoLiveWorker(
+                    f"no live worker for kernel '{kernel}' "
+                    f"({len(self._workers)} known)")
+            # heterogeneous fleets: a worker that advertised kernels
+            # but NOT this one would answer 404 (no retry -- an HTTP
+            # answer is not a transport failure); prefer advertisers,
+            # fall back to anyone (a bare registration lists nothing)
+            adv = [w for w in live if not w.kernels or kernel in w.kernels]
+            live = adv or live
+            if want_gen is not None:
+                matched = [w for w in live
+                           if w.kernels.get(kernel, {}).get("generation")
+                           in (None, want_gen)]
+                live = matched or live  # stale beats unavailable
+            min_depth = min(w.inflight for w in live)
+            best = [w for w in live if w.inflight == min_depth]
+            akey = (kernel, int(bucket))
+            aff = self._affinity.get(akey)
+            chosen = next((w for w in best if w.wid == aff), None)
+            if chosen is None:
+                chosen = best[self._rr % len(best)]
+                self._rr += 1
+                self._affinity[akey] = chosen.wid
+            chosen.routed += 1
+            return chosen
+
+    def note_dispatch(self, worker: Worker) -> None:
+        with self._lock:
+            worker.inflight += 1
+
+    def note_done(self, worker: Worker) -> None:
+        with self._lock:
+            worker.inflight = max(0, worker.inflight - 1)
+
+    # --- health ----------------------------------------------------------
+    def report_failure(self, worker: Worker, exc: Exception) -> None:
+        """A dispatch-time transport failure: decisive, eject NOW (the
+        health loop readmits when /healthz answers again)."""
+        with self._lock:
+            worker.fails += 1
+            self.failovers_total += 1
+            worker.failovers += 1
+            if worker.state != STATE_DEAD:
+                worker.state = STATE_DEAD
+                nn_warn(f"mesh: worker {worker.addr} ejected "
+                        f"({type(exc).__name__}: {exc})\n")
+
+    def report_ok(self, worker: Worker) -> None:
+        """A successful dispatch or an ok /healthz poll: THE promotion
+        path back to live (readmission for the dead, warm-up completion
+        for the warming -- registration heartbeats deliberately never
+        promote, see ``register``)."""
+        with self._lock:
+            worker.fails = 0
+            worker.last_seen = time.monotonic()
+            if worker.state == STATE_DEAD:
+                worker.state = STATE_LIVE
+                nn_out(f"mesh: worker {worker.addr} readmitted\n")
+            elif worker.state == STATE_WARMING:
+                worker.state = STATE_LIVE
+
+    def check_health_once(self) -> None:
+        """One poll round over every known worker (dead ones included --
+        that is the readmission path)."""
+        for w in self.workers():
+            try:
+                status, body = get_json(w.addr, "/healthz", timeout_s=2.0)
+            except TRANSPORT_ERRORS as exc:
+                with self._lock:
+                    w.fails += 1
+                    if (w.state != STATE_DEAD
+                            and w.fails >= self.eject_after):
+                        w.state = STATE_DEAD
+                        nn_warn(f"mesh: worker {w.addr} ejected "
+                                f"(health: {type(exc).__name__})\n")
+                continue
+            if status == 200 and body.get("status") == "ok":
+                self.report_ok(w)
+            elif body.get("status") == "warming":
+                with self._lock:
+                    # reachable but compiling: not routable yet, but not
+                    # a failure either
+                    if w.state != STATE_DEAD:
+                        w.state = STATE_WARMING
+                    w.fails = 0
+                    w.last_seen = time.monotonic()
+            else:
+                with self._lock:
+                    w.fails += 1
+                    if (w.state != STATE_DEAD
+                            and w.fails >= self.eject_after):
+                        w.state = STATE_DEAD
+                        nn_warn(f"mesh: worker {w.addr} ejected "
+                                f"(health: {status} "
+                                f"{body.get('status')})\n")
+
+    def start_health_loop(self, interval_s: float) -> None:
+        def loop():
+            while not self._closed:
+                time.sleep(interval_s)
+                if self._closed:
+                    return
+                try:
+                    self.check_health_once()
+                except Exception as exc:  # the loop IS the mesh's
+                    # ejection/readmission engine: one malformed worker
+                    # entry must not silently kill it for good
+                    nn_warn(f"mesh: health poll error (loop continues): "
+                            f"{type(exc).__name__}: {exc}\n")
+
+        self._health_thread = threading.Thread(
+            target=loop, name="hpnn-mesh-health", daemon=True)
+        self._health_thread.start()
+
+    def close(self) -> None:
+        self._closed = True
+        self.executor.shutdown(wait=False)
+
+
+class MeshRouter:
+    """The app-facing coordinator: pool + fleet-coherent reload."""
+
+    def __init__(self, app, required: int = 1,
+                 health_interval_s: float = 1.0):
+        self.app = app
+        self.required = max(1, int(required))
+        self.pool = WorkerPool(auth_token=app.auth_token)
+        self.pool.start_health_loop(health_interval_s)
+        # serializes whole fleet reloads: the --watch-ckpt watcher
+        # racing a manual POST must not broadcast two different weight
+        # files under one target generation
+        self._reload_lock = threading.Lock()
+
+    def backend_for(self, model) -> RemoteBackend:
+        return RemoteBackend(self.pool, model)
+
+    def close(self) -> None:
+        self.pool.close()
+
+    # --- registration (POST /v1/mesh/register) ---------------------------
+    def register_worker(self, addr: str, kernels: dict | None) -> dict:
+        self.pool.register(addr, kernels)
+        # the ack tells the worker where the fleet SHOULD be: current
+        # generation + weights source per kernel, so an ejected/late
+        # worker catches itself up before taking traffic again
+        ack_kernels = {}
+        for name in self.app.registry.names():
+            model = self.app.registry.get(name)
+            if model is not None:
+                ack_kernels[name] = {"generation": model.generation,
+                                     "source": model.source}
+        return {"ok": True, "live": self.pool.live_count(),
+                "required": self.required, "kernels": ack_kernels}
+
+    # --- readiness (healthz quorum) --------------------------------------
+    def readiness(self) -> dict:
+        table = self.pool.table()
+        live = sum(1 for w in table.values() if w["state"] == STATE_LIVE)
+        return {"role": "router", "required": self.required,
+                "live": live, "quorum": live >= self.required,
+                "workers": {wid: {"state": w["state"],
+                                  "inflight": w["inflight"],
+                                  "consecutive_failures":
+                                      w["consecutive_failures"]}
+                            for wid, w in table.items()}}
+
+    # --- fleet-coherent reload ------------------------------------------
+    def coherent_reload(self, name: str,
+                        kernel_path: str | None = None) -> dict:
+        """Broadcast-then-flip: push the new weights to every live
+        worker at an explicit target generation, eject stragglers, then
+        reload the router's own copy at the SAME generation (the traffic
+        flip -- from here the router's label, A/B windows and pins all
+        mean the new fleet-wide weights).  Whole reloads serialize on
+        ``_reload_lock``: two racers (manifest watcher + manual POST)
+        land as two DISTINCT generations in sequence, never two weight
+        sets under one number.  Raises like a local reload: KeyError
+        unknown kernel, ValueError unloadable file."""
+        with self._reload_lock:
+            return self._coherent_reload_locked(name, kernel_path)
+
+    def _coherent_reload_locked(self, name: str,
+                                kernel_path: str | None) -> dict:
+        model = self.app.registry.get(name)
+        if model is None:
+            raise KeyError(name)
+        src = kernel_path or model.source
+        if not src:
+            raise ValueError(
+                f"kernel '{name}' has no weights file to reload from")
+        # validate the file HERE before touching the fleet: a typo'd
+        # path would otherwise make every worker answer 409, eject them
+        # all, and punch a fleet-wide 503 hole for a request that could
+        # never have succeeded
+        from ...io.kernel_io import load_kernel
+
+        if load_kernel(src) is None:
+            raise ValueError(f"failed to load kernel from {src}")
+        target = model.generation + 1
+        ok_workers, failed = [], []
+        headers = {}
+        if self.app.auth_token:
+            headers["Authorization"] = f"Bearer {self.app.auth_token}"
+        for w in self.pool.workers():
+            if w.state == STATE_DEAD:
+                continue  # readmission catch-up handles it later
+            try:
+                status, body, _ = post_json(
+                    w.addr, f"/v1/kernels/{name}/reload",
+                    {"kernel": src, "set_generation": target},
+                    timeout_s=30.0, headers=headers)
+            except TRANSPORT_ERRORS as exc:
+                self.pool.report_failure(w, exc)
+                failed.append(w.wid)
+                continue
+            if status != 200:
+                # the worker answered but could not land the weights:
+                # eject it from routing until its heartbeat catches up,
+                # or the fleet would serve two generations indefinitely
+                self.pool.report_failure(
+                    w, RuntimeError(f"reload HTTP {status}: "
+                                    f"{body.get('error')}"))
+                failed.append(w.wid)
+                continue
+            w.kernels.setdefault(name, {})["generation"] = \
+                body.get("generation", target)
+            ok_workers.append(w.wid)
+        nn_dbg(f"mesh: broadcast reload '{name}' gen {target}: "
+               f"{len(ok_workers)} ok, {len(failed)} failed\n")
+        result = self.app.reload_model(name, src, set_generation=target,
+                                      broadcast=False)
+        result["mesh"] = {"target_generation": target,
+                          "workers_reloaded": ok_workers,
+                          "workers_failed": failed}
+        return result
+
+    # --- metrics ---------------------------------------------------------
+    def metrics_snapshot(self) -> dict:
+        table = self.pool.table()
+        by_state: dict[str, int] = {}
+        for w in table.values():
+            by_state[w["state"]] = by_state.get(w["state"], 0) + 1
+        return {"role": "router", "required": self.required,
+                "live": by_state.get(STATE_LIVE, 0),
+                "workers_by_state": by_state,
+                "failovers_total": self.pool.failovers_total,
+                "workers": table}

@@ -1,0 +1,143 @@
+"""Worker-side mesh agent: registration heartbeat + generation catch-up.
+
+A mesh worker IS a complete single-process server (same registry,
+batcher, tiers, metrics, tracing) -- the only worker-specific machinery
+is this agent, which on a daemon loop
+
+1. POSTs ``/v1/mesh/register`` to the router every
+   ``HPNN_MESH_HEARTBEAT_S`` seconds, advertising its address and the
+   per-kernel weights generation it currently serves (the router's
+   placement prefers generation-matched workers);
+2. reads the router's ack -- the fleet's CURRENT generation + weights
+   source per kernel -- and catches itself up when it is BEHIND
+   (reload at the router's ``set_generation``): that is how an ejected
+   or freshly restarted worker rejoins at the right weights without any
+   operator action.  A worker AHEAD of the router (the window between a
+   broadcast landing here and the router's own flip) never rolls back.
+
+The agent also flips ``registry.retain_generations`` on: mesh reloads
+must keep previous generations pinnable, or ``X-HPNN-Generation``
+through the router would silently fall back to current weights.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ...utils.nn_log import nn_dbg, nn_warn
+from .backend import TRANSPORT_ERRORS, post_json
+
+
+def _heartbeat_s(default: float = 2.0) -> float:
+    try:
+        return float(os.environ.get("HPNN_MESH_HEARTBEAT_S", "")
+                     or default)
+    except ValueError:
+        return default
+
+
+class WorkerAgent:
+    def __init__(self, app, router_addr: str, advertise_addr: str,
+                 interval_s: float | None = None):
+        self.app = app
+        self.router_addr = router_addr
+        self.advertise = advertise_addr
+        self.interval_s = (interval_s if interval_s is not None
+                           else _heartbeat_s())
+        self.registered = False
+        self._closed = False
+        self._thread: threading.Thread | None = None
+        self._warned = False
+        # previous generations must stay pinnable through mesh reloads
+        app.registry.retain_generations = True
+
+    # --- one heartbeat ---------------------------------------------------
+    def beat(self) -> bool:
+        """Register/heartbeat once; returns True when the router acked.
+        Catch-up reloads run inline (they are rare and the loop is a
+        daemon thread, not a request path)."""
+        kernels = {}
+        for name in self.app.registry.names():
+            model = self.app.registry.get(name)
+            if model is not None:
+                kernels[name] = {
+                    "generation": model.generation,
+                    "n_inputs": model.n_inputs,
+                    "n_outputs": model.n_outputs,
+                    "topology": list(model.topology),
+                }
+        headers = {}
+        if self.app.auth_token:
+            headers["Authorization"] = f"Bearer {self.app.auth_token}"
+        try:
+            status, ack, _ = post_json(
+                self.router_addr, "/v1/mesh/register",
+                {"addr": self.advertise, "kernels": kernels},
+                timeout_s=5.0, headers=headers)
+        except TRANSPORT_ERRORS as exc:
+            if not self._warned:
+                # once, not every 2s: the router may simply start later
+                nn_warn(f"mesh: cannot reach router "
+                        f"{self.router_addr} ({exc}); retrying every "
+                        f"{self.interval_s:g}s\n")
+                self._warned = True
+            self.registered = False
+            return False
+        if status != 200:
+            if not self._warned:
+                nn_warn(f"mesh: router {self.router_addr} rejected "
+                        f"registration (HTTP {status}: "
+                        f"{ack.get('error')})\n")
+                self._warned = True
+            self.registered = False
+            return False
+        self._warned = False
+        self.registered = True
+        self._catch_up(ack.get("kernels") or {})
+        return True
+
+    def _catch_up(self, ack_kernels: dict) -> None:
+        for name, info in ack_kernels.items():
+            model = self.app.registry.get(name)
+            if model is None or not isinstance(info, dict):
+                continue
+            want = info.get("generation")
+            src = info.get("source")
+            if not isinstance(want, int) or not src:
+                continue
+            if model.generation >= want:
+                continue  # current, or ahead mid-broadcast: never back
+            if not os.path.exists(src):
+                nn_warn(f"mesh: cannot catch '{name}' up to generation "
+                        f"{want}: {src} not readable from this host\n")
+                continue
+            try:
+                self.app.reload_model(name, src, set_generation=want)
+                nn_dbg(f"mesh: caught '{name}' up to generation "
+                       f"{want} from {src}\n")
+            except (ValueError, KeyError) as exc:
+                nn_warn(f"mesh: catch-up reload of '{name}' failed: "
+                        f"{exc}\n")
+
+    # --- lifecycle -------------------------------------------------------
+    def start(self) -> "WorkerAgent":
+        def loop():
+            while not self._closed:
+                self.beat()
+                time.sleep(self.interval_s)
+
+        self._thread = threading.Thread(
+            target=loop, name="hpnn-mesh-worker", daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._closed = True
+
+    def info(self) -> dict:
+        """What the worker's /healthz reports under ``mesh``."""
+        return {"role": "worker", "router": self.router_addr,
+                "advertise": self.advertise,
+                "registered": self.registered}

@@ -29,8 +29,8 @@ _BUCKET_FACTOR = 10.0 ** 0.1
 _BUCKET_MIN_S = 1e-4
 _N_BUCKETS = 61
 
-_REQUEST_OUTCOMES = ("ok", "queue_full", "deadline", "bad_request",
-                     "not_found", "error")
+_REQUEST_OUTCOMES = ("ok", "queue_full", "quota_exceeded", "deadline",
+                     "bad_request", "not_found", "error")
 
 # request-path phases (ISSUE 8): per-phase latency distributions join
 # /metrics so a slow p99 can be attributed without turning tracing on.
@@ -185,6 +185,13 @@ class ServeMetrics:
         # jobs subsystem gauges, read through a callback at render time
         # (like queue depth) so they can never go stale
         self._jobs_fn: Callable[[], dict] | None = None
+        # mesh subsystem (router worker table), autoscaling signal and
+        # quota table -- same live-callback pattern
+        self._mesh_fn: Callable[[], dict] | None = None
+        self._autoscale_fn: Callable[[], dict] | None = None
+        self._quota_fn: Callable[[], dict] | None = None
+        # per-kernel QoS lane depth gauges (rows queued per lane)
+        self._lane_fns: dict[str, Callable[[], dict]] = {}
 
     # --- write side -----------------------------------------------------
     def count_request(self, outcome: str) -> None:
@@ -301,6 +308,29 @@ class ServeMetrics:
         with self._lock:
             self._jobs_fn = fn
 
+    def set_mesh_source(self, fn: Callable[[], dict] | None) -> None:
+        """Attach the mesh router's live worker-table callback."""
+        with self._lock:
+            self._mesh_fn = fn
+
+    def set_autoscale_source(self, fn: Callable[[], dict] | None) -> None:
+        """Attach the autoscaling-signal callback (queued rows, drain
+        rate, desired-worker count)."""
+        with self._lock:
+            self._autoscale_fn = fn
+
+    def set_quota_source(self, fn: Callable[[], dict] | None) -> None:
+        """Attach the quota table's live snapshot callback."""
+        with self._lock:
+            self._quota_fn = fn
+
+    def register_lanes(self, name: str,
+                       fn: Callable[[], dict]) -> None:
+        """Register a per-lane queued-rows gauge for one served kernel
+        (the batcher's ``lane_depths``)."""
+        with self._lock:
+            self._lane_fns[name] = fn
+
     # --- read side ------------------------------------------------------
     def batch_fill_ratio(self) -> float:
         with self._lock:
@@ -325,10 +355,18 @@ class ServeMetrics:
         from ..io.samples import native_io_status
 
         depths = {name: fn() for name, fn in list(self._depth_fns.items())}
+        lanes = {name: fn() for name, fn in list(self._lane_fns.items())}
         jobs_fn = self._jobs_fn
-        # the jobs callback takes the scheduler/store locks: call it
-        # OUTSIDE our own lock (no nested-lock ordering to get wrong)
+        mesh_fn = self._mesh_fn
+        autoscale_fn = self._autoscale_fn
+        quota_fn = self._quota_fn
+        # the source callbacks take their own subsystem locks
+        # (scheduler/store, worker pool, batchers): call them OUTSIDE
+        # our own lock (no nested-lock ordering to get wrong)
         jobs = jobs_fn() if jobs_fn is not None else None
+        mesh = mesh_fn() if mesh_fn is not None else None
+        autoscale = autoscale_fn() if autoscale_fn is not None else None
+        quota = quota_fn() if quota_fn is not None else None
         with self._lock:
             req = dict(self.requests)
             out = {
@@ -350,6 +388,13 @@ class ServeMetrics:
             }
         out["batch_fill_ratio"] = round(self.batch_fill_ratio(), 4)
         out["queue_depth"] = depths
+        out["lanes"] = lanes
+        if mesh is not None:
+            out["mesh"] = mesh
+        if autoscale is not None:
+            out["autoscale"] = autoscale
+        if quota is not None:
+            out["quota"] = quota
         out["latency"] = self.latency.snapshot()
         out["queue_latency"] = self.queue_latency.snapshot()
         out["device_time"] = self.device_time.snapshot()
@@ -489,6 +534,64 @@ class ServeMetrics:
             lines.append(
                 f'hpnn_serve_queue_depth'
                 f'{{kernel="{_escape_label(name)}"}} {depth}')
+        if snap.get("lanes"):
+            lines += [
+                "# HELP hpnn_serve_lane_depth Rows queued per QoS "
+                "priority lane.",
+                "# TYPE hpnn_serve_lane_depth gauge",
+            ]
+            for name, lanes in sorted(snap["lanes"].items()):
+                for lane, rows in sorted(lanes.items()):
+                    lines.append(
+                        "hpnn_serve_lane_depth"
+                        f'{{kernel="{_escape_label(name)}",'
+                        f'lane="{_escape_label(lane)}"}} {rows}')
+        if snap.get("autoscale") is not None:
+            a = snap["autoscale"]
+            lines += [
+                "# HELP hpnn_serve_desired_workers Workers the current "
+                "backlog needs at the measured drain rate "
+                "(autoscaling signal).",
+                "# TYPE hpnn_serve_desired_workers gauge",
+                f"hpnn_serve_desired_workers {a['desired_workers']}",
+                "# HELP hpnn_serve_drain_rows_per_sec EWMA of completed "
+                "rows/sec across all batchers.",
+                "# TYPE hpnn_serve_drain_rows_per_sec gauge",
+                f"hpnn_serve_drain_rows_per_sec {a['drain_rows_per_s']}",
+            ]
+        if snap.get("mesh") is not None:
+            msh = snap["mesh"]
+            lines += [
+                "# HELP hpnn_mesh_workers Mesh workers by state.",
+                "# TYPE hpnn_mesh_workers gauge",
+            ]
+            for state, n in sorted(
+                    msh.get("workers_by_state", {}).items()):
+                lines.append(
+                    f'hpnn_mesh_workers'
+                    f'{{state="{_escape_label(state)}"}} {n}')
+            lines += [
+                "# HELP hpnn_mesh_failovers_total Worker dispatch "
+                "failures that triggered ejection/retry.",
+                "# TYPE hpnn_mesh_failovers_total counter",
+                f"hpnn_mesh_failovers_total "
+                f"{msh.get('failovers_total', 0)}",
+                "# HELP hpnn_mesh_worker_requests_total Batches routed "
+                "per worker.",
+                "# TYPE hpnn_mesh_worker_requests_total counter",
+            ]
+            for wid, w in sorted(msh.get("workers", {}).items()):
+                lines.append(
+                    "hpnn_mesh_worker_requests_total"
+                    f'{{worker="{_escape_label(wid)}"}} {w["routed"]}')
+        if snap.get("quota") is not None:
+            q = snap["quota"]
+            lines += [
+                "# HELP hpnn_serve_quota_clients Distinct client quota "
+                "buckets tracked.",
+                "# TYPE hpnn_serve_quota_clients gauge",
+                f"hpnn_serve_quota_clients {q['clients']}",
+            ]
         lines += [
             "# HELP hpnn_serve_bucket_rows_per_sec Device rows/sec per "
             "batch bucket.",

@@ -260,7 +260,8 @@ class ServedModel:
             return self._pool
 
     def swap_kernel(self, kernel, source: str | None,
-                    ab: bool = True) -> dict:
+                    ab: bool = True,
+                    set_generation: int | None = None) -> dict:
         """Atomically replace the served weights with ``kernel`` (hot
         reload).  The new device copies (and replicated mesh copies for
         every mesh already in use) are built OUTSIDE the lock, then
@@ -269,7 +270,14 @@ class ServedModel:
         blocks on device transfers.  Same topology -> the per-bucket
         compiled entries keep working untouched (they read the weights
         through the model); a topology change purges this model's cache
-        entries so the next dispatch retraces at the new shapes."""
+        entries so the next dispatch retraces at the new shapes.
+
+        ``set_generation`` pins the POST-swap generation counter to an
+        explicit value instead of the default +1 bump: the mesh
+        coordinator broadcasts one target generation to every worker and
+        the router, so a host that missed intermediate swaps (ejected,
+        restarted) lands on the SAME number as the rest of the fleet and
+        ``X-HPNN-Generation`` means the same weights everywhere."""
         import jax
         import jax.numpy as jnp
 
@@ -335,6 +343,8 @@ class ServedModel:
                 self.n_inputs = kernel.n_inputs
                 self.n_outputs = kernel.n_outputs
             self.generation += 1
+            if set_generation is not None:
+                self.generation = int(set_generation)
             self.loaded_at = _time.time()
             if source:
                 self.source = source
@@ -580,12 +590,16 @@ class ModelRegistry:
 
     # --- hot reload -----------------------------------------------------
     def reload(self, name: str,
-               kernel_path: str | None = None) -> tuple[dict | None, str]:
+               kernel_path: str | None = None,
+               set_generation: int | None = None
+               ) -> tuple[dict | None, str]:
         """Re-read a model's weights from disk and swap them in under
         traffic.  ``kernel_path`` defaults to the model's last source
         (its conf's ``[init]`` kernel file, or whatever the previous
-        reload used).  Returns ``(result, "")`` or ``(None, reason)`` --
-        a failed load leaves the served weights UNTOUCHED."""
+        reload used).  ``set_generation`` pins the resulting generation
+        counter (mesh-coherent reloads; see ``swap_kernel``).  Returns
+        ``(result, "")`` or ``(None, reason)`` -- a failed load leaves
+        the served weights UNTOUCHED."""
         model = self.get(name)
         if model is None:
             return None, f"unknown kernel '{name}'"
@@ -600,7 +614,8 @@ class ModelRegistry:
             kernel = load_kernel(src)
             if kernel is None:
                 return None, f"failed to load kernel from {src}"
-            result = model.swap_kernel(kernel, src)
+            result = model.swap_kernel(kernel, src,
+                                       set_generation=set_generation)
         self.metrics.set_model_info(name, model.generation,
                                     model.loaded_at)
         nn_out(f"serve: reloaded kernel '{name}' from {src} "

@@ -56,10 +56,28 @@ Endpoints:
 * ``POST /v1/jobs/<id>/{cancel,promote,rollback}`` -- stop the job at
   the next epoch boundary (final snapshot written, resumable) /
   finalize its A/B window.
+* ``POST /v1/mesh/register`` -- a mesh worker's registration heartbeat
+  (``serve_nn --mesh-role worker``); the router's ack carries the
+  fleet's current weights generation + source per kernel so late
+  workers catch themselves up.  503 on a server without a router role.
+* ``GET /v1/mesh/workers`` -- the router's worker table (state,
+  in-flight depth, routed counts, per-kernel generations).
 
-Mutating endpoints (reload, train, job actions) honor ``--auth-token``
-/ ``HPNN_SERVE_TOKEN``: when configured, requests without the matching
-``Authorization: Bearer`` (or ``X-HPNN-Token``) header get 401.
+QoS request headers (honored by every server; the mesh router is where
+they matter most):
+
+* ``X-HPNN-Priority: high|normal|low`` -- queue lane; dequeue is
+  lane-ordered, earliest-deadline-first within a lane.
+* ``X-HPNN-Deadline-Ms: N`` -- per-request deadline: admission rejects
+  an expired one with 504 immediately, EDF orders by it, and it rides
+  the mesh RPC so workers enforce the same budget.
+* ``X-HPNN-Client: ID`` -- quota key for ``--quota-rows`` token
+  buckets (falls back to the auth token, then the peer address).
+
+Mutating endpoints (reload, train, job actions, mesh registration)
+honor ``--auth-token`` / ``HPNN_SERVE_TOKEN``: when configured,
+requests without the matching ``Authorization: Bearer`` (or
+``X-HPNN-Token``) header get 401.
 
 Status mapping (distinct by failure class, so clients can react):
 
@@ -70,10 +88,14 @@ Status mapping (distinct by failure class, so clients can react):
   401   missing/invalid auth token on a mutating endpoint
   404   unknown kernel / job / pinned generation
   409   reload failed / job action in a conflicting state
-  429   queue full (backpressure -- retry later; Retry-After: 1)
+  429   queue full or quota exceeded (backpressure -- the
+        Retry-After header is computed from the queue's measured
+        drain rate / the quota bucket's refill rate)
   501   device profiler unavailable on this host/backend
-  503   server draining (shutdown in progress) / jobs disabled
-  504   deadline exceeded (queued or computed past the timeout)
+  503   server draining (shutdown in progress) / jobs disabled /
+        no live mesh worker
+  504   deadline exceeded (admission, queued, or computed past the
+        per-request deadline)
   ====  ==========================================================
 
 ``ThreadingHTTPServer`` gives one thread per connection; they all block
@@ -85,6 +107,7 @@ from __future__ import annotations
 
 import hmac
 import json
+import math
 import os
 import re
 import threading
@@ -95,6 +118,8 @@ import numpy as np
 
 from ..utils.nn_log import nn_dbg, nn_out
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull, ServeClosed
+from .mesh import qos as mesh_qos
+from .mesh.backend import NoLiveWorker, RemoteHTTPError
 from .metrics import ServeMetrics
 from .registry import ModelRegistry
 
@@ -108,10 +133,12 @@ _JOB_ACTION_RE = re.compile(
 
 
 class _HTTPError(Exception):
-    def __init__(self, status: int, outcome: str, message: str):
+    def __init__(self, status: int, outcome: str, message: str,
+                 retry_after: float | None = None):
         super().__init__(message)
         self.status = status
         self.outcome = outcome
+        self.retry_after = retry_after  # seconds; 429s render the header
 
 
 def _parse_multipart(body: bytes,
@@ -177,10 +204,17 @@ class ServeApp:
                  auth_token: str | None = None,
                  ab_fraction: float = 0.0,
                  trace: bool | None = None,
-                 profile_dir: str | None = None):
+                 profile_dir: str | None = None,
+                 quota_rows: float = 0.0,
+                 quota_burst: float | None = None):
         self.metrics = metrics or ServeMetrics()
         self.auth_token = auth_token or None
         self.jobs = None  # JobScheduler once enable_jobs() runs
+        self.mesh_router = None  # MeshRouter once enable_mesh_router()
+        self.mesh_worker = None  # WorkerAgent when serving as a worker
+        # per-client token-bucket quotas (rows/sec; 0 = no quota)
+        self.quota = (mesh_qos.QuotaTable(quota_rows, quota_burst)
+                      if quota_rows and quota_rows > 0 else None)
         self.started_mono = time.monotonic()  # /healthz uptime_s
         self.profile_dir = profile_dir  # /v1/debug/profile default dest
         # span tracing (ISSUE 8): explicit flag wins -- True enables,
@@ -223,6 +257,11 @@ class ServeApp:
         self._warming_lock = threading.Lock()
         self._watchers: list[threading.Thread] = []
         self._closed = False
+        # autoscaling signal: queued rows + measured drain rate ->
+        # desired-worker gauge, read live at /metrics render time
+        self.metrics.set_autoscale_source(self.autoscale_snapshot)
+        if self.quota is not None:
+            self.metrics.set_quota_source(self.quota.snapshot)
 
     def _warm(self, model) -> None:
         try:
@@ -259,7 +298,9 @@ class ServeApp:
         model = self.registry.register_conf(conf_path, name=name)
         if model is None:
             return None
-        if warmup:
+        if warmup and self.mesh_router is None:
+            # a router never launches locally: warming its (unused)
+            # device buckets would just delay readiness
             if background:
                 with self._warming_lock:
                     self._warming.add(model.name)
@@ -268,11 +309,15 @@ class ServeApp:
                     name=f"hpnn-warmup-{model.name}", daemon=True).start()
             else:
                 self._warm(model)
+        backend = (self.mesh_router.backend_for(model)
+                   if self.mesh_router is not None else None)
         b = MicroBatcher(model, metrics=self.metrics,
                          max_queue_rows=self.max_queue_rows,
-                         linger_s=self.linger_s)
+                         linger_s=self.linger_s,
+                         backend=backend)
         self.batchers[model.name] = b
         self.metrics.register_queue(model.name, b.depth)
+        self.metrics.register_lanes(model.name, b.lane_depths)
         return model
 
     def infer(self, name: str, xs: np.ndarray,
@@ -290,8 +335,14 @@ class ServeApp:
             # in-flight epoch, snapshots and lands `interrupted`
             # (resumable) before the eval batchers stop
             self.jobs.drain()
+        if self.mesh_worker is not None:
+            self.mesh_worker.close()
         for b in self.batchers.values():
             b.close(drain=drain)
+        if self.mesh_router is not None:
+            # after the batchers: draining batches may still need the
+            # pool's RPC executor
+            self.mesh_router.close()
 
     # --- auth (mutating endpoints) --------------------------------------
     def authorized(self, headers) -> bool:
@@ -332,14 +383,90 @@ class ServeApp:
         self.metrics.set_jobs_source(self.jobs.metrics_snapshot)
         return self.jobs
 
+    # --- multi-host serve mesh ------------------------------------------
+    def enable_mesh_router(self, required_workers: int = 1,
+                           health_interval_s: float = 1.0):
+        """Turn this app into a mesh ROUTER (``serve_nn --mesh-role
+        router``): models registered after this call get a
+        ``RemoteBackend`` that fans their batches over the worker pool,
+        /healthz reports ``warming`` until a quorum of workers is live,
+        and reloads become fleet-coherent broadcasts.  Must run before
+        ``add_model`` -- the backend is wired at batcher creation."""
+        from .mesh.router import MeshRouter
+
+        if self.batchers:
+            raise RuntimeError("enable_mesh_router must run before any "
+                               "add_model (backends are wired at "
+                               "batcher creation)")
+        self.mesh_router = MeshRouter(
+            self, required=required_workers,
+            health_interval_s=health_interval_s)
+        self.metrics.set_mesh_source(self.mesh_router.metrics_snapshot)
+        return self.mesh_router
+
+    def handle_mesh_register(self, body: bytes) -> dict:
+        """POST /v1/mesh/register: a worker's registration heartbeat."""
+        if self.mesh_router is None:
+            raise _HTTPError(503, "mesh_disabled",
+                             "this server is not a mesh router "
+                             "(start serve_nn with --mesh-role router)")
+        try:
+            req = json.loads(body.decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError) as exc:
+            raise _HTTPError(400, "bad_request", f"bad JSON: {exc}")
+        if not isinstance(req, dict) or not req.get("addr"):
+            raise _HTTPError(400, "bad_request",
+                             "body must be an object with 'addr'")
+        addr = str(req["addr"])
+        # the addr IS how every later RPC/health poll reaches the
+        # worker: a port-less or junk-port addr must be rejected HERE,
+        # not discovered as int() ValueErrors inside the dispatch path
+        # and the health loop
+        _host, _, port = addr.rpartition(":")
+        if not (_host and port.isdigit() and 0 < int(port) < 65536):
+            raise _HTTPError(400, "bad_request",
+                             f"'addr' must be HOST:PORT, got {addr!r}")
+        kernels = req.get("kernels")
+        if kernels is not None and not isinstance(kernels, dict):
+            raise _HTTPError(400, "bad_request",
+                             "'kernels' must be an object")
+        return self.mesh_router.register_worker(addr, kernels)
+
+    def autoscale_snapshot(self) -> dict:
+        """The autoscaling signal /metrics renders: queued rows, the
+        measured fleet drain rate, and the desired-worker-count gauge
+        derived from them (``mesh.qos.desired_workers``)."""
+        queued = sum(b.depth() for b in self.batchers.values())
+        rate = sum(b.drain_rate() for b in self.batchers.values())
+        live = (self.mesh_router.pool.live_count()
+                if self.mesh_router is not None else 1)
+        return {
+            "queued_rows": queued,
+            "drain_rows_per_s": round(rate, 2),
+            "live_workers": live,
+            "desired_workers": mesh_qos.desired_workers(queued, rate,
+                                                        live),
+        }
+
     # --- model lifecycle (hot reload) ----------------------------------
     def reload_model(self, name: str,
-                     kernel_path: str | None = None) -> dict:
+                     kernel_path: str | None = None,
+                     set_generation: int | None = None,
+                     broadcast: bool = True) -> dict:
         """Swap a model's weights from disk under traffic (registry
         ``reload``); raises KeyError for an unknown kernel, ValueError
         when the weights file cannot be loaded (the served weights stay
-        untouched).  Counted into the reload metrics either way."""
-        result, reason = self.registry.reload(name, kernel_path)
+        untouched).  Counted into the reload metrics either way.
+
+        On a mesh router every reload is FLEET-COHERENT: the weights are
+        broadcast to the live workers at one target generation first,
+        and only then does the router flip its own label (``broadcast=
+        False`` is the coordinator's recursion guard)."""
+        if (broadcast and self.mesh_router is not None
+                and set_generation is None):
+            return self.mesh_router.coherent_reload(name, kernel_path)
+        result, reason = self.registry.reload(
+            name, kernel_path, set_generation=set_generation)
         if result is None:
             self.metrics.count_reload(False)
             if "unknown kernel" in reason:
@@ -464,7 +591,8 @@ class ServeApp:
     # --- request handling (transport-independent) ----------------------
     def handle_infer(self, name: str, body: bytes,
                      headers=None,
-                     trace_ctx: tuple[str, str] | None = None) -> dict:
+                     trace_ctx: tuple[str, str] | None = None,
+                     peer: str | None = None) -> dict:
         from ..obs import trace as obs_trace
 
         b = self.batchers.get(name)
@@ -487,13 +615,25 @@ class ServeApp:
             except (TypeError, ValueError):
                 raise _HTTPError(400, "bad_request",
                                  "X-HPNN-Generation must be an integer")
+        if self.mesh_router is not None and requested is not None:
+            # the router never retains generations itself -- pass the
+            # pin through; the worker validates it (its 404 propagates)
+            gen = requested
+        else:
+            try:
+                gen = b.model.resolve_generation(requested)
+            except KeyError:
+                raise _HTTPError(
+                    404, "unknown_generation",
+                    f"kernel '{name}' has no pinned generation "
+                    f"{requested} (retained: "
+                    f"{b.model.generation_table()['retained']})")
+        # QoS lane + per-request deadline headers (mesh subsystem)
         try:
-            gen = b.model.resolve_generation(requested)
-        except KeyError:
-            raise _HTTPError(
-                404, "unknown_generation",
-                f"kernel '{name}' has no pinned generation {requested} "
-                f"(retained: {b.model.generation_table()['retained']})")
+            lane = mesh_qos.parse_priority(
+                headers.get("X-HPNN-Priority") if headers else None)
+        except ValueError as exc:
+            raise _HTTPError(400, "bad_request", str(exc))
         raw = req.get("inputs")
         if raw is None:
             one = req.get("input")
@@ -518,6 +658,28 @@ class ServeApp:
                 timeout_s = float(req["timeout_ms"]) / 1e3
             except (TypeError, ValueError):
                 raise _HTTPError(400, "bad_request", "bad timeout_ms")
+        deadline_hdr = (headers.get("X-HPNN-Deadline-Ms") if headers
+                        else None)
+        if deadline_hdr is not None:
+            # the header IS the request's deadline: it wins over both
+            # the body timeout and the queue-global default
+            try:
+                timeout_s = mesh_qos.parse_deadline_ms(deadline_hdr)
+            except (TypeError, ValueError):
+                raise _HTTPError(400, "bad_request",
+                                 "X-HPNN-Deadline-Ms must be a number")
+        # per-client quota: charged per row, BEFORE queue admission --
+        # an over-quota client never occupies queue capacity
+        quota_key = None
+        if self.quota is not None:
+            quota_key = mesh_qos.client_key(headers, peer)
+            allowed, wait_s = self.quota.allow(quota_key,
+                                               float(xs.shape[0]))
+            if not allowed:
+                raise _HTTPError(
+                    429, "quota_exceeded",
+                    f"client quota exceeded ({self.quota.rate:g} rows/s"
+                    f"; retry in {wait_s:.2f}s)", retry_after=wait_s)
         t_parse1 = time.monotonic()
         self.metrics.observe_phase("parse", t_parse1 - t_parse0)
         if trace_ctx is not None:
@@ -527,13 +689,26 @@ class ServeApp:
         try:
             outs, served_gen = b.submit(xs, timeout_s, gen=gen,
                                         return_gen=True,
-                                        trace=trace_ctx)
+                                        trace=trace_ctx, lane=lane)
         except QueueFull as exc:
-            raise _HTTPError(429, "queue_full", str(exc))
+            if quota_key is not None:
+                # the charge bought no service: refund it, or obedient
+                # Retry-After clients burn their quota on backpressure
+                self.quota.refund(quota_key, float(xs.shape[0]))
+            raise _HTTPError(429, "queue_full", str(exc),
+                             retry_after=getattr(exc, "retry_after_s",
+                                                 None)
+                             or b.retry_after_s())
         except DeadlineExceeded as exc:
             raise _HTTPError(504, "deadline", str(exc))
         except ServeClosed as exc:
             raise _HTTPError(503, "error", str(exc))
+        except NoLiveWorker as exc:
+            raise _HTTPError(503, "mesh_unavailable", str(exc))
+        except RemoteHTTPError as exc:
+            # a worker answered with a status the router should pass
+            # through verbatim (e.g. 404 unknown_generation on a pin)
+            raise _HTTPError(exc.status, exc.reason, str(exc))
         except Exception as exc:
             raise _HTTPError(500, "error", f"{type(exc).__name__}: {exc}")
         if served_gen is None:  # registry stand-ins without generations
@@ -552,9 +727,12 @@ class ServeApp:
     def handle_reload(self, name: str, body: bytes) -> dict:
         """POST /v1/kernels/<name>/reload: optional JSON body
         ``{"kernel": "<path>"}`` picks the weights file; default is the
-        model's last source.  409 when the file fails to load (the old
-        weights keep serving)."""
+        model's last source.  ``{"set_generation": G}`` (the mesh
+        coordinator's broadcast form) pins the post-swap generation
+        counter so the whole fleet lands on one number.  409 when the
+        file fails to load (the old weights keep serving)."""
         kernel_path = None
+        set_generation = None
         if body.strip():
             try:
                 req = json.loads(body.decode("utf-8"))
@@ -568,8 +746,17 @@ class ServeApp:
                                                           str):
                 raise _HTTPError(400, "bad_request",
                                  "'kernel' must be a path string")
+            set_generation = req.get("set_generation")
+            if set_generation is not None:
+                try:
+                    set_generation = int(set_generation)
+                except (TypeError, ValueError):
+                    raise _HTTPError(400, "bad_request",
+                                     "'set_generation' must be an "
+                                     "integer")
         try:
-            return self.reload_model(name, kernel_path)
+            return self.reload_model(name, kernel_path,
+                                     set_generation=set_generation)
         except KeyError:
             raise _HTTPError(404, "not_found", f"unknown kernel '{name}'")
         except ValueError as exc:
@@ -695,9 +882,21 @@ class _Handler(BaseHTTPRequestHandler):
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             warming = self.app.warming()
+            mesh = None
+            router = self.app.mesh_router
+            if router is not None:
+                mesh = router.readiness()
+            elif self.app.mesh_worker is not None:
+                mesh = self.app.mesh_worker.info()
             if self.app._closed:
                 status = "draining"
             elif warming:
+                status = "warming"
+            elif mesh is not None and mesh.get("quorum") is False:
+                # a mesh router is not ready until a QUORUM of workers
+                # is: local state alone says nothing about whether a
+                # request could actually be served -- the per-worker
+                # readiness table rides in body["mesh"]["workers"]
                 status = "warming"
             else:
                 status = "ok"
@@ -714,9 +913,21 @@ class _Handler(BaseHTTPRequestHandler):
                                     self.app.batchers.items()},
                     "active_jobs": 0 if jobs is None else
                     jobs.queue.depth() + (1 if jobs._current else 0)}
+            if mesh is not None:
+                body["mesh"] = mesh
             if warming:
                 body["warming"] = warming
             self._reply(200 if status == "ok" else 503, body)
+            return
+        if path == "/v1/mesh/workers":
+            router = self.app.mesh_router
+            if router is None:
+                self._reply(404, {"error": "not a mesh router",
+                                  "reason": "mesh_disabled"})
+                return
+            self._reply(200, {"workers": router.pool.table(),
+                              "required": router.required,
+                              "live": router.pool.live_count()})
             return
         if path == "/v1/debug/trace":
             from ..obs import trace as obs_trace
@@ -837,12 +1048,23 @@ class _Handler(BaseHTTPRequestHandler):
         t = _TRAIN_RE.match(path)
         a = _JOB_ACTION_RE.match(path)
         prof = path == "/v1/debug/profile"
-        if (r or t or a or prof) and not self.app.authorized(self.headers):
+        mesh_reg = path == "/v1/mesh/register"
+        if (r or t or a or prof or mesh_reg) \
+                and not self.app.authorized(self.headers):
             # every mutating endpoint sits behind the auth token when
             # one is configured; infer/metrics/healthz stay open
             self._reply(401, {"error": "missing or invalid auth token",
                               "reason": "unauthorized"},
                         extra_headers={"WWW-Authenticate": "Bearer"})
+            return
+        if mesh_reg:
+            try:
+                out = self.app.handle_mesh_register(body)
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+                return
+            self._reply(200, out)
             return
         if r is not None:
             try:
@@ -910,12 +1132,16 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             out = self.app.handle_infer(m.group(1), body,
                                         headers=self.headers,
-                                        trace_ctx=trace_ctx)
+                                        trace_ctx=trace_ctx,
+                                        peer=self.client_address[0])
         except _HTTPError as exc:
             self.app.metrics.count_request(exc.outcome)
             headers = dict(echo or {})
             if exc.status == 429:
-                headers["Retry-After"] = "1"
+                # Retry-After from the queue's measured drain rate (or
+                # the quota bucket's refill) instead of a flat 1s
+                headers["Retry-After"] = str(
+                    max(1, math.ceil(exc.retry_after or 1.0)))
             if trace_ctx is not None:
                 obs_trace.record("serve.request", t_req0,
                                  time.monotonic(), trace_id=trace_ctx[0],

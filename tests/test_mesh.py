@@ -1,0 +1,1028 @@
+"""Multi-host serve mesh (ISSUE 9): backend/router/worker/QoS units +
+the end-to-end acceptance pins.
+
+Fast tier (in-process apps, real HTTP over loopback):
+
+  * degenerate mesh parity -- a router with ONE worker answers
+    byte-identically to the existing single-process fast tier for the
+    same sequential requests (the acceptance pin);
+  * failover -- one of two workers dies mid-operation (listening socket
+    closed = connection refused, exactly what a kill -9 looks like to
+    the router) and every subsequent request still answers 200 via
+    retry-once-elsewhere + ejection;
+  * fleet-coherent reload -- a ckpt manifest bump reloads BOTH workers
+    at one broadcast generation before the router flips, and
+    X-HPNN-Generation pins keep working through the mesh;
+  * QoS -- priority-lane EDF dequeue ordering, per-request deadline
+    headers (admission 504 included), per-client quotas with
+    drain-rate/refill Retry-After, per-lane /metrics gauges and the
+    desired-worker autoscaling signal.
+
+Slow tier: the heavy e2e with REAL subprocess workers and an actual
+``kill -9`` under concurrent load (zero non-200 beyond the in-flight
+retry window), driven through the same helpers scripts/mesh_bench.py
+uses.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import mesh_bench  # noqa: E402
+import serve_bench  # noqa: E402
+
+from hpnn_tpu.serve import MicroBatcher, ServeApp, ServeMetrics  # noqa: E402
+from hpnn_tpu.serve.batcher import (  # noqa: E402
+    DeadlineExceeded,
+    LocalBackend,
+    QueueFull,
+)
+from hpnn_tpu.serve.mesh import qos  # noqa: E402
+from hpnn_tpu.serve.mesh.backend import NoLiveWorker  # noqa: E402
+from hpnn_tpu.serve.mesh.router import WorkerPool  # noqa: E402
+from hpnn_tpu.serve.mesh.worker import WorkerAgent  # noqa: E402
+from hpnn_tpu.serve.registry import bucket_rows  # noqa: E402
+from hpnn_tpu.serve.server import serve_in_thread  # noqa: E402
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+
+
+def _write_kernel_conf(tmp_path, name="tiny", seed=1234):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path, load_kernel
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    kern = load_kernel(kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    return str(conf), kern, kpath
+
+
+def _post_raw(base, path, payload, headers=None):
+    """Raw-byte POST (the byte-parity pin compares exact bodies)."""
+    import urllib.error
+    import urllib.request
+
+    h = {"Content-Type": "application/json"}
+    h.update(headers or {})
+    req = urllib.request.Request(base + path,
+                                 data=json.dumps(payload).encode(),
+                                 headers=h)
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read(), dict(exc.headers)
+
+
+# --- QoS units --------------------------------------------------------------
+
+def test_parse_priority_lanes():
+    assert qos.parse_priority(None) == qos.LANE_NORMAL
+    assert qos.parse_priority("high") == 0
+    assert qos.parse_priority(" Normal ") == 1
+    assert qos.parse_priority("low") == 2
+    assert qos.parse_priority("0") == 0
+    with pytest.raises(ValueError):
+        qos.parse_priority("urgent")
+
+
+def test_parse_deadline_ms():
+    assert qos.parse_deadline_ms("1500") == 1.5
+    assert qos.parse_deadline_ms("-5") < 0  # expired: caller 504s
+    with pytest.raises(ValueError):
+        qos.parse_deadline_ms("soon")
+
+
+def test_client_key_precedence():
+    assert qos.client_key({"X-HPNN-Client": "alice"},
+                          "1.2.3.4") == "client:alice"
+    assert qos.client_key({"Authorization": "Bearer tok"},
+                          "1.2.3.4") == "token:Bearer tok"
+    assert qos.client_key({}, "1.2.3.4") == "peer:1.2.3.4"
+    assert qos.client_key(None, None) == "peer:anon"
+
+
+def test_token_bucket_and_quota_table():
+    b = qos.TokenBucket(rate=10.0, burst=5.0)
+    now = time.monotonic()
+    ok, _ = b.allow(5.0, now=now)
+    assert ok
+    ok, wait = b.allow(1.0, now=now)  # empty: 1 token at 10/s = 0.1s
+    assert not ok and 0.05 <= wait <= 0.15
+    ok, _ = b.allow(1.0, now=now + 0.2)  # refilled
+    assert ok
+    # over-burst cost = DEBT model: admitted only at a full bucket,
+    # charged its TRUE cost (tokens go negative) -- neither an
+    # un-admittable 429 loop nor a burst-priced quota bypass
+    big = qos.TokenBucket(rate=10.0, burst=5.0)
+    bnow = big.t_last
+    ok, _ = big.allow(50.0, now=bnow)
+    assert ok and big.tokens == -45.0  # full charge, in debt
+    ok, wait = big.allow(50.0, now=bnow)
+    assert not ok and wait == (5.0 - -45.0) / 10.0  # honest, finite
+    ok, _ = big.allow(1.0, now=bnow + 1.0)  # still paying the debt
+    assert not ok
+    ok, _ = big.allow(50.0, now=bnow + 5.0)  # debt repaid, bucket full
+    assert ok
+    # refund restores a charge that bought no service
+    rb = qos.TokenBucket(rate=10.0, burst=5.0)
+    rb.allow(5.0, now=rb.t_last)
+    assert not rb.allow(5.0, now=rb.t_last)[0]
+    rb.refund(5.0)
+    assert rb.allow(5.0, now=rb.t_last)[0]
+    q = qos.QuotaTable(rows_per_s=10.0, burst=5.0, max_clients=2)
+    assert q.allow("a", 5.0)[0]
+    assert not q.allow("a", 1.0)[0]
+    assert q.allow("b", 1.0)[0]
+    # a third client evicts the LRU ("a"); eviction only re-fills
+    assert q.allow("c", 1.0)[0]
+    assert q.snapshot()["clients"] == 2
+
+
+def test_desired_workers_signal():
+    assert qos.desired_workers(0, 100.0, 4) == 1  # idle floor
+    # backlog, nothing measured yet: ask for one more
+    assert qos.desired_workers(50, 0.0, 2) == 3
+    # 100 rows queued, fleet drains 40/s over 2 workers = 20/worker:
+    # draining within 1s needs 5 workers
+    assert qos.desired_workers(100, 40.0, 2, target_drain_s=1.0) == 5
+    assert qos.desired_workers(10_000, 1.0, 1, max_workers=16) == 16
+
+
+# --- worker pool placement --------------------------------------------------
+
+def test_pool_placement_affinity_and_least_depth():
+    pool = WorkerPool(eject_after=2)
+    a = pool.register("127.0.0.1:1001")
+    b = pool.register("127.0.0.1:1002")
+    first = pool.pick("k", 8)
+    # bucket affinity: an idle pool keeps routing a bucket to the same
+    # worker (its jit cache is hot for that padded shape)
+    assert all(pool.pick("k", 8) is first for _ in range(5))
+    # least depth beats affinity: the affine worker is busy
+    pool.note_dispatch(first)
+    other = pool.pick("k", 8)
+    assert other is not first
+    pool.note_done(first)
+    # exclusion (the retry path) never returns the failed worker
+    assert pool.pick("k", 8, exclude={a.wid}) is b
+    assert pool.pick("k", 8, exclude={b.wid}) is a
+    with pytest.raises(NoLiveWorker):
+        pool.pick("k", 8, exclude={a.wid, b.wid})
+    # heterogeneous fleet: a worker advertising OTHER kernels is not
+    # picked for one it does not serve while an advertiser is live
+    a.kernels = {"k": {"generation": 1}}
+    b.kernels = {"other": {"generation": 1}}
+    for _ in range(4):
+        assert pool.pick("k", 8) is a
+    assert pool.pick("other", 8) is b
+    pool.close()
+
+
+def test_pool_generation_preference_and_ejection():
+    pool = WorkerPool(eject_after=2)
+    a = pool.register("127.0.0.1:2001", {"k": {"generation": 2}})
+    b = pool.register("127.0.0.1:2002", {"k": {"generation": 1}})
+    # generation-matched workers are preferred over stale ones
+    for _ in range(4):
+        assert pool.pick("k", 4, want_gen=2) is a
+    # ...but a stale worker beats no worker at all
+    pool.report_failure(a, ConnectionRefusedError("gone"))
+    assert a.state == "dead"
+    assert pool.pick("k", 4, want_gen=2) is b
+    # re-registration readmits (the worker restarted)
+    pool.register("127.0.0.1:2001", {"k": {"generation": 2}})
+    assert a.state == "live"
+    # ...but a WARMING worker's heartbeat must NOT self-promote: only
+    # the health loop's ok-poll does, or readiness flaps (review
+    # finding)
+    a.state = "warming"
+    pool.register("127.0.0.1:2001", {"k": {"generation": 2}})
+    assert a.state == "warming"
+    pool.report_ok(a)  # the health loop's promotion path
+    assert a.state == "live"
+    pool.close()
+
+
+# --- batcher QoS (EDF lanes, deadlines, drain-rate Retry-After) -------------
+
+class _OrderModel:
+    """Stand-in recording the first feature value of every dispatched
+    batch -- the dequeue-order probe (LocalBackend drives it exactly
+    like a real registry)."""
+
+    class _Handle:
+        def __init__(self, out, rows, bucket):
+            self.out, self.rows, self.bucket = out, rows, bucket
+
+    class _Reg:
+        def __init__(self, model, max_batch):
+            self.model, self.max_batch = model, max_batch
+            self.metrics = ServeMetrics()
+
+        def dispatch(self, model, xs):
+            model.order.append(float(xs[0, 0]))
+            return _OrderModel._Handle(
+                xs.sum(axis=1, keepdims=True), xs.shape[0],
+                bucket_rows(xs.shape[0], self.max_batch))
+
+        def collect(self, handle):
+            time.sleep(self.model.delay_s)
+            return handle.out
+
+    def __init__(self, max_batch=2, delay_s=0.0):
+        self.name = "order"
+        self.registry = self._Reg(self, max_batch)
+        self.delay_s = delay_s
+        self.order: list[float] = []
+
+
+def test_edf_lane_ordering():
+    """Dequeue is lane-ordered (high first), EDF within a lane; with
+    uniform lanes+timeouts the order is exactly the old FIFO."""
+    model = _OrderModel(max_batch=2)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=64)
+    b.pause()
+    done = []
+
+    def client(val, timeout_s, lane):
+        xs = np.full((2, 4), float(val))
+        done.append(b.submit(xs, timeout_s, lane=lane))
+
+    # submit order: low, normal-late-deadline, normal-early-deadline,
+    # high.  max_batch=2 rows = one request per batch, so the dispatch
+    # order IS the dequeue order.
+    specs = [(1.0, 30.0, 2), (2.0, 30.0, 1), (3.0, 10.0, 1),
+             (4.0, 30.0, 0)]
+    threads = []
+    for val, t_s, lane in specs:
+        t = threading.Thread(target=client, args=(val, t_s, lane))
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)  # deterministic enqueue order
+    assert b.depth() == 8
+    lanes = b.lane_depths()
+    assert lanes == {"high": 2, "normal": 4, "low": 2}
+    b.resume()
+    for t in threads:
+        t.join()
+    # high lane first, EDF within normal (3.0 before 2.0), low last
+    assert model.order == [4.0, 3.0, 2.0, 1.0]
+    b.close()
+
+
+def test_admission_rejects_expired_deadline():
+    model = _OrderModel()
+    b = MicroBatcher(model, metrics=model.registry.metrics)
+    with pytest.raises(DeadlineExceeded):
+        b.submit(np.zeros((1, 4)), timeout_s=-0.5)
+    assert model.order == []  # never queued, never dispatched
+    b.close()
+
+
+def test_expired_low_lane_rows_reaped_not_leaked():
+    """Whole-queue expiry: a low-lane request that never reaches the
+    head (EDF keeps higher lanes in front) must still be failed AND its
+    rows reclaimed at the next pop -- dead entries may not consume
+    max_queue_rows capacity forever (review finding)."""
+    model = _OrderModel(max_batch=2)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=8)
+    b.pause()
+    results = {}
+
+    def client(key, val, timeout_s, lane):
+        try:
+            results[key] = b.submit(np.full((2, 4), val), timeout_s,
+                                    lane=lane)
+        except DeadlineExceeded:
+            results[key] = "deadline"
+
+    t_low = threading.Thread(target=client, args=("low", 1.0, 0.1, 2))
+    t_high = threading.Thread(target=client, args=("high", 2.0, 30.0, 0))
+    t_low.start()
+    t_high.start()
+    deadline = time.monotonic() + 5
+    while b.depth() < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    time.sleep(0.25)  # let the low lane's deadline lapse while queued
+    b.resume()
+    t_low.join()
+    t_high.join()
+    assert results["low"] == "deadline"
+    assert isinstance(results["high"], np.ndarray)
+    # the expired entry never dispatched and its rows were reclaimed
+    assert model.order == [2.0]
+    assert b.depth() == 0
+    assert b.lane_depths() == {"high": 0, "normal": 0, "low": 0}
+    b.close()
+
+
+def test_batch_deadline_forwarded_is_most_generous():
+    """A near-expired member must not 504 the whole coalesced batch:
+    the backend receives the batch's MAX deadline (review finding)."""
+    seen = {}
+
+    class _RecordingBackend(LocalBackend):
+        def dispatch(self, xs, gen=None, trace=None, deadline=None,
+                     lane=None):
+            seen["deadline"] = deadline
+            return super().dispatch(xs, gen=gen)
+
+    model = _OrderModel(max_batch=4)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     backend=_RecordingBackend(model))
+    b.pause()
+    threads = [
+        threading.Thread(target=b.submit,
+                         args=(np.ones((2, 4)), t_s), kwargs={"lane": 1})
+        for t_s in (5.0, 30.0)]
+    for t in threads:
+        t.start()
+        time.sleep(0.05)
+    deadline = time.monotonic() + 5
+    while b.depth() < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    t_before = time.monotonic()
+    b.resume()
+    for t in threads:
+        t.join()
+    assert model.order == [1.0]  # ONE coalesced batch
+    # forwarded deadline ~ now + 30s (the generous member), not +5s
+    assert seen["deadline"] - t_before > 20.0
+    b.close()
+
+
+def test_queue_full_carries_drain_rate_retry_after():
+    model = _OrderModel(max_batch=2, delay_s=0.01)
+    b = MicroBatcher(model, metrics=model.registry.metrics,
+                     max_queue_rows=4)
+    # no drain observed yet: the conservative 1s default
+    assert b.retry_after_s() == 1.0
+    outs = [b.submit(np.ones((2, 4)), 10.0) for _ in range(4)]
+    assert len(outs) == 4 and b.drain_rate() > 0
+    b.pause()
+    holders = [threading.Thread(
+        target=lambda: b.submit(np.ones((2, 4)), 10.0))
+        for _ in range(2)]
+    for t in holders:
+        t.start()
+    deadline = time.monotonic() + 5
+    while b.depth() < 4 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    with pytest.raises(QueueFull) as exc_info:
+        b.submit(np.ones((2, 4)), 10.0)
+    assert 1.0 <= exc_info.value.retry_after_s <= 60.0
+    b.resume()
+    for t in holders:
+        t.join()
+    b.close()
+
+
+def test_local_backend_is_the_registry_path():
+    model = _OrderModel(max_batch=4)
+    be = LocalBackend(model)
+    assert be.pipeline_depth() == 1
+    xs = np.full((2, 4), 7.0)
+    out = be.collect(be.dispatch(xs, gen=None, trace=None))
+    np.testing.assert_array_equal(out, xs.sum(axis=1, keepdims=True))
+    assert model.order == [7.0]
+
+
+# --- in-process mesh fixtures -----------------------------------------------
+
+def _mk_worker(conf, router_port=None, **kw):
+    """A full in-process worker: ServeApp + HTTP thread (+ agent when a
+    router port is given).  Returns (app, httpd, port)."""
+    app = ServeApp(max_batch=16, max_queue_rows=512, **kw)
+    assert app.add_model(conf, warmup=False) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    port = httpd.server_address[1]
+    if router_port is not None:
+        agent = WorkerAgent(app, f"127.0.0.1:{router_port}",
+                            f"127.0.0.1:{port}", interval_s=0.3)
+        app.mesh_worker = agent
+        agent.start()
+    return app, httpd, port
+
+
+def _mk_router(conf, required=1, **kw):
+    app = ServeApp(max_batch=16, max_queue_rows=512, **kw)
+    app.enable_mesh_router(required_workers=required,
+                           health_interval_s=0.2)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    return app, httpd, httpd.server_address[1]
+
+
+def _wait_quorum(port, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status, body = serve_bench.http_json(
+            f"http://127.0.0.1:{port}/healthz")
+        if status == 200:
+            return body
+        time.sleep(0.05)
+    raise AssertionError(f"router on :{port} never reached quorum")
+
+
+def _kill_worker(httpd, app):
+    """In-process stand-in for a worker death: closing the listening
+    socket makes the router's next RPC see connection-refused, exactly
+    like a kill -9 does."""
+    httpd.shutdown()
+    httpd.server_close()
+    app.close(drain=False)
+
+
+# --- the acceptance pins ----------------------------------------------------
+
+def test_single_worker_mesh_byte_identical_to_local_fast(tmp_path):
+    """Degenerate mesh parity (acceptance): a router fronting ONE worker
+    returns BIT-identical response bodies to the single-process fast
+    tier for the same sequential requests -- strict sub-threshold
+    buckets and fast GEMM buckets both."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    tier_kw = dict(parity="fast", fast_threshold=8)
+    lapp, lhttpd, lport = _mk_worker(conf, **tier_kw)   # plain local
+    wapp, whttpd, wport = None, None, None
+    rapp = rhttpd = None
+    try:
+        rapp, rhttpd, rport = _mk_router(conf, required=1, **tier_kw)
+        wapp, whttpd, wport = _mk_worker(conf, router_port=rport,
+                                         **tier_kw)
+        _wait_quorum(rport)
+        rng = np.random.default_rng(11)
+        for rows in (1, 3, 5, 8, 11, 16):  # strict AND fast buckets
+            xs = rng.uniform(-1, 1, (rows, N_IN))
+            payload = {"inputs": xs.tolist()}
+            st_l, body_l, _ = _post_raw(
+                f"http://127.0.0.1:{lport}", "/v1/kernels/tiny/infer",
+                payload)
+            st_m, body_m, _ = _post_raw(
+                f"http://127.0.0.1:{rport}", "/v1/kernels/tiny/infer",
+                payload)
+            assert st_l == st_m == 200
+            assert body_m == body_l  # BYTES, not parsed floats
+    finally:
+        for httpd, app in ((lhttpd, lapp), (whttpd, wapp),
+                           (rhttpd, rapp)):
+            if httpd is not None:
+                httpd.shutdown()
+                app.close(drain=True)
+
+
+def test_failover_worker_loss_zero_non200(tmp_path):
+    """Two workers, one dies mid-operation: every request (including
+    the ones whose RPC was in flight on the corpse) still answers 200
+    via retry-once-elsewhere; the corpse is ejected and /healthz
+    reports it."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=2)
+    w1app, w1httpd, _ = _mk_worker(conf, router_port=rport)
+    w2app, w2httpd, _ = _mk_worker(conf, router_port=rport)
+    base = f"http://127.0.0.1:{rport}"
+    statuses = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    try:
+        _wait_quorum(rport)
+        xs = np.random.default_rng(5).uniform(-1, 1, (3, N_IN))
+
+        def hammer():
+            while not stop.is_set():
+                st, _ = serve_bench.http_json(
+                    base + "/v1/kernels/tiny/infer",
+                    {"inputs": xs.tolist(), "timeout_ms": 10000})
+                with lock:
+                    statuses.append(st)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with lock:
+                if len(statuses) >= 20:
+                    break
+            time.sleep(0.01)
+        # kill the worker CARRYING the traffic (bucket affinity pins
+        # the steady bucket to one of them; killing the idle one would
+        # prove nothing about failover)
+        tbl = rapp.mesh_router.pool.table()
+        busiest = max(tbl.values(), key=lambda w: w["routed"])
+        if busiest["addr"].endswith(f":{w1httpd.server_address[1]}"):
+            _kill_worker(w1httpd, w1app)
+            w1httpd = None
+        else:
+            _kill_worker(w2httpd, w2app)
+            w2httpd = None
+        t_kill = time.monotonic()
+        while time.monotonic() - t_kill < 10.0:
+            tbl = rapp.mesh_router.pool.table()
+            if any(w["state"] == "dead" for w in tbl.values()):
+                break
+            time.sleep(0.01)
+        time.sleep(0.5)  # keep hammering the survivor
+        stop.set()
+        for t in threads:
+            t.join()
+        assert len(statuses) >= 40
+        assert set(statuses) == {200}, (
+            f"non-200 during failover: "
+            f"{[s for s in statuses if s != 200]}")
+        assert rapp.mesh_router.pool.failovers_total >= 1
+        status, body = serve_bench.http_json(base + "/healthz")
+        states = {w["state"]
+                  for w in body["mesh"]["workers"].values()}
+        assert "dead" in states and "live" in states
+        # quorum (2) lost: the router reports warming again
+        assert status == 503 and body["status"] == "warming"
+        m = serve_bench.fetch_metrics(base)
+        assert m["mesh"]["failovers_total"] >= 1
+        assert m["requests"].get("error", 0) == 0
+    finally:
+        stop.set()
+        for httpd, app in ((w1httpd, w1app), (w2httpd, w2app),
+                           (rhttpd, rapp)):
+            if httpd is not None:
+                httpd.shutdown()
+                app.close(drain=True)
+
+
+def test_generation_coherent_reload_across_two_workers(tmp_path):
+    """Fleet-coherent hot reload (tentpole): a ckpt-manifest generation
+    bump reloads BOTH workers at one broadcast generation before the
+    router flips; pins to the old generation still serve the old
+    weights through the mesh, unknown pins 404."""
+    conf, _, kpath = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=2)
+    w1app, w1httpd, _ = _mk_worker(conf, router_port=rport)
+    w2app, w2httpd, _ = _mk_worker(conf, router_port=rport)
+    base = f"http://127.0.0.1:{rport}"
+    try:
+        _wait_quorum(rport)
+        xs = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+        st, before = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()})
+        assert st == 200 and before["generation"] == 1
+
+        # new weights + a hand-rolled manifest generation bump (the
+        # ckpt watcher's poll input)
+        from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+        from hpnn_tpu.models.kernel import generate_kernel
+
+        k2, _ = generate_kernel(4321, N_IN, [N_HID], N_OUT)
+        ckdir = tmp_path / "ck"
+        ckdir.mkdir()
+        dump_kernel_to_path(k2, str(ckdir / "kernel.opt"))
+        (ckdir / "manifest.json").write_text(json.dumps(
+            {"generation": 1, "kernel": "kernel.opt"}))
+        state = {"gen": 0}
+        result = rapp.poll_ckpt_reload("tiny", str(ckdir), state)
+        assert result is not None and result["generation"] == 2
+        assert sorted(result["mesh"]["workers_reloaded"]) == sorted(
+            w.wid for w in rapp.mesh_router.pool.workers())
+        assert result["mesh"]["workers_failed"] == []
+        # every host landed the SAME generation number
+        assert rapp.registry.get("tiny").generation == 2
+        assert w1app.registry.get("tiny").generation == 2
+        assert w2app.registry.get("tiny").generation == 2
+
+        st, after = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()})
+        assert st == 200 and after["generation"] == 2
+        assert after["outputs"] != before["outputs"]
+        # pin the PREVIOUS generation through the mesh: the workers
+        # retain it (WorkerAgent flips retain_generations on)
+        st, pinned = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()},
+            headers={"X-HPNN-Generation": "1"})
+        assert st == 200 and pinned["generation"] == 1
+        assert pinned["outputs"] == before["outputs"]
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()},
+            headers={"X-HPNN-Generation": "9"})
+        assert st == 404 and body["reason"] == "unknown_generation"
+        # idempotent re-poll: generation already consumed
+        assert rapp.poll_ckpt_reload("tiny", str(ckdir), state) is None
+        # a reload request with an unloadable path is rejected at the
+        # ROUTER (409) before any broadcast: the fleet stays live and
+        # at its generation -- a bad request must not eject workers
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/reload",
+            {"kernel": str(tmp_path / "missing.opt")})
+        assert st == 409 and body["reason"] == "reload_failed"
+        assert rapp.mesh_router.pool.live_count() == 2
+        st, after2 = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()})
+        assert st == 200 and after2["generation"] == 2
+    finally:
+        for httpd, app in ((w1httpd, w1app), (w2httpd, w2app),
+                           (rhttpd, rapp)):
+            httpd.shutdown()
+            app.close(drain=True)
+
+
+def test_late_worker_catches_up_via_heartbeat(tmp_path):
+    """A worker that registers AFTER a fleet reload (restart, partition
+    heal) pulls itself up to the router's generation on its first
+    heartbeat ack -- no operator action."""
+    conf, _, kpath = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=1)
+    w1app, w1httpd, _ = _mk_worker(conf, router_port=rport)
+    w2app = w2httpd = None
+    try:
+        _wait_quorum(rport)
+        from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+        from hpnn_tpu.models.kernel import generate_kernel
+
+        k2, _ = generate_kernel(999, N_IN, [N_HID], N_OUT)
+        dump_kernel_to_path(k2, kpath)
+        result = rapp.reload_model("tiny")  # coherent: worker1 + router
+        assert result["generation"] == 2
+        assert w1app.registry.get("tiny").generation == 2
+        # the late joiner starts at generation 1...
+        w2app, w2httpd, w2port = _mk_worker(conf)
+        assert w2app.registry.get("tiny").generation == 1
+        agent = WorkerAgent(w2app, f"127.0.0.1:{rport}",
+                            f"127.0.0.1:{w2port}", interval_s=0.3)
+        assert agent.beat()
+        # ...and lands on the fleet generation after ONE beat
+        assert w2app.registry.get("tiny").generation == 2
+    finally:
+        for httpd, app in ((w1httpd, w1app), (w2httpd, w2app),
+                           (rhttpd, rapp)):
+            if httpd is not None:
+                httpd.shutdown()
+                app.close(drain=True)
+
+
+def test_router_healthz_quorum_and_worker_info(tmp_path):
+    """Satellite: a warming mesh router reports per-worker readiness --
+    warming until the quorum is live, ok after, per-worker states in
+    the body either way."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=2)
+    base = f"http://127.0.0.1:{rport}"
+    apps = []
+    try:
+        status, body = serve_bench.http_json(base + "/healthz")
+        assert status == 503 and body["status"] == "warming"
+        assert body["mesh"] == {"role": "router", "required": 2,
+                                "live": 0, "quorum": False,
+                                "workers": {}}
+        apps.append(_mk_worker(conf, router_port=rport))
+        time.sleep(0.5)
+        status, body = serve_bench.http_json(base + "/healthz")
+        assert status == 503 and body["status"] == "warming"
+        assert body["mesh"]["live"] == 1  # progress is visible
+        apps.append(_mk_worker(conf, router_port=rport))
+        body = _wait_quorum(rport)
+        assert body["mesh"]["quorum"] is True
+        assert all(w["state"] == "live"
+                   for w in body["mesh"]["workers"].values())
+        # the worker's own healthz names its role + router
+        wport = apps[0][2]
+        status, wbody = serve_bench.http_json(
+            f"http://127.0.0.1:{wport}/healthz")
+        assert status == 200
+        assert wbody["mesh"]["role"] == "worker"
+        assert wbody["mesh"]["registered"] is True
+        # the router's worker table endpoint
+        status, tbl = serve_bench.http_json(base + "/v1/mesh/workers")
+        assert status == 200 and len(tbl["workers"]) == 2
+    finally:
+        for app, httpd, _port in apps:
+            httpd.shutdown()
+            app.close(drain=True)
+        rhttpd.shutdown()
+        rapp.close(drain=True)
+
+
+def test_mesh_register_auth_guarded(tmp_path):
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=1,
+                                     auth_token="sesame")
+    base = f"http://127.0.0.1:{rport}"
+    try:
+        status, body = serve_bench.http_json(
+            base + "/v1/mesh/register", {"addr": "127.0.0.1:1"})
+        assert status == 401
+        status, body = serve_bench.http_json(
+            base + "/v1/mesh/register", {"addr": "127.0.0.1:1"},
+            headers={"Authorization": "Bearer sesame"})
+        assert status == 200 and body["ok"] is True
+        # a port-less addr would ValueError inside every later RPC and
+        # the health loop: rejected at the boundary instead
+        status, body = serve_bench.http_json(
+            base + "/v1/mesh/register", {"addr": "myhost"},
+            headers={"Authorization": "Bearer sesame"})
+        assert status == 400 and "HOST:PORT" in body["error"]
+        # a non-router server refuses registrations outright
+        lapp = ServeApp(max_batch=8)
+        assert lapp.add_model(conf, warmup=False, name="l")
+        lhttpd, _ = serve_in_thread("127.0.0.1", 0, lapp)
+        status, body = serve_bench.http_json(
+            "http://127.0.0.1:%d/v1/mesh/register"
+            % lhttpd.server_address[1], {"addr": "127.0.0.1:1"})
+        assert status == 503 and body["reason"] == "mesh_disabled"
+        lhttpd.shutdown()
+        lapp.close()
+    finally:
+        rhttpd.shutdown()
+        rapp.close(drain=True)
+
+
+# --- QoS over HTTP ----------------------------------------------------------
+
+def test_deadline_header_end_to_end(tmp_path):
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    app, httpd, port = _mk_worker(conf)
+    base = f"http://127.0.0.1:{port}"
+    xs = np.zeros((1, N_IN))
+    try:
+        # already expired at admission: 504 without queueing
+        st, body, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                                {"inputs": xs.tolist()},
+                                headers={"X-HPNN-Deadline-Ms": "-10"})
+        assert st == 504 and json.loads(body)["reason"] == "deadline"
+        # expires while the queue is held: 504 at dispatch, no compute
+        app.batchers["tiny"].pause()
+        st, body, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                                {"inputs": xs.tolist()},
+                                headers={"X-HPNN-Deadline-Ms": "80"})
+        assert st == 504
+        app.batchers["tiny"].resume()
+        # header wins over a generous body timeout_ms
+        app.batchers["tiny"].pause()
+        st, body, _ = _post_raw(
+            base, "/v1/kernels/tiny/infer",
+            {"inputs": xs.tolist(), "timeout_ms": 60000},
+            headers={"X-HPNN-Deadline-Ms": "80"})
+        assert st == 504
+        app.batchers["tiny"].resume()
+        # malformed: 400, not silently defaulted
+        st, body, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                                {"inputs": xs.tolist()},
+                                headers={"X-HPNN-Deadline-Ms": "soon"})
+        assert st == 400
+        st, body, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                                {"inputs": xs.tolist()},
+                                headers={"X-HPNN-Priority": "urgent"})
+        assert st == 400
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_quota_token_bucket_over_http(tmp_path):
+    """Per-client quotas: a client burning its bucket gets 429
+    quota_exceeded with a refill-derived Retry-After; distinct clients
+    have distinct buckets; the outcome is counted in /metrics."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    app, httpd, port = _mk_worker(conf, quota_rows=10.0, quota_burst=6.0)
+    base = f"http://127.0.0.1:{port}"
+    xs = np.zeros((3, N_IN))
+    try:
+        hdr_a = {"X-HPNN-Client": "alice"}
+        st, _, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                             {"inputs": xs.tolist()}, headers=hdr_a)
+        assert st == 200
+        st, _, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                             {"inputs": xs.tolist()}, headers=hdr_a)
+        assert st == 200  # burst of 6 rows spent
+        st, body, hdrs = _post_raw(base, "/v1/kernels/tiny/infer",
+                                   {"inputs": xs.tolist()},
+                                   headers=hdr_a)
+        assert st == 429
+        assert json.loads(body)["reason"] == "quota_exceeded"
+        assert int(hdrs["Retry-After"]) >= 1
+        # bob is a different bucket: admitted
+        st, _, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                             {"inputs": xs.tolist()},
+                             headers={"X-HPNN-Client": "bob"})
+        assert st == 200
+        # queue-full 429s REFUND the quota charge: carol's retries
+        # against a held queue must not burn her bucket
+        batcher = app.batchers["tiny"]
+        batcher.max_queue_rows = 2
+        batcher.pause()
+        hdr_c = {"X-HPNN-Client": "carol"}
+        holder = threading.Thread(
+            target=lambda: _post_raw(
+                base, "/v1/kernels/tiny/infer",
+                {"inputs": xs.tolist()[:2], "timeout_ms": 20000},
+                headers=hdr_c))
+        holder.start()
+        deadline = time.monotonic() + 5
+        while batcher.depth() < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        for _ in range(4):  # 4x3 rows > the 6-row burst if not refunded
+            st, body, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                                    {"inputs": xs.tolist()},
+                                    headers=hdr_c)
+            assert st == 429
+            assert json.loads(body)["reason"] == "queue_full"
+        batcher.resume()
+        holder.join()
+        batcher.max_queue_rows = 512
+        st, _, _ = _post_raw(base, "/v1/kernels/tiny/infer",
+                             {"inputs": xs.tolist()}, headers=hdr_c)
+        assert st == 200  # quota intact after the refunded 429s
+        m = serve_bench.fetch_metrics(base)
+        assert m["requests"]["quota_exceeded"] == 1
+        assert m["quota"]["clients"] == 3  # alice, bob, carol
+        import urllib.request
+
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            prom = resp.read().decode()
+        assert 'hpnn_serve_requests_total{outcome="quota_exceeded"} 1' \
+            in prom
+        assert "hpnn_serve_quota_clients 3" in prom
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_lane_and_autoscale_metrics(tmp_path):
+    """/metrics gains per-lane queue depth and the desired-worker
+    gauge; a held queue with backlog asks for more workers, an idle one
+    falls back to 1."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    app, httpd, port = _mk_worker(conf)
+    base = f"http://127.0.0.1:{port}"
+    try:
+        b = app.batchers["tiny"]
+        # drain rate needs at least one completed batch
+        serve_bench.http_json(base + "/v1/kernels/tiny/infer",
+                              {"inputs": np.zeros((2, N_IN)).tolist()})
+        serve_bench.http_json(base + "/v1/kernels/tiny/infer",
+                              {"inputs": np.zeros((2, N_IN)).tolist()})
+        b.pause()
+        done = []
+        threads = [threading.Thread(target=lambda lane=lane: done.append(
+            serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer",
+                {"inputs": np.zeros((4, N_IN)).tolist(),
+                 "timeout_ms": 30000},
+                headers={"X-HPNN-Priority": lane})))
+            for lane in ("high", "low", "low")]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 5
+        while b.depth() < 12 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        m = serve_bench.fetch_metrics(base)
+        assert m["lanes"]["tiny"] == {"high": 4, "normal": 0, "low": 8}
+        assert m["autoscale"]["queued_rows"] == 12
+        assert m["autoscale"]["desired_workers"] >= 1
+        import urllib.request
+
+        with urllib.request.urlopen(base + "/metrics") as resp:
+            prom = resp.read().decode()
+        assert ('hpnn_serve_lane_depth{kernel="tiny",lane="high"} 4'
+                in prom)
+        assert "hpnn_serve_desired_workers" in prom
+        assert "hpnn_serve_drain_rows_per_sec" in prom
+        b.resume()
+        for t in threads:
+            t.join()
+        m = serve_bench.fetch_metrics(base)
+        assert m["autoscale"]["queued_rows"] == 0
+        assert m["autoscale"]["desired_workers"] == 1
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_trace_spans_cross_the_mesh_hop(tmp_path):
+    """PR 8 integration: one traced request through the router yields
+    route AND worker-side device spans under the SAME trace id (the
+    in-process apps share the process-global flight recorder)."""
+    from hpnn_tpu.obs import trace as obs_trace
+
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rapp = wapp = None
+    try:
+        obs_trace.enable()
+        rapp, rhttpd, rport = _mk_router(conf, required=1)
+        wapp, whttpd, _wp = _mk_worker(conf, router_port=rport)
+        _wait_quorum(rport)
+        xs = np.zeros((2, N_IN))
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/infer",
+            {"inputs": xs.tolist()},
+            headers={"X-HPNN-Trace-Id": "meshtrace01"})
+        assert st == 200 and body["trace"] == "meshtrace01"
+        spans = obs_trace.snapshot(trace_id="meshtrace01")
+        names = {s["name"] for s in spans}
+        # router side: root + queue + the hop; worker side: its own
+        # root + device launch, all one correlated tree
+        assert {"serve.request", "queue_wait", "mesh.route",
+                "device_launch"} <= names
+        route = [s for s in spans if s["name"] == "mesh.route"]
+        assert route and route[0]["retried"] == 0
+        rhttpd.shutdown()
+        whttpd.shutdown()
+    finally:
+        obs_trace.disable()
+        if rapp is not None:
+            rapp.close(drain=True)
+        if wapp is not None:
+            wapp.close(drain=True)
+
+
+def test_serve_nn_worker_requires_router(tmp_path, capsys):
+    from hpnn_tpu import cli
+
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rc = cli.serve_nn_main(["--mesh-role", "worker", conf])
+    assert rc == -1
+    assert "--router" in capsys.readouterr().err
+
+
+# --- heavy e2e: real subprocess workers, real kill -9 -----------------------
+
+@pytest.mark.slow
+def test_kill9_failover_e2e_subprocess(tmp_path):
+    """The acceptance failover pin with REAL process death: two
+    serve_nn worker subprocesses behind an in-process router, kill -9
+    one mid-load, ZERO non-200 responses beyond the in-flight retry
+    window (the retries themselves answer 200)."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=2)
+    base = f"http://127.0.0.1:{rport}"
+    procs = []
+    statuses = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    try:
+        for _ in range(2):
+            procs.append(mesh_bench.spawn_worker(
+                conf, f"127.0.0.1:{rport}"))
+        _wait_quorum(rport, timeout_s=120.0)
+        xs = np.random.default_rng(3).uniform(-1, 1, (3, N_IN))
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    st, _ = serve_bench.http_json(
+                        base + "/v1/kernels/tiny/infer",
+                        {"inputs": xs.tolist(), "timeout_ms": 15000},
+                        timeout_s=20.0)
+                except Exception:
+                    st = -1
+                with lock:
+                    statuses.append(st)
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            with lock:
+                if len(statuses) >= 30:
+                    break
+            time.sleep(0.05)
+        # kill the worker that actually carries traffic
+        tbl = rapp.mesh_router.pool.table()
+        busiest = max(tbl.values(), key=lambda w: w["routed"])
+        victim = next(p for p, port in procs
+                      if busiest["addr"].endswith(f":{port}"))
+        victim.send_signal(signal.SIGKILL)
+        t_kill = time.monotonic()
+        while time.monotonic() - t_kill < 15.0:
+            if any(w["state"] == "dead"
+                   for w in rapp.mesh_router.pool.table().values()):
+                break
+            time.sleep(0.02)
+        time.sleep(1.0)  # sustained load on the survivor
+        stop.set()
+        for t in threads:
+            t.join()
+        assert len(statuses) >= 50
+        bad = [s for s in statuses if s != 200]
+        assert bad == [], f"non-200 after kill -9: {bad}"
+        assert rapp.mesh_router.pool.failovers_total >= 1
+    finally:
+        stop.set()
+        for proc, _port in procs:
+            if proc.poll() is None:
+                proc.kill()
+        rhttpd.shutdown()
+        rapp.close(drain=True)

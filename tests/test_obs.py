@@ -446,18 +446,21 @@ def test_elapsed_time_is_monotonic_in_serve_jobs_ckpt():
     offenders = []
     for sub in ("serve", "jobs", "ckpt", "obs"):
         root = os.path.join(REPO, "hpnn_tpu", sub)
-        for fname in sorted(os.listdir(root)):
-            if not fname.endswith(".py"):
-                continue
-            path = os.path.join(root, fname)
-            with open(path) as fp:
-                for lineno, line in enumerate(fp, 1):
-                    if "time.time()" not in line:
-                        continue
-                    if _WALL_CLOCK_ALLOWED.search(line):
-                        continue
-                    offenders.append(f"{sub}/{fname}:{lineno}: "
-                                     f"{line.strip()}")
+        # recursive: subpackages (serve/mesh) are held to the same rule
+        for dirpath, _dirs, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                if not fname.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fname)
+                rel = os.path.relpath(path, os.path.join(REPO, "hpnn_tpu"))
+                with open(path) as fp:
+                    for lineno, line in enumerate(fp, 1):
+                        if "time.time()" not in line:
+                            continue
+                        if _WALL_CLOCK_ALLOWED.search(line):
+                            continue
+                        offenders.append(f"{rel}:{lineno}: "
+                                         f"{line.strip()}")
     assert offenders == [], (
         "wall-clock time.time() outside the persisted-timestamp "
         "allowlist (use time.monotonic() for elapsed intervals):\n"

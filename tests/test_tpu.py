@@ -206,11 +206,22 @@ CHECKS = ("backend", "dispatch", "fused_kernels", "convergence",
 
 
 def test_on_chip_suite():
-    """All on-chip checks in one subprocess (one backend init)."""
+    """All on-chip checks in one subprocess (one backend init).
+
+    The probe timeout is tiered by the environment's own claim: a host
+    that ADVERTISES a TPU gets the full 900 s (and a loud failure, never
+    a skip).  A host with no TPU signal can only ever end in a skip --
+    but the PJRT TPU plugin spends many minutes retrying its tunnel
+    before giving up, so waiting the full window just delays that
+    inevitable skip (~460 s of the tier-1 wall budget on TPU-less CI
+    hosts).  180 s is still enough for an UNADVERTISED real chip to
+    init and be detected; past that, the documented no-signal skip
+    applies either way."""
     try:
         r = subprocess.run(
             [sys.executable, "-c", textwrap.dedent(ON_CHIP_SUITE)],
-            capture_output=True, text=True, timeout=900,
+            capture_output=True, text=True,
+            timeout=900 if _tpu_expected() else 180,
             env=_clean_env(), cwd=REPO)
     except subprocess.TimeoutExpired as exc:
         if _tpu_expected():
