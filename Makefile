@@ -23,7 +23,7 @@ SERVE_TESTS = tests/test_serve.py
 SERVE_MESH_TESTS = tests/test_mesh.py
 CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
-OBS_TESTS = tests/test_obs.py
+OBS_TESTS = tests/test_obs.py tests/test_fleet_obs.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
@@ -51,12 +51,16 @@ mesh-check:
 ckpt-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(CKPT_TESTS) -q
 
-# observability tier (ISSUE 8): span/recorder units, LatencyHistogram
-# edge cases, the Prometheus exposition-format lint, healthz fields,
-# the monotonic-clock audit, nn_log JSON mode, train-parity with
-# tracing on, and the live-server trace e2e (slow-marked: a training
-# job under eval traffic must yield one correlated span tree per
-# trace id in the /v1/debug/trace dump)
+# observability tier (ISSUE 8 + 10): span/recorder units,
+# LatencyHistogram edge cases, the Prometheus exposition-format lint
+# (incl. the FEDERATED ?fleet=1 text with hostile kernel names + a
+# dead-worker gap), healthz fields, the monotonic-clock audit, nn_log
+# JSON mode, train-parity with tracing on, since_seq paging, the fleet
+# trace collector (cursors, restart rewind, dead-worker retention),
+# SLO burn semantics, and the slow-marked e2es: the trace-under-job
+# acceptance and the 2-subprocess-worker merged-cross-host-tree pin
+# (complete route -> worker -> device tree from ONE router GET, incl.
+# after a SIGKILL)
 obs-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(OBS_TESTS) -q
 
@@ -137,6 +141,15 @@ mesh-bench:
 	python scripts/mesh_bench.py --out MESH_BENCH.json \
 	    $(if $(REAL),--real)
 
+# fleet observability overhead (ISSUE 10): the same 2-worker mesh load
+# with tracing + metrics federation OFF vs ON (collector draining +
+# federated scrapes under fire), overhead ceiling asserted, merged
+# cross-host tree verified live; emits OBS_BENCH.json, rc!=0 when a
+# floor misses.  `make obs-bench REAL=1` keeps the ambient platform
+obs-bench:
+	python scripts/obs_bench.py --out OBS_BENCH.json \
+	    $(if $(REAL),--real)
+
 .PHONY: check check-all serve-check mesh-check ckpt-check ckpt-bench \
-    jobs-check jobs-bench obs-check native bench serve-bench io-bench \
-    epoch-bench mfu-bench mesh-bench
+    jobs-check jobs-bench obs-check obs-bench native bench serve-bench \
+    io-bench epoch-bench mfu-bench mesh-bench

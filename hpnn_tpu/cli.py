@@ -586,6 +586,21 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     metavar="N",
                     help="quota bucket burst capacity in rows "
                     "(default: max(2 x rate, 64))")
+    ap.add_argument("--slo-p99-ms", type=float, default=None,
+                    metavar="F",
+                    help="latency SLO: at most 1%% of completed "
+                    "requests may exceed F ms.  Enables per-kernel "
+                    "error-budget burn-rate gauges in /metrics and a "
+                    "structured slo_burn event when the fast AND slow "
+                    "windows (HPNN_SLO_FAST_S/HPNN_SLO_SLOW_S) both "
+                    "burn past HPNN_SLO_BURN (default 14.4).  Unset: "
+                    "no SLO tracking, zero cost")
+    ap.add_argument("--slo-availability", type=float, default=None,
+                    metavar="F",
+                    help="availability SLO target in [0, 1) (e.g. "
+                    "0.999): server-caused failures (HTTP >= 500) "
+                    "spend the 1-F error budget; same burn-rate "
+                    "gauges/alerts as --slo-p99-ms")
     args = ap.parse_args(argv)
 
     from .serve.server import ServeApp, make_server
@@ -611,8 +626,25 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                          "HOST:PORT (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    if args.slo_availability is not None \
+            and not 0.0 <= args.slo_availability < 1.0:
+        sys.stderr.write(f"--slo-availability must be in [0, 1): "
+                         f"{args.slo_availability} (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    if args.slo_p99_ms is not None and args.slo_p99_ms <= 0.0:
+        sys.stderr.write(f"--slo-p99-ms must be > 0: "
+                         f"{args.slo_p99_ms} (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
     auth_token = args.auth_token or os.environ.get("HPNN_SERVE_TOKEN") \
         or None
+    # name this process's mesh role for post-mortem dump files
+    # (trace-<reason>-<role>-<pid>.ndjson): a killed fleet's dumps must
+    # be tellable apart without opening them
+    from .obs import trace as _obs_trace
+
+    _obs_trace.set_role(args.mesh_role or "local")
     app = ServeApp(max_batch=args.max_batch,
                    max_queue_rows=args.queue_rows,
                    linger_s=args.linger_ms / 1e3,
@@ -625,7 +657,9 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                    trace=args.trace or None,
                    profile_dir=args.profile_dir,
                    quota_rows=args.quota_rows,
-                   quota_burst=args.quota_burst)
+                   quota_burst=args.quota_burst,
+                   slo_p99_ms=args.slo_p99_ms,
+                   slo_availability=args.slo_availability)
     if args.mesh_role == "router":
         # before add_model: batchers are wired to the worker pool at
         # creation.  (A router never computes locally -- add_model
@@ -720,6 +754,18 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
     # window of activity survives the process
     dump_dir = args.job_dir if args.jobs > 0 else "."
     dumped = False
+
+    def _collected_worker_spans():
+        """A router's post-mortem must carry its last collected worker
+        spans -- the remote halves of in-flight traces die with the
+        process otherwise (ISSUE 10 bugfix)."""
+        if app.mesh_router is None:
+            return None
+        try:
+            return app.mesh_router.fleet.collected_spans()
+        except Exception:  # pragma: no cover - post-mortem best effort
+            return None
+
     try:
         httpd.serve_forever()
     except KeyboardInterrupt:  # pragma: no cover - handler owns SIGINT
@@ -728,7 +774,9 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
     except Exception:
         from .obs import trace as obs_trace
 
-        path = obs_trace.dump_to_dir(dump_dir, reason="fault")
+        path = obs_trace.dump_to_dir(
+            dump_dir, reason="fault",
+            extra_spans=_collected_worker_spans())
         dumped = True  # ONE post-mortem per process, fault-tagged
         if path:
             sys.stderr.write(f"SERVE: flight recorder dumped to "
@@ -745,7 +793,9 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         if not dumped:
             from .obs import trace as obs_trace
 
-            path = obs_trace.dump_to_dir(dump_dir, reason="shutdown")
+            path = obs_trace.dump_to_dir(
+                dump_dir, reason="shutdown",
+                extra_spans=_collected_worker_spans())
             if path:
                 sys.stdout.write(f"SERVE: flight recorder dumped to "
                                  f"{path}\n")

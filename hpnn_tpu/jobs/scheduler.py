@@ -372,6 +372,18 @@ class JobScheduler:
     def list(self) -> list[dict]:
         return self.store.list()
 
+    def active(self) -> dict:
+        """The running job (id + its trace id) and the queued count --
+        what a mesh worker's heartbeat advertises so the router's
+        worker table says where a job runs and which
+        ``?trace=job:<id>`` to pull fleet-wide (ISSUE 10)."""
+        with self._mu:
+            cur = self._current.job_id if self._current is not None \
+                else None
+        return {"running": cur,
+                "trace": f"job:{cur}" if cur else None,
+                "queued": self.queue.depth()}
+
     def cancel(self, job_id: str) -> dict:
         """Cancel a queued job immediately, or latch the running job's
         stop event (the in-flight epoch finishes, a final snapshot is

@@ -21,9 +21,17 @@ Three layers, stacked so each can be used without the next:
   captures (``POST /v1/debug/profile``) and whole-run captures
   (``train_nn --profile-dir D``), so a chip-side XLA trace can be
   pulled from a running server without restarting it.
+* :mod:`.slo` -- per-kernel availability/latency objectives with
+  multi-window error-budget burn rates (ISSUE 10): ``--slo-p99-ms`` /
+  ``--slo-availability`` construct a :class:`slo.SloTracker`, /metrics
+  exports the burn gauges, and a structured ``slo_burn`` event fires
+  when the fast AND slow windows both exceed the threshold.
 
 ``HPNN_TRACE=1`` enables tracing at ``init_all`` / server start;
-``HPNN_TRACE_BUFFER=N`` sizes the ring (default 8192 spans).
+``HPNN_TRACE_BUFFER=N`` sizes the ring (default 8192 spans).  Spans
+carry a monotone ``seq`` for incremental cross-host collection
+(``/v1/debug/trace?since_seq=N``), and :func:`set_role` names the
+process's mesh role in auto-dump filenames.
 """
 
 from .trace import (  # noqa: F401
@@ -34,15 +42,22 @@ from .trace import (  # noqa: F401
     enable,
     enable_from_env,
     enabled,
+    get_role,
+    last_seq,
     new_span_id,
     new_trace_id,
     record,
+    render_ndjson,
+    ring_id,
+    set_role,
     snapshot,
     span,
 )
 
 __all__ = [
     "current_ctx", "disable", "dump_ndjson", "dump_to_dir", "enable",
-    "enable_from_env", "enabled", "new_span_id", "new_trace_id",
-    "record", "snapshot", "span",
+    "enable_from_env", "enabled", "get_role", "last_seq",
+    "new_span_id", "new_trace_id", "record", "render_ndjson",
+    "ring_id",
+    "set_role", "snapshot", "span",
 ]

@@ -30,6 +30,18 @@ passes ``trace_id``/``parent_id`` explicitly to :func:`record` with
 measured start/end times.  Trace ids are caller-meaningful strings
 (a request's ``X-HPNN-Trace-Id``, a job id); :func:`new_trace_id`
 mints a random one when the caller has none.
+
+Cross-HOST correlation (ISSUE 10): every recorded span carries a
+monotone per-process ``seq`` number, so a remote collector (the mesh
+router's fleet drain) can page the ring incrementally with
+``since_seq=N`` instead of re-shipping the whole window every poll --
+:func:`snapshot` filters on it and :func:`last_seq` is the cursor a
+scraper stores.  ``seq`` restarts when the ring is re-enabled (or the
+process restarts); collectors detect that by a ``last_seq`` smaller
+than their cursor and rewind to 0.  :func:`set_role` names this
+process's mesh role (router/worker/local): the SIGTERM/fault auto-dump
+filename includes it (``trace-<reason>-<role>-<pid>.ndjson``) so a
+killed fleet's post-mortems are attributable at a glance.
 """
 
 from __future__ import annotations
@@ -46,15 +58,25 @@ _DEFAULT_CAPACITY = 8192
 # the whole on/off switch: a _State when tracing, None when off
 _state: "_State | None" = None
 _tls = threading.local()
+# this process's mesh role ("router"/"worker"/"local"); None outside a
+# serving context -- names the auto-dump file, never the hot path
+_role: str | None = None
 
 
 class _State:
-    __slots__ = ("ring", "lock", "capacity", "wall_base", "mono_base")
+    __slots__ = ("ring", "lock", "capacity", "wall_base", "mono_base",
+                 "seq", "ring_id")
 
     def __init__(self, capacity: int):
         self.capacity = int(capacity)
         self.ring: deque[dict] = deque(maxlen=self.capacity)
         self.lock = threading.Lock()
+        self.seq = 0  # monotone span counter (the since_seq cursor)
+        # ring identity: a fresh id per enable()/process, so a remote
+        # collector can tell "this ring restarted" (cursor invalid)
+        # apart from "entries were evicted" (cursor fine) -- seq alone
+        # cannot distinguish a restart that already out-ran the cursor
+        self.ring_id = uuid.uuid4().hex[:16]
         # one wall/monotonic anchor pair per enable(): span timestamps
         # are monotonic (elapsed math must survive clock steps) and the
         # dump renders them as wall time through this anchor
@@ -96,6 +118,34 @@ def enable_from_env() -> bool:
     if os.environ.get("HPNN_TRACE", "") not in ("", "0"):
         enable()
     return enabled()
+
+
+def set_role(role: str | None) -> None:
+    """Name this process's mesh role (router/worker/local) for the
+    auto-dump filename; None restores the role-less legacy name."""
+    global _role
+    _role = role
+
+
+def get_role() -> str | None:
+    return _role
+
+
+def last_seq() -> int:
+    """The newest recorded span's ``seq`` (0 when tracing is off or
+    nothing recorded) -- what ``X-HPNN-Trace-Seq`` reports so scrapers
+    can page with ``since_seq`` and detect ring restarts."""
+    st = _state
+    return st.seq if st is not None else 0
+
+
+def ring_id() -> str:
+    """This ring's identity (fresh per enable()/process; "" when
+    tracing is off) -- ``X-HPNN-Trace-Ring`` carries it so a collector
+    invalidates its cursor on ANY restart, even one whose new seq
+    already passed the old cursor."""
+    st = _state
+    return st.ring_id if st is not None else ""
 
 
 def new_trace_id() -> str:
@@ -213,6 +263,8 @@ def _append(st: _State, name: str, trace_id: str, span_id: str,
     if attrs:
         rec.update(attrs)
     with st.lock:
+        st.seq += 1
+        rec["seq"] = st.seq
         st.ring.append(rec)
 
 
@@ -246,14 +298,18 @@ def new_span_id() -> str:
 
 
 def snapshot(trace_id: str | None = None,
-             limit: int | None = None) -> list[dict]:
+             limit: int | None = None,
+             since_seq: int | None = None) -> list[dict]:
     """Recorded spans, oldest first; ``trace_id`` filters to one trace,
-    ``limit`` keeps the newest N."""
+    ``since_seq`` keeps spans recorded after that cursor (incremental
+    paging), ``limit`` keeps the newest N."""
     st = _state
     if st is None:
         return []
     with st.lock:
         spans = list(st.ring)
+    if since_seq is not None and since_seq > 0:
+        spans = [s for s in spans if s.get("seq", 0) > since_seq]
     if trace_id is not None:
         spans = [s for s in spans if s["trace"] == trace_id]
     if limit is not None:
@@ -264,24 +320,43 @@ def snapshot(trace_id: str | None = None,
 
 
 def dump_ndjson(trace_id: str | None = None,
-                limit: int | None = None) -> str:
+                limit: int | None = None,
+                since_seq: int | None = None) -> str:
     """The flight-recorder dump: one JSON object per line (NDJSON),
     oldest span first -- what ``GET /v1/debug/trace`` serves."""
-    spans = snapshot(trace_id=trace_id, limit=limit)
+    return render_ndjson(snapshot(trace_id=trace_id, limit=limit,
+                                  since_seq=since_seq))
+
+
+def render_ndjson(spans: list[dict]) -> str:
+    """Span dicts -> NDJSON text (the same line format dump_ndjson
+    emits) -- what fleet-merged dumps render through."""
     if not spans:
         return ""
     return "\n".join(json.dumps(s, sort_keys=True) for s in spans) + "\n"
 
 
-def dump_to_dir(dirpath: str, reason: str = "dump") -> str | None:
-    """Write the recorder to ``<dirpath>/trace-<reason>-<pid>.ndjson``
-    (the SIGTERM/fault auto-dump).  Best-effort: returns the path, or
-    None when tracing is off / nothing is recorded / the write fails --
-    a dying process must not die harder because its post-mortem failed."""
-    text = dump_ndjson()
+def dump_to_dir(dirpath: str, reason: str = "dump",
+                extra_spans: list[dict] | None = None) -> str | None:
+    """Write the recorder to ``<dirpath>/trace-<reason>[-<role>]-<pid>
+    .ndjson`` (the SIGTERM/fault auto-dump; the role lands in the name
+    once :func:`set_role` ran, so a killed fleet's dumps don't all look
+    alike).  ``extra_spans`` ride along time-sorted into the same file
+    -- a mesh router passes its last collected worker spans so remote
+    halves of in-flight traces survive the process.  Best-effort:
+    returns the path, or None when tracing is off / nothing is recorded
+    / the write fails -- a dying process must not die harder because
+    its post-mortem failed."""
+    spans = snapshot()
+    if extra_spans:
+        spans = sorted(spans + list(extra_spans),
+                       key=lambda s: s.get("ts", 0.0))
+    text = render_ndjson(spans)
     if not text:
         return None
-    path = os.path.join(dirpath, f"trace-{reason}-{os.getpid()}.ndjson")
+    role = f"-{_role}" if _role else ""
+    path = os.path.join(dirpath,
+                        f"trace-{reason}{role}-{os.getpid()}.ndjson")
     try:
         os.makedirs(dirpath, exist_ok=True)
         with open(path, "w") as fp:

@@ -271,6 +271,12 @@ class MicroBatcher:
         lat = time.monotonic() - p.t_enq
         tid = trace[0] if trace else None
         self.metrics.latency.observe(lat, trace_id=tid)
+        # latency SLO (ISSUE 10): completed requests feed the latency
+        # objective with the honest whole-request wall; off = one
+        # attribute read
+        slo = self.metrics.slo
+        if slo is not None:
+            slo.record_latency(self.model.name, lat)
         if p.bucket:
             # slow-span flag: compare against this kernel+bucket's p99
             # BEFORE this observation joins the distribution (one
@@ -494,6 +500,24 @@ class MicroBatcher:
                 "cache_hit": bool(getattr(handle, "cache_hit", True)),
                 "generation": live[0].served_gen,
             }
+            # remote batches: EVERY traced member gets a mesh.route
+            # span (not just the head whose trace id rode the RPC),
+            # annotated with the worker that served it and a
+            # remote_trace link to the id the worker recorded under --
+            # the fleet merger follows it, so a coalesced batch still
+            # yields a complete route -> worker -> device tree for any
+            # member's trace id (ISSUE 10)
+            route_worker = getattr(handle, "worker_id", None)
+            route_attrs = None
+            if route_worker is not None:
+                route_attrs = {
+                    "worker": route_worker,
+                    "bucket": handle.bucket,
+                    "retried": getattr(handle, "retried", 0),
+                }
+                rpc_trace = getattr(handle, "rpc_trace", None)
+                if rpc_trace is not None:
+                    route_attrs["remote_trace"] = rpc_trace
         off = 0
         for p in live:
             p.result = outs[off:off + p.rows]
@@ -522,6 +546,10 @@ class MicroBatcher:
                                  **batch_attrs)
                 obs_trace.record("d2h", t_c0, t_c1, trace_id=tid,
                                  parent_id=root, bucket=handle.bucket)
+                if route_attrs is not None:
+                    obs_trace.record("mesh.route", t_launched, t_c1,
+                                     trace_id=tid, parent_id=root,
+                                     **route_attrs)
             # spans recorded BEFORE the wakeup: once the submitter
             # returns, this request's tree is already in the recorder
             p.event.set()

@@ -22,12 +22,22 @@ instead of reassembling activations.
   catch-up after ejection/restart.
 * :mod:`qos`      -- priority lanes, per-client token-bucket quotas,
   deadline parsing, and the desired-worker autoscaling signal.
+* :mod:`fleet`    -- fleet observability (ISSUE 10): the router's
+  incremental worker-ring trace collector (``since_seq`` paging into a
+  bounded per-worker store that survives worker death) and the metrics
+  federation client behind ``GET /metrics?fleet=1``.
+* :mod:`events`   -- ``mesh_event``: lifecycle transitions (register/
+  eject/readmit/failover/reload broadcast) as console lines, structured
+  ``nn_event`` records (``HPNN_LOG_JSON=1``) and flight-recorder spans
+  under the ``mesh`` trace id.
 
 Everything here is stdlib + numpy; jax is only ever touched by the
 workers' own registries.
 """
 
 from .backend import NoLiveWorker, RemoteBackend, RemoteHTTPError
+from .events import MESH_TRACE_ID, mesh_event
+from .fleet import FleetObserver
 from .qos import LANE_NAMES, LANES, QuotaTable, desired_workers
 from .router import MeshRouter, WorkerPool
 from .worker import WorkerAgent
@@ -36,4 +46,5 @@ __all__ = [
     "NoLiveWorker", "RemoteBackend", "RemoteHTTPError",
     "LANES", "LANE_NAMES", "QuotaTable", "desired_workers",
     "MeshRouter", "WorkerPool", "WorkerAgent",
+    "FleetObserver", "MESH_TRACE_ID", "mesh_event",
 ]

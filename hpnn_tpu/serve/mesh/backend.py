@@ -45,7 +45,6 @@ import time
 
 import numpy as np
 
-from ...obs import trace as obs_trace
 from ..batcher import DeadlineExceeded, QueueFull
 from ..registry import bucket_rows
 
@@ -125,9 +124,11 @@ class _RemoteHandle:
     cache_hit/pad_h2d_s off it for metrics + spans."""
 
     __slots__ = ("future", "rows", "bucket", "served_gen", "tier",
-                 "cache_hit", "pad_h2d_s", "worker_id", "retried")
+                 "cache_hit", "pad_h2d_s", "worker_id", "retried",
+                 "rpc_trace")
 
-    def __init__(self, future, rows: int, bucket: int):
+    def __init__(self, future, rows: int, bucket: int,
+                 rpc_trace: str | None = None):
         self.future = future
         self.rows = rows
         self.bucket = bucket
@@ -137,6 +138,11 @@ class _RemoteHandle:
         self.pad_h2d_s = 0.0
         self.worker_id = None
         self.retried = 0
+        # the trace id that rode the RPC header (the batch HEAD's): the
+        # worker recorded ITS spans under this id, so every member's
+        # mesh.route span links to it and the fleet merger can pull the
+        # remote half of a coalesced batch into any member's tree
+        self.rpc_trace = rpc_trace
 
 
 class RemoteBackend:
@@ -169,7 +175,8 @@ class RemoteBackend:
         bucket = bucket_rows(rows, self.max_batch)
         fut = self.pool.executor.submit(
             self._call, xs, gen, trace, deadline, bucket, lane)
-        return _RemoteHandle(fut, rows, bucket)
+        return _RemoteHandle(fut, rows, bucket,
+                             rpc_trace=trace[0] if trace else None)
 
     def collect(self, handle: _RemoteHandle) -> np.ndarray:
         outs, served_gen, worker_id, retried = handle.future.result()
@@ -193,7 +200,6 @@ class RemoteBackend:
         want_gen = getattr(self.model, "generation", None)
         excluded: set = set()
         last_exc: Exception | None = None
-        t_route0 = time.monotonic()
         for attempt in (0, 1):  # retry-once-elsewhere on worker loss
             try:
                 worker = self.pool.pick(self.kernel, bucket,
@@ -222,18 +228,27 @@ class RemoteBackend:
                 # the worker is gone (kill -9, network partition, hang):
                 # eject it and try the batch ONCE on another worker --
                 # inference is idempotent, so the retry is safe
+                from .events import mesh_event
+
                 self.pool.report_failure(worker, exc)
+                mesh_event("failover_retry",
+                           f"mesh: retrying batch for "
+                           f"'{self.kernel}' off {worker.addr} "
+                           f"({type(exc).__name__})\n",
+                           level="dbg", kernel=self.kernel,
+                           worker=worker.addr, bucket=bucket,
+                           attempt=attempt,
+                           error=type(exc).__name__)
                 excluded.add(worker.wid)
                 last_exc = exc
                 continue
             finally:
                 self.pool.note_done(worker)
             self.pool.report_ok(worker)
-            if trace is not None and obs_trace.enabled():
-                obs_trace.record("mesh.route", t_route0, time.monotonic(),
-                                 trace_id=trace[0], parent_id=trace[1],
-                                 worker=worker.wid, addr=worker.addr,
-                                 bucket=bucket, retried=attempt)
+            # mesh.route spans are recorded by the BATCHER at batch
+            # completion, one per traced member (not just the head) --
+            # a coalesced batch must leave a route span in EVERY
+            # member's tree (ISSUE 10)
             return self._decode(status, body, worker, attempt)
         raise NoLiveWorker(
             f"kernel '{self.kernel}': retry also failed ({last_exc})"

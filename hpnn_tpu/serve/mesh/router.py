@@ -29,12 +29,12 @@ generations under one label longer than the broadcast takes, and
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
-from ...utils.nn_log import nn_dbg, nn_out, nn_warn
+from ...utils.env import env_int as _env_int
+from ...utils.nn_log import nn_warn
 from .backend import (
     TRANSPORT_ERRORS,
     NoLiveWorker,
@@ -42,24 +42,19 @@ from .backend import (
     get_json,
     post_json,
 )
+from .events import mesh_event
 
 STATE_LIVE = "live"
 STATE_WARMING = "warming"   # registered, /healthz still 503-warming
 STATE_DEAD = "dead"
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
-
-
 class Worker:
     """One registered worker host."""
 
     __slots__ = ("wid", "addr", "state", "fails", "inflight", "routed",
-                 "failovers", "kernels", "created_at", "last_seen")
+                 "failovers", "kernels", "created_at", "last_seen",
+                 "jobs")
 
     def __init__(self, addr: str):
         self.wid = addr  # the advertised addr IS the identity
@@ -70,16 +65,20 @@ class Worker:
         self.routed = 0
         self.failovers = 0
         self.kernels: dict[str, dict] = {}
+        self.jobs: dict | None = None  # heartbeat-advertised job state
         self.created_at = time.time()  # displayed registration timestamp
         self.last_seen = time.monotonic()
 
     def to_dict(self) -> dict:
-        return {"addr": self.addr, "state": self.state,
-                "consecutive_failures": self.fails,
-                "inflight": self.inflight, "routed": self.routed,
-                "failovers": self.failovers,
-                "registered_at": round(self.created_at, 3),
-                "kernels": {n: dict(v) for n, v in self.kernels.items()}}
+        d = {"addr": self.addr, "state": self.state,
+             "consecutive_failures": self.fails,
+             "inflight": self.inflight, "routed": self.routed,
+             "failovers": self.failovers,
+             "registered_at": round(self.created_at, 3),
+             "kernels": {n: dict(v) for n, v in self.kernels.items()}}
+        if self.jobs is not None:
+            d["jobs"] = dict(self.jobs)
+        return d
 
 
 class WorkerPool:
@@ -106,7 +105,8 @@ class WorkerPool:
         self._health_thread: threading.Thread | None = None
 
     # --- membership ------------------------------------------------------
-    def register(self, addr: str, kernels: dict | None = None) -> Worker:
+    def register(self, addr: str, kernels: dict | None = None,
+                 jobs: dict | None = None) -> Worker:
         """Create or refresh a worker entry (registration doubles as the
         heartbeat).  A re-registering dead worker is readmitted -- the
         process restarted or the partition healed.  A WARMING worker
@@ -118,10 +118,14 @@ class WorkerPool:
             w = self._workers.get(addr)
             if w is None:
                 w = self._workers[addr] = Worker(addr)
-                nn_out(f"mesh: worker {addr} registered\n")
+                mesh_event("worker_registered",
+                           f"mesh: worker {addr} registered\n",
+                           worker=addr)
             elif w.state == STATE_DEAD:
-                nn_out(f"mesh: worker {addr} readmitted "
-                       "(re-registration)\n")
+                mesh_event("worker_readmitted",
+                           f"mesh: worker {addr} readmitted "
+                           "(re-registration)\n",
+                           worker=addr, via="re-registration")
             if w.state != STATE_WARMING:
                 w.state = STATE_LIVE
             w.fails = 0
@@ -129,6 +133,8 @@ class WorkerPool:
             if kernels:
                 w.kernels = {str(k): dict(v) for k, v in kernels.items()
                              if isinstance(v, dict)}
+            if jobs is not None and isinstance(jobs, dict):
+                w.jobs = jobs
             return w
 
     def workers(self) -> list[Worker]:
@@ -198,8 +204,12 @@ class WorkerPool:
             worker.failovers += 1
             if worker.state != STATE_DEAD:
                 worker.state = STATE_DEAD
-                nn_warn(f"mesh: worker {worker.addr} ejected "
-                        f"({type(exc).__name__}: {exc})\n")
+                mesh_event("worker_ejected",
+                           f"mesh: worker {worker.addr} ejected "
+                           f"({type(exc).__name__}: {exc})\n",
+                           level="warn", worker=worker.addr,
+                           via="dispatch",
+                           error=f"{type(exc).__name__}: {exc}")
 
     def report_ok(self, worker: Worker) -> None:
         """A successful dispatch or an ok /healthz poll: THE promotion
@@ -211,7 +221,9 @@ class WorkerPool:
             worker.last_seen = time.monotonic()
             if worker.state == STATE_DEAD:
                 worker.state = STATE_LIVE
-                nn_out(f"mesh: worker {worker.addr} readmitted\n")
+                mesh_event("worker_readmitted",
+                           f"mesh: worker {worker.addr} readmitted\n",
+                           worker=worker.addr, via="health")
             elif worker.state == STATE_WARMING:
                 worker.state = STATE_LIVE
 
@@ -227,8 +239,12 @@ class WorkerPool:
                     if (w.state != STATE_DEAD
                             and w.fails >= self.eject_after):
                         w.state = STATE_DEAD
-                        nn_warn(f"mesh: worker {w.addr} ejected "
-                                f"(health: {type(exc).__name__})\n")
+                        mesh_event(
+                            "worker_ejected",
+                            f"mesh: worker {w.addr} ejected "
+                            f"(health: {type(exc).__name__})\n",
+                            level="warn", worker=w.addr, via="health",
+                            error=type(exc).__name__)
                 continue
             if status == 200 and body.get("status") == "ok":
                 self.report_ok(w)
@@ -246,9 +262,13 @@ class WorkerPool:
                     if (w.state != STATE_DEAD
                             and w.fails >= self.eject_after):
                         w.state = STATE_DEAD
-                        nn_warn(f"mesh: worker {w.addr} ejected "
-                                f"(health: {status} "
-                                f"{body.get('status')})\n")
+                        mesh_event(
+                            "worker_ejected",
+                            f"mesh: worker {w.addr} ejected "
+                            f"(health: {status} "
+                            f"{body.get('status')})\n",
+                            level="warn", worker=w.addr, via="health",
+                            error=f"{status} {body.get('status')}")
 
     def start_health_loop(self, interval_s: float) -> None:
         def loop():
@@ -278,10 +298,17 @@ class MeshRouter:
 
     def __init__(self, app, required: int = 1,
                  health_interval_s: float = 1.0):
+        from .fleet import FleetObserver
+
         self.app = app
         self.required = max(1, int(required))
         self.pool = WorkerPool(auth_token=app.auth_token)
         self.pool.start_health_loop(health_interval_s)
+        # fleet observability (ISSUE 10): incremental worker-ring
+        # collection + metrics federation; idle when tracing is off on
+        # the workers and nothing scrapes ?fleet=1
+        self.fleet = FleetObserver(
+            self.pool, auth_token=app.auth_token).start()
         # serializes whole fleet reloads: the --watch-ckpt watcher
         # racing a manual POST must not broadcast two different weight
         # files under one target generation
@@ -291,11 +318,13 @@ class MeshRouter:
         return RemoteBackend(self.pool, model)
 
     def close(self) -> None:
+        self.fleet.close()
         self.pool.close()
 
     # --- registration (POST /v1/mesh/register) ---------------------------
-    def register_worker(self, addr: str, kernels: dict | None) -> dict:
-        self.pool.register(addr, kernels)
+    def register_worker(self, addr: str, kernels: dict | None,
+                        jobs: dict | None = None) -> dict:
+        self.pool.register(addr, kernels, jobs=jobs)
         # the ack tells the worker where the fleet SHOULD be: current
         # generation + weights source per kernel, so an ejected/late
         # worker catches itself up before taking traffic again
@@ -381,8 +410,12 @@ class MeshRouter:
             w.kernels.setdefault(name, {})["generation"] = \
                 body.get("generation", target)
             ok_workers.append(w.wid)
-        nn_dbg(f"mesh: broadcast reload '{name}' gen {target}: "
-               f"{len(ok_workers)} ok, {len(failed)} failed\n")
+        mesh_event("reload_broadcast",
+                   f"mesh: broadcast reload '{name}' gen {target}: "
+                   f"{len(ok_workers)} ok, {len(failed)} failed\n",
+                   level="dbg", kernel=name, generation=target,
+                   workers_ok=len(ok_workers),
+                   workers_failed=len(failed))
         result = self.app.reload_model(name, src, set_generation=target,
                                       broadcast=False)
         result["mesh"] = {"target_generation": target,
@@ -400,4 +433,5 @@ class MeshRouter:
                 "live": by_state.get(STATE_LIVE, 0),
                 "workers_by_state": by_state,
                 "failovers_total": self.pool.failovers_total,
-                "workers": table}
+                "workers": table,
+                "fleet_collector": self.fleet.stats()}

@@ -26,8 +26,9 @@ import os
 import threading
 import time
 
-from ...utils.nn_log import nn_dbg, nn_warn
+from ...utils.nn_log import nn_warn
 from .backend import TRANSPORT_ERRORS, post_json
+from .events import mesh_event
 
 
 def _heartbeat_s(default: float = 2.0) -> float:
@@ -71,11 +72,17 @@ class WorkerAgent:
         headers = {}
         if self.app.auth_token:
             headers["Authorization"] = f"Bearer {self.app.auth_token}"
+        payload = {"addr": self.advertise, "kernels": kernels}
+        if self.app.jobs is not None:
+            # fleet-wide job visibility (ISSUE 10): the router's worker
+            # table names the running job + its trace id, so
+            # `?trace=job:<id>` on the router finds the right worker's
+            # spans without asking every host
+            payload["jobs"] = self.app.jobs.active()
         try:
             status, ack, _ = post_json(
                 self.router_addr, "/v1/mesh/register",
-                {"addr": self.advertise, "kernels": kernels},
-                timeout_s=5.0, headers=headers)
+                payload, timeout_s=5.0, headers=headers)
         except TRANSPORT_ERRORS as exc:
             if not self._warned:
                 # once, not every 2s: the router may simply start later
@@ -115,8 +122,11 @@ class WorkerAgent:
                 continue
             try:
                 self.app.reload_model(name, src, set_generation=want)
-                nn_dbg(f"mesh: caught '{name}' up to generation "
-                       f"{want} from {src}\n")
+                mesh_event("worker_catch_up",
+                           f"mesh: caught '{name}' up to generation "
+                           f"{want} from {src}\n",
+                           level="dbg", kernel=name, generation=want,
+                           worker=self.advertise)
             except (ValueError, KeyError) as exc:
                 nn_warn(f"mesh: catch-up reload of '{name}' failed: "
                         f"{exc}\n")

@@ -130,17 +130,76 @@ class LatencyHistogram:
     def snapshot(self) -> dict:
         with self._lock:
             n, s = self._n, self._sum
+            # sparse bucket counts ride the JSON snapshot so a remote
+            # reader (metrics federation) can MERGE distributions and
+            # compute honest fleet quantiles -- a handful of entries in
+            # practice (requests cluster in a few latency buckets)
+            counts = {str(i): c for i, c in enumerate(self._counts) if c}
         out = {
             "count": n,
             "sum_seconds": round(s, 6),
             "mean_ms": round(s / n * 1e3, 3) if n else 0.0,
             "p50_ms": round(self.percentile(50) * 1e3, 3),
             "p99_ms": round(self.percentile(99) * 1e3, 3),
+            "counts": counts,
         }
         ex = self.exemplar()
         if ex is not None:
             out["exemplar"] = ex
         return out
+
+    @staticmethod
+    def percentile_from_counts(counts: dict, n: int, p: float) -> float:
+        """Percentile (seconds) from a sparse ``{bucket_index: count}``
+        map -- the same upper-edge estimate :meth:`percentile` uses,
+        computable from merged snapshots."""
+        if n <= 0:
+            return 0.0
+        by_idx = {int(k): int(v) for k, v in counts.items()}
+        covered = sum(by_idx.values())
+        if covered <= 0:
+            # observations but no bucket detail (a snapshot from a
+            # pre-'counts' worker mid-upgrade): unknown must read as
+            # 0, not as the overflow bucket's sentinel latency
+            return 0.0
+        # rank against the observations we actually have buckets for:
+        # with a PARTIAL detail set (one mixed-version worker) this is
+        # the honest quantile of the known subset, and the loop always
+        # terminates inside the buckets instead of falling through to
+        # the overflow sentinel
+        rank = p / 100.0 * min(n, covered)
+        seen = 0
+        for i in sorted(by_idx):
+            seen += by_idx[i]
+            if seen >= rank:
+                return LatencyHistogram._upper_bound(i)
+        return LatencyHistogram._upper_bound(_N_BUCKETS)
+
+    @classmethod
+    def merge_snapshots(cls, snaps) -> dict:
+        """Merge histogram snapshots (the federation rollup): counts
+        and sums add, quantiles recompute from the merged buckets --
+        so the fleet p99 is a real quantile of the union, not an
+        average of per-worker quantiles."""
+        counts: dict[str, int] = {}
+        n, total = 0, 0.0
+        for sn in snaps:
+            if not sn:
+                continue
+            n += int(sn.get("count", 0))
+            total += float(sn.get("sum_seconds", 0.0))
+            for k, c in (sn.get("counts") or {}).items():
+                counts[str(k)] = counts.get(str(k), 0) + int(c)
+        return {
+            "count": n,
+            "sum_seconds": round(total, 6),
+            "mean_ms": round(total / n * 1e3, 3) if n else 0.0,
+            "p50_ms": round(
+                cls.percentile_from_counts(counts, n, 50) * 1e3, 3),
+            "p99_ms": round(
+                cls.percentile_from_counts(counts, n, 99) * 1e3, 3),
+            "counts": counts,
+        }
 
 
 class ServeMetrics:
@@ -192,6 +251,10 @@ class ServeMetrics:
         self._quota_fn: Callable[[], dict] | None = None
         # per-kernel QoS lane depth gauges (rows queued per lane)
         self._lane_fns: dict[str, Callable[[], dict]] = {}
+        # SLO tracker (ISSUE 10): None unless --slo-* configured; the
+        # batcher records latency against it through this reference
+        # (one attribute read on the off path)
+        self.slo = None
 
     # --- write side -----------------------------------------------------
     def count_request(self, outcome: str) -> None:
@@ -324,6 +387,11 @@ class ServeMetrics:
         with self._lock:
             self._quota_fn = fn
 
+    def set_slo(self, tracker) -> None:
+        """Attach the SLO tracker (obs.slo.SloTracker); its burn-rate
+        gauges join both metric renderings."""
+        self.slo = tracker
+
     def register_lanes(self, name: str,
                        fn: Callable[[], dict]) -> None:
         """Register a per-lane queued-rows gauge for one served kernel
@@ -367,6 +435,7 @@ class ServeMetrics:
         mesh = mesh_fn() if mesh_fn is not None else None
         autoscale = autoscale_fn() if autoscale_fn is not None else None
         quota = quota_fn() if quota_fn is not None else None
+        slo = self.slo.snapshot() if self.slo is not None else None
         with self._lock:
             req = dict(self.requests)
             out = {
@@ -395,6 +464,8 @@ class ServeMetrics:
             out["autoscale"] = autoscale
         if quota is not None:
             out["quota"] = quota
+        if slo is not None:
+            out["slo"] = slo
         out["latency"] = self.latency.snapshot()
         out["queue_latency"] = self.queue_latency.snapshot()
         out["device_time"] = self.device_time.snapshot()
@@ -592,6 +663,40 @@ class ServeMetrics:
                 "# TYPE hpnn_serve_quota_clients gauge",
                 f"hpnn_serve_quota_clients {q['clients']}",
             ]
+        if snap.get("slo") is not None:
+            s = snap["slo"]
+            lines += [
+                "# HELP hpnn_slo_burn_rate Error-budget burn rate per "
+                "kernel/objective/window (1.0 = budget spent exactly "
+                "over the SLO period).",
+                "# TYPE hpnn_slo_burn_rate gauge",
+            ]
+            for kernel, objectives in sorted(s["kernels"].items()):
+                for obj, o in sorted(objectives.items()):
+                    pre = (f'hpnn_slo_burn_rate'
+                           f'{{kernel="{_escape_label(kernel)}",'
+                           f'objective="{_escape_label(obj)}"')
+                    lines += [
+                        f'{pre},window="fast"}} {o["fast_burn"]}',
+                        f'{pre},window="slow"}} {o["slow_burn"]}',
+                    ]
+            lines += [
+                "# HELP hpnn_slo_burning Both burn windows past the "
+                "threshold (1 = page-worthy; an slo_burn event fired).",
+                "# TYPE hpnn_slo_burning gauge",
+            ]
+            for kernel, objectives in sorted(s["kernels"].items()):
+                for obj, o in sorted(objectives.items()):
+                    lines.append(
+                        f'hpnn_slo_burning'
+                        f'{{kernel="{_escape_label(kernel)}",'
+                        f'objective="{_escape_label(obj)}"}} '
+                        f'{1 if o["burning"] else 0}')
+            lines += [
+                "# HELP hpnn_slo_alerts_total slo_burn events fired.",
+                "# TYPE hpnn_slo_alerts_total counter",
+                f"hpnn_slo_alerts_total {s['alerts_total']}",
+            ]
         lines += [
             "# HELP hpnn_serve_bucket_rows_per_sec Device rows/sec per "
             "batch bucket.",
@@ -667,3 +772,181 @@ class ServeMetrics:
                         f'{h["count"]}',
                     ]
         return "\n".join(lines) + "\n"
+
+    def render_fleet_prometheus(self, workers: dict) -> str:
+        """``GET /metrics?fleet=1`` on a mesh router: the router's own
+        exposition plus per-worker series and fleet rollups.  Fleet
+        families are all new names (``hpnn_fleet_*``) so the combined
+        text stays exposition-lint-clean; a worker that could not be
+        scraped (``None`` snapshot -- dead/unreachable) contributes
+        ONLY ``hpnn_fleet_worker_up 0``, an explicit gap rather than
+        stale series."""
+        lines = [self.render_prometheus().rstrip("\n")]
+        rollup = fleet_rollup(workers)
+        lines += [
+            "# HELP hpnn_fleet_worker_up Worker snapshot scraped this "
+            "federation pass (0 = dead/unreachable: the gap).",
+            "# TYPE hpnn_fleet_worker_up gauge",
+        ]
+        for addr in sorted(workers):
+            lines.append(
+                f'hpnn_fleet_worker_up'
+                f'{{worker="{_escape_label(addr)}"}} '
+                f"{1 if workers[addr] else 0}")
+        lines += [
+            "# HELP hpnn_fleet_worker_requests_total Per-worker "
+            "requests by outcome (federated).",
+            "# TYPE hpnn_fleet_worker_requests_total counter",
+        ]
+        for addr, snap in sorted(workers.items()):
+            if not snap:
+                continue
+            wlab = _escape_label(addr)
+            for outcome, n in sorted(snap.get("requests", {}).items()):
+                lines.append(
+                    f'hpnn_fleet_worker_requests_total'
+                    f'{{worker="{wlab}",'
+                    f'outcome="{_escape_label(outcome)}"}} {n}')
+        lines += [
+            "# HELP hpnn_fleet_worker_rows_total Per-worker device "
+            "rows (federated).",
+            "# TYPE hpnn_fleet_worker_rows_total counter",
+        ]
+        for addr, snap in sorted(workers.items()):
+            if not snap:
+                continue
+            lines.append(
+                f'hpnn_fleet_worker_rows_total'
+                f'{{worker="{_escape_label(addr)}"}} '
+                f"{snap.get('rows_total', 0)}")
+        lines += [
+            "# HELP hpnn_fleet_worker_latency_seconds Per-worker "
+            "request latency summary (federated).",
+            "# TYPE hpnn_fleet_worker_latency_seconds summary",
+        ]
+        for addr, snap in sorted(workers.items()):
+            if not snap or not snap.get("latency"):
+                continue
+            wlab = _escape_label(addr)
+            h = snap["latency"]
+            lines += [
+                f'hpnn_fleet_worker_latency_seconds{{worker="{wlab}",'
+                f'quantile="0.5"}} {h.get("p50_ms", 0.0) / 1e3}',
+                f'hpnn_fleet_worker_latency_seconds{{worker="{wlab}",'
+                f'quantile="0.99"}} {h.get("p99_ms", 0.0) / 1e3}',
+                f'hpnn_fleet_worker_latency_seconds_sum'
+                f'{{worker="{wlab}"}} {h.get("sum_seconds", 0.0)}',
+                f'hpnn_fleet_worker_latency_seconds_count'
+                f'{{worker="{wlab}"}} {h.get("count", 0)}',
+            ]
+        lines += [
+            "# HELP hpnn_fleet_worker_model_generation Per-worker "
+            "model weights generation (federated; min/max rollups "
+            "show reload coherence).",
+            "# TYPE hpnn_fleet_worker_model_generation gauge",
+        ]
+        for addr, snap in sorted(workers.items()):
+            if not snap:
+                continue
+            wlab = _escape_label(addr)
+            for kernel, info in sorted(snap.get("models", {}).items()):
+                lines.append(
+                    f'hpnn_fleet_worker_model_generation'
+                    f'{{worker="{wlab}",'
+                    f'kernel="{_escape_label(kernel)}"}} '
+                    f"{info.get('generation', 0)}")
+        # --- rollups -----------------------------------------------------
+        lines += [
+            "# HELP hpnn_fleet_workers Federation pass worker counts.",
+            "# TYPE hpnn_fleet_workers gauge",
+            f'hpnn_fleet_workers{{state="polled"}} '
+            f"{rollup['workers_polled']}",
+            f'hpnn_fleet_workers{{state="up"}} {rollup["workers_up"]}',
+            "# HELP hpnn_fleet_requests_total Fleet requests by "
+            "outcome (sum over scraped workers).",
+            "# TYPE hpnn_fleet_requests_total counter",
+        ]
+        for outcome, n in sorted(rollup["requests"].items()):
+            lines.append(
+                f'hpnn_fleet_requests_total'
+                f'{{outcome="{_escape_label(outcome)}"}} {n}')
+        h = rollup["latency"]
+        lines += [
+            "# HELP hpnn_fleet_rows_total Fleet device rows (sum).",
+            "# TYPE hpnn_fleet_rows_total counter",
+            f"hpnn_fleet_rows_total {rollup['rows_total']}",
+            "# HELP hpnn_fleet_batches_total Fleet device launches "
+            "(sum).",
+            "# TYPE hpnn_fleet_batches_total counter",
+            f"hpnn_fleet_batches_total {rollup['batches_total']}",
+            "# HELP hpnn_fleet_latency_seconds Fleet request latency "
+            "(bucket-merged across workers: real union quantiles).",
+            "# TYPE hpnn_fleet_latency_seconds summary",
+            f'hpnn_fleet_latency_seconds{{quantile="0.5"}} '
+            f"{h['p50_ms'] / 1e3}",
+            f'hpnn_fleet_latency_seconds{{quantile="0.99"}} '
+            f"{h['p99_ms'] / 1e3}",
+            f"hpnn_fleet_latency_seconds_sum {h['sum_seconds']}",
+            f"hpnn_fleet_latency_seconds_count {h['count']}",
+        ]
+        lines += [
+            "# HELP hpnn_fleet_model_generation_min Lowest worker "
+            "generation per kernel (== max when the fleet is "
+            "reload-coherent).",
+            "# TYPE hpnn_fleet_model_generation_min gauge",
+        ]
+        for kernel, mm in sorted(rollup["model_generation"].items()):
+            lines.append(
+                f'hpnn_fleet_model_generation_min'
+                f'{{kernel="{_escape_label(kernel)}"}} {mm["min"]}')
+        lines += [
+            "# HELP hpnn_fleet_model_generation_max Highest worker "
+            "generation per kernel.",
+            "# TYPE hpnn_fleet_model_generation_max gauge",
+        ]
+        for kernel, mm in sorted(rollup["model_generation"].items()):
+            lines.append(
+                f'hpnn_fleet_model_generation_max'
+                f'{{kernel="{_escape_label(kernel)}"}} {mm["max"]}')
+        return "\n".join(lines) + "\n"
+
+
+def fleet_rollup(workers: dict) -> dict:
+    """Aggregate per-worker JSON snapshots (``None`` = unreachable)
+    into the fleet view: counters SUM, latency histograms bucket-merge,
+    per-kernel generations reduce to min/max.  Pure function -- the
+    rollup-equals-sum acceptance pin drives it directly."""
+    up = {addr: s for addr, s in workers.items() if s}
+    requests: dict[str, int] = {}
+    gen: dict[str, dict] = {}
+    rows = batches = 0
+    queue_depth = 0
+    reloads = {"ok": 0, "error": 0}
+    for snap in up.values():
+        for outcome, n in snap.get("requests", {}).items():
+            requests[outcome] = requests.get(outcome, 0) + int(n)
+        rows += int(snap.get("rows_total", 0))
+        batches += int(snap.get("batches_total", 0))
+        for r, n in snap.get("reloads", {}).items():
+            reloads[r] = reloads.get(r, 0) + int(n)
+        for depth in snap.get("queue_depth", {}).values():
+            queue_depth += int(depth)
+        for kernel, info in snap.get("models", {}).items():
+            g = int(info.get("generation", 0))
+            mm = gen.setdefault(kernel, {"min": g, "max": g})
+            mm["min"] = min(mm["min"], g)
+            mm["max"] = max(mm["max"], g)
+    return {
+        "workers_polled": len(workers),
+        "workers_up": len(up),
+        "requests": requests,
+        "rows_total": rows,
+        "batches_total": batches,
+        "reloads": reloads,
+        "queue_depth_total": queue_depth,
+        "latency": LatencyHistogram.merge_snapshots(
+            s.get("latency") for s in up.values()),
+        "device_time": LatencyHistogram.merge_snapshots(
+            s.get("device_time") for s in up.values()),
+        "model_generation": gen,
+    }

@@ -21,14 +21,28 @@ Endpoints:
   model generation + last-reload-timestamp gauges, reload counters, and
   per-phase (parse/queue-wait/pad+H2D/device/D2H/respond) latency
   summaries; the JSON snapshot's histograms carry trace-id exemplars
-  (the slowest recent traced request).
-* ``GET /v1/debug/trace[?trace=ID&limit=N]`` -- the observability
-  flight recorder (hpnn_tpu.obs) as NDJSON, one completed span per
-  line; 404 until tracing is enabled (``--trace`` / ``HPNN_TRACE=1``).
-  Each infer request's trace id (``X-HPNN-Trace-Id`` request header, or
-  generated) is echoed in the response header + body, and its span tree
-  (parse -> queue-wait -> batch-assembly -> pad/H2D -> device launch ->
-  D2H -> respond) is recorded here.
+  (the slowest recent traced request).  On a mesh router ``?fleet=1``
+  FEDERATES: every worker's JSON snapshot is pulled and the exposition
+  gains per-worker series plus fleet rollups (summed counters,
+  bucket-merged latency histograms, per-kernel generation min/max);
+  dead workers federate as an explicit gap (``hpnn_fleet_worker_up
+  0``), never stale series.
+* ``GET /v1/debug/trace[?trace=ID&limit=N&since_seq=S]`` -- the
+  observability flight recorder (hpnn_tpu.obs) as NDJSON, one
+  completed span per line; 404 until tracing is enabled (``--trace`` /
+  ``HPNN_TRACE=1``).  Each infer request's trace id
+  (``X-HPNN-Trace-Id`` request header, or generated) is echoed in the
+  response header + body, and its span tree (parse -> queue-wait ->
+  batch-assembly -> pad/H2D -> device launch -> D2H -> respond) is
+  recorded here.  On a mesh router the response is the FLEET-MERGED
+  tree: the router's spans (``role=router``) plus every worker's
+  collected spans (``host=<addr>, role=worker``), so one query yields
+  the complete route -> worker -> device tree -- including spans from
+  workers that have since died.  ``?since_seq=S`` pages THIS process's
+  ring incrementally (spans carry a monotone ``seq``; the
+  ``X-HPNN-Trace-Seq`` response header is the next cursor), which is
+  the protocol the router's background collector drains workers with;
+  ``?local=1`` forces the router-local view.
 * ``POST /v1/debug/profile`` -- ``{"seconds": N, "dir": PATH?}``:
   capture a chip-side XLA/TSL profile from the live server via
   jax.profiler (auth-guarded; 409 while one runs, 501 when the
@@ -206,9 +220,20 @@ class ServeApp:
                  trace: bool | None = None,
                  profile_dir: str | None = None,
                  quota_rows: float = 0.0,
-                 quota_burst: float | None = None):
+                 quota_burst: float | None = None,
+                 slo_p99_ms: float | None = None,
+                 slo_availability: float | None = None):
         self.metrics = metrics or ServeMetrics()
         self.auth_token = auth_token or None
+        # SLO tracking (ISSUE 10): constructed only when an objective
+        # is configured -- the off path is `self.slo is None`
+        self.slo = None
+        if slo_p99_ms is not None or slo_availability is not None:
+            from ..obs.slo import SloTracker
+
+            self.slo = SloTracker(availability=slo_availability,
+                                  p99_ms=slo_p99_ms)
+            self.metrics.set_slo(self.slo)
         self.jobs = None  # JobScheduler once enable_jobs() runs
         self.mesh_router = None  # MeshRouter once enable_mesh_router()
         self.mesh_worker = None  # WorkerAgent when serving as a worker
@@ -430,7 +455,11 @@ class ServeApp:
         if kernels is not None and not isinstance(kernels, dict):
             raise _HTTPError(400, "bad_request",
                              "'kernels' must be an object")
-        return self.mesh_router.register_worker(addr, kernels)
+        jobs = req.get("jobs")
+        if jobs is not None and not isinstance(jobs, dict):
+            jobs = None  # advisory field: ignore junk, don't reject
+        return self.mesh_router.register_worker(addr, kernels,
+                                                jobs=jobs)
 
     def autoscale_snapshot(self) -> dict:
         """The autoscaling signal /metrics renders: queued rows, the
@@ -939,27 +968,63 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             params = dict(
                 kv.split("=", 1) for kv in query.split("&") if "=" in kv)
-            limit = None
-            if params.get("limit"):
-                try:
+            limit = since_seq = None
+            try:
+                if params.get("limit"):
                     limit = int(params["limit"])
-                except ValueError:
-                    self._reply(400, {"error": "bad limit",
-                                      "reason": "bad_request"})
-                    return
-            text = obs_trace.dump_ndjson(
-                trace_id=params.get("trace") or None, limit=limit)
+                if params.get("since_seq"):
+                    since_seq = int(params["since_seq"])
+            except ValueError:
+                self._reply(400, {"error": "bad limit/since_seq",
+                                  "reason": "bad_request"})
+                return
+            trace_id = params.get("trace") or None
+            router = self.app.mesh_router
+            # ?since_seq / ?local=1 page THIS process's ring (the
+            # fleet collector's per-host protocol: seq numbers are
+            # per-process); otherwise a mesh router serves the
+            # FLEET-MERGED view -- its own spans role=router plus every
+            # worker's, host-tagged, one endpoint for the whole tree
+            if (router is not None and since_seq is None
+                    and params.get("local") != "1"):
+                text = router.fleet.merged_dump(trace_id=trace_id,
+                                                limit=limit)
+            else:
+                text = obs_trace.dump_ndjson(trace_id=trace_id,
+                                             limit=limit,
+                                             since_seq=since_seq)
+            # the scraper's cursor (newest recorded seq) + the ring's
+            # identity: a changed ring id means this process's ring
+            # restarted and any stored cursor is invalid
             self._reply(200, text.encode("utf-8"),
-                        content_type="application/x-ndjson")
+                        content_type="application/x-ndjson",
+                        extra_headers={"X-HPNN-Trace-Seq":
+                                       str(obs_trace.last_seq()),
+                                       "X-HPNN-Trace-Ring":
+                                       obs_trace.ring_id()})
             return
         if path == "/metrics":
+            router = self.app.mesh_router
+            fleet = ("fleet=1" in query and router is not None)
             if "format=json" in query:
-                self._reply(200, self.app.metrics.snapshot())
+                if fleet:
+                    from .metrics import fleet_rollup
+
+                    workers = router.fleet.federated_metrics()
+                    self._reply(200, {
+                        "router": self.app.metrics.snapshot(),
+                        "workers": workers,
+                        "rollup": fleet_rollup(workers)})
+                else:
+                    self._reply(200, self.app.metrics.snapshot())
             else:
-                self._reply(
-                    200,
-                    self.app.metrics.render_prometheus().encode("utf-8"),
-                    content_type="text/plain; version=0.0.4")
+                if fleet:
+                    text = self.app.metrics.render_fleet_prometheus(
+                        router.fleet.federated_metrics())
+                else:
+                    text = self.app.metrics.render_prometheus()
+                self._reply(200, text.encode("utf-8"),
+                            content_type="text/plain; version=0.0.4")
             return
         try:
             if path == "/v1/jobs":
@@ -1136,6 +1201,16 @@ class _Handler(BaseHTTPRequestHandler):
                                         peer=self.client_address[0])
         except _HTTPError as exc:
             self.app.metrics.count_request(exc.outcome)
+            if self.app.slo is not None and exc.outcome != "not_found":
+                # availability SLO: only server-caused failures
+                # (5xx/504) spend error budget -- a client's bad input
+                # or over-quota 429 is not a service failure.  404s on
+                # unknown kernels are excluded entirely: the kernel
+                # path segment is client-supplied, and minting an
+                # objective (+ /metrics series) per junk name would be
+                # an unauthenticated cardinality leak
+                self.app.slo.record_outcome(m.group(1),
+                                            exc.status < 500)
             headers = dict(echo or {})
             if exc.status == 429:
                 # Retry-After from the queue's measured drain rate (or
@@ -1153,6 +1228,8 @@ class _Handler(BaseHTTPRequestHandler):
                         extra_headers=headers or None)
             return
         self.app.metrics.count_request("ok")
+        if self.app.slo is not None:
+            self.app.slo.record_outcome(m.group(1), True)
         if trace_ctx is not None:
             # the root completes BEFORE the response bytes leave: by the
             # time the client can query /v1/debug/trace, its tree is in

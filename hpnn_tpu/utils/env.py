@@ -1,0 +1,22 @@
+"""Tolerant environment-knob parsing, shared by every subsystem that
+reads an ``HPNN_*`` tuning value: a malformed value falls back to the
+default instead of raising -- a typo'd knob must degrade a tunable,
+never kill a server."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default

@@ -1,0 +1,235 @@
+#!/usr/bin/env python3
+"""Fleet observability overhead benchmark (ISSUE 10): mesh serving
+p50/p99 with tracing + metrics federation OFF vs ON, the trace
+collector's drain rate, and a verified merged cross-host tree --
+OBS_BENCH.json out.
+
+Topology (all on localhost; plain HTTP, so the same driver measures a
+real multi-host fleet): an in-process router fans over two subprocess
+workers -- the PR-9 mesh -- and the SAME load runs twice:
+
+1. **off** -- tracing disabled everywhere, nothing scrapes: the
+   baseline the observability layer is judged against (its off path is
+   one pointer check, so this round prices the mesh, not the layer);
+2. **on**  -- ``--trace`` on router + workers, every request minting a
+   full cross-host span tree, the router's fleet collector draining
+   worker rings in the background, AND a scraper thread pulling the
+   federated ``/metrics?fleet=1`` throughout the load -- the worst
+   honest case: full observability under fire.
+
+Floors (bench.py protocol: asserted, rc!=0 on a miss):
+
+* zero non-200 responses in both rounds;
+* overhead ceiling -- ON p50 <= OFF p50 x {ceiling} + {slack} ms (the
+  layer must stay in the noise next to the RPC hop);
+* the collector actually drained (> 0 spans, rate recorded) and ONE
+  traced request yields a MERGED route -> worker -> device tree from
+  the router endpoint (an overhead number for a broken feature would
+  be worthless).
+
+``--real`` (``make obs-bench REAL=1``) keeps the ambient JAX platform
+(chip workers); default forces CPU everywhere.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+OVERHEAD_CEILING = 1.75   # ON p50 <= OFF p50 * this ...
+OVERHEAD_SLACK_MS = 25.0  # ... + this (single-core CPU jitter floor)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--real", action="store_true",
+                    help="keep the ambient JAX platform (chip "
+                    "workers); default forces CPU everywhere")
+    ap.add_argument("--requests", type=int, default=160)
+    ap.add_argument("--rows", default="3,5,7")
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--scrape-interval", type=float, default=0.25,
+                    help="federated /metrics?fleet=1 pull period "
+                    "during the ON round")
+    args = ap.parse_args()
+
+    if not args.real:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    # deep rings: the measured load must not out-run the recorder
+    os.environ.setdefault("HPNN_TRACE_BUFFER", "65536")
+    os.environ.setdefault("HPNN_FLEET_TRACE_BUFFER", "65536")
+    os.environ.setdefault("HPNN_FLEET_POLL_S", "0.5")
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import numpy as np
+
+    import mesh_bench
+    import serve_bench
+    from hpnn_tpu.serve.server import ServeApp, serve_in_thread
+
+    sizes = [int(s) for s in str(args.rows).split(",")]
+    tmp = tempfile.mkdtemp(prefix="hpnn-obs-bench-")
+    conf = mesh_bench._write_conf(tmp)
+    rng = np.random.default_rng(42)
+    total_rows = sum(sizes[i % len(sizes)] for i in range(args.requests))
+    inputs = rng.uniform(-1.0, 1.0, (total_rows, 8))
+    serve_kw = dict(max_batch=64, max_queue_rows=4096, parity="fast",
+                    fast_threshold=4)
+
+    def run_round(trace_on: bool) -> tuple[dict, dict]:
+        """One fresh router + 2 workers; returns (load stats, extras)."""
+        procs: list = []
+        rapp = ServeApp(trace=trace_on if trace_on else False,
+                        **serve_kw)
+        rapp.enable_mesh_router(required_workers=2,
+                                health_interval_s=0.5)
+        assert rapp.add_model(conf) is not None
+        rhttpd, _ = serve_in_thread("127.0.0.1", 0, rapp)
+        rport = rhttpd.server_address[1]
+        rbase = f"http://127.0.0.1:{rport}"
+        wargs = ["--parity", "fast", "--fast-threshold", "4",
+                 "-b", "64", "-q", "4096"]
+        if trace_on:
+            wargs.append("--trace")
+        try:
+            for _ in range(2):
+                procs.append(mesh_bench.spawn_worker(
+                    conf, f"127.0.0.1:{rport}", tuple(wargs),
+                    real=args.real))
+            mesh_bench.wait_healthz_ok(rbase, timeout_s=120.0)
+            # steady state: pay both workers' first-request compiles
+            for i in range(48):
+                serve_bench.http_json(
+                    rbase + "/v1/kernels/mesh/infer",
+                    {"inputs": inputs[:sizes[i % len(sizes)]].tolist()},
+                    timeout_s=120.0)
+            extras: dict = {}
+            stop = threading.Event()
+            scrape_counts = {"n": 0, "errors": 0}
+
+            def scraper():
+                while not stop.is_set():
+                    try:
+                        st, _ = serve_bench.http_json(
+                            rbase + "/metrics?fleet=1&format=json",
+                            timeout_s=30.0)
+                        if st != 200:
+                            scrape_counts["errors"] += 1
+                    except Exception:
+                        scrape_counts["errors"] += 1
+                    scrape_counts["n"] += 1
+                    time.sleep(args.scrape_interval)
+
+            scraper_thread = None
+            if trace_on:
+                scraper_thread = threading.Thread(target=scraper,
+                                                  daemon=True)
+                scraper_thread.start()
+            t0 = time.monotonic()
+            load = serve_bench.run_load(rbase, "mesh", inputs,
+                                        rows_per_request=sizes,
+                                        concurrency=args.concurrency)
+            wall = time.monotonic() - t0
+            if trace_on:
+                stop.set()
+                scraper_thread.join(timeout=5)
+                # the feature must WORK at the measured overhead: one
+                # traced request -> merged cross-host tree, one GET
+                st, body = serve_bench.http_json(
+                    rbase + "/v1/kernels/mesh/infer",
+                    {"inputs": inputs[:3].tolist()},
+                    headers={"X-HPNN-Trace-Id": "obsbench01"})
+                merged_ok = False
+                deadline = time.monotonic() + 15
+                while time.monotonic() < deadline and not merged_ok:
+                    import urllib.request
+
+                    with urllib.request.urlopen(
+                            rbase + "/v1/debug/trace?trace=obsbench01",
+                            timeout=30) as resp:
+                        spans = [json.loads(ln) for ln in
+                                 resp.read().decode().splitlines()]
+                    names_roles = {(s["name"], s.get("role"))
+                                   for s in spans}
+                    merged_ok = (
+                        ("mesh.route", "router") in names_roles
+                        and ("device_launch", "worker") in names_roles)
+                    if not merged_ok:
+                        time.sleep(0.25)
+                fstats = rapp.mesh_router.fleet.stats()
+                extras = {
+                    "merged_tree_ok": merged_ok,
+                    "collector": fstats,
+                    "collector_drain_spans_per_s": round(
+                        fstats["spans_collected_total"] / wall, 1),
+                    "federation_scrapes": scrape_counts["n"],
+                    "federation_scrape_errors": scrape_counts["errors"],
+                }
+            return load, extras
+        finally:
+            for proc, _port in procs:
+                if proc.poll() is None:
+                    proc.kill()
+            rhttpd.shutdown()
+            rapp.close(drain=True)
+
+    off, _ = run_round(trace_on=False)
+    on, extras = run_round(trace_on=True)
+
+    keep = ("rows_per_s", "requests_per_s", "p50_ms", "p99_ms",
+            "statuses")
+    row = {"metric": "fleet_obs_overhead", "unit": "ms",
+           "real": bool(args.real), "requests": args.requests,
+           "rows_per_request": sizes, "concurrency": args.concurrency,
+           "off": {k: off[k] for k in keep},
+           "on": {k: on[k] for k in keep},
+           "overhead_p50_ms": round(on["p50_ms"] - off["p50_ms"], 3),
+           "overhead_p99_ms": round(on["p99_ms"] - off["p99_ms"], 3),
+           "overhead_ceiling": f"p50_on <= p50_off*{OVERHEAD_CEILING}"
+                               f" + {OVERHEAD_SLACK_MS}ms",
+           "value": round(on["p50_ms"] - off["p50_ms"], 3)}
+    row.update(extras)
+
+    failed: list[str] = []
+    if off["statuses"] != {"200": args.requests}:
+        failed.append(f"off-round non-200s: {off['statuses']}")
+    if on["statuses"] != {"200": args.requests}:
+        failed.append(f"on-round non-200s: {on['statuses']}")
+    ceiling = off["p50_ms"] * OVERHEAD_CEILING + OVERHEAD_SLACK_MS
+    if on["p50_ms"] > ceiling:
+        failed.append(f"tracing+federation overhead blew the ceiling: "
+                      f"p50 {on['p50_ms']}ms vs off {off['p50_ms']}ms "
+                      f"(ceiling {ceiling:.1f}ms)")
+    if not extras.get("merged_tree_ok"):
+        failed.append("merged cross-host trace tree never materialized")
+    if extras.get("collector", {}).get("spans_collected_total", 0) <= 0:
+        failed.append("collector drained zero spans during the load")
+    if extras.get("federation_scrape_errors", 1) != 0:
+        failed.append(f"federated scrapes failed: "
+                      f"{extras.get('federation_scrape_errors')}")
+
+    row["floors_failed"] = failed
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(json.dumps(row) + "\n")
+    if failed:
+        for f in failed:
+            sys.stderr.write(f"OBS_BENCH floor miss: {f}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,817 @@
+"""Fleet observability (ISSUE 10): cross-host trace collection,
+metrics federation, and SLO burn-rate tracking.
+
+Fast tier: recorder seq/since_seq paging semantics (unit + over HTTP),
+the router's fleet collector against scripted stub workers (incremental
+cursors, worker-restart rewind, dead-worker span retention, merged
+host/role-tagged trees), metrics federation rollup == per-worker sums
+with a hostile kernel name and a dead-worker gap (exposition lint on
+the federated text), SLO tracker burn semantics (trips exactly at the
+budget threshold, multi-window alert + re-arm, zero-cost off), mesh
+lifecycle events in the recorder + JSON log mode, and the role-tagged
+post-mortem dump with collected worker spans.
+
+Slow tier: the acceptance e2e -- a 2-subprocess-worker mesh under load,
+ONE trace id yielding the complete merged route -> worker -> device
+tree from the router's /v1/debug/trace, including after the serving
+worker is SIGKILLed.
+"""
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import mesh_bench  # noqa: E402
+import serve_bench  # noqa: E402
+from test_obs import lint_prometheus  # noqa: E402
+
+from hpnn_tpu import obs  # noqa: E402
+from hpnn_tpu.obs import trace as obs_trace  # noqa: E402
+from hpnn_tpu.obs.slo import SloTracker  # noqa: E402
+from hpnn_tpu.serve.metrics import (  # noqa: E402
+    LatencyHistogram,
+    ServeMetrics,
+    fleet_rollup,
+)
+from hpnn_tpu.serve.mesh.router import WorkerPool  # noqa: E402
+from hpnn_tpu.serve.server import ServeApp, serve_in_thread  # noqa: E402
+from hpnn_tpu.utils import nn_log  # noqa: E402
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    """Tracing off, role cleared, verbosity 0 around every test."""
+    obs.disable()
+    obs_trace.set_role(None)
+    nn_log.set_verbosity(0)
+    yield
+    obs.disable()
+    obs_trace.set_role(None)
+    nn_log.set_verbosity(0)
+
+
+def _write_kernel_conf(tmp_path, name="tiny", seed=1234):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    return str(conf)
+
+
+# --- scripted stub worker (trace ring + metrics snapshot over HTTP) ---------
+
+class _StubHandler(BaseHTTPRequestHandler):
+    def log_message(self, fmt, *args):
+        pass
+
+    def do_GET(self):
+        cfg = self.server.cfg  # type: ignore[attr-defined]
+        path, _, query = self.path.partition("?")
+        params = dict(kv.split("=", 1) for kv in query.split("&")
+                      if "=" in kv)
+        if path == "/healthz":
+            self._send(200, json.dumps({"status": "ok"}).encode(),
+                       "application/json")
+            return
+        if path == "/v1/debug/trace":
+            since = int(params.get("since_seq", "0"))
+            cfg["seen_since"].append(since)
+            spans = [s for s in cfg["spans"] if s["seq"] > since]
+            body = "".join(json.dumps(s) + "\n" for s in spans).encode()
+            last = max((s["seq"] for s in cfg["spans"]), default=0)
+            headers = {"X-HPNN-Trace-Seq": str(last)}
+            if cfg.get("ring"):
+                headers["X-HPNN-Trace-Ring"] = cfg["ring"]
+            self._send(200, body, "application/x-ndjson", headers)
+            return
+        if path == "/metrics":
+            self._send(200, json.dumps(cfg["metrics"]).encode(),
+                       "application/json")
+            return
+        self._send(404, b"{}", "application/json")
+
+    def _send(self, status, body, ctype, headers=None):
+        self.send_response(status)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def _stub_worker(spans=None, metrics=None):
+    """A scripted worker host: returns (cfg, httpd, addr).  Mutate
+    cfg["spans"]/cfg["metrics"] to script later responses."""
+    httpd = ThreadingHTTPServer(("127.0.0.1", 0), _StubHandler)
+    httpd.daemon_threads = True
+    httpd.cfg = {"spans": spans or [], "metrics": metrics or {},
+                 "seen_since": []}
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    return httpd.cfg, httpd, f"127.0.0.1:{httpd.server_address[1]}"
+
+
+def _mk_span(seq, trace="t-fleet", name="device_launch", parent=None):
+    return {"name": name, "trace": trace, "span": f"stub{seq:08x}",
+            "parent": parent, "ts": 1000.0 + seq, "dur_s": 0.001,
+            "thread": "w", "seq": seq}
+
+
+def _worker_metrics(ok=10, rows=30, kernel="tiny", gen=1,
+                    lat_counts=None, lat_n=0, lat_sum=0.0):
+    return {
+        "requests": {"ok": ok, "error": 0},
+        "rows_total": rows, "batches_total": ok,
+        "reloads": {"ok": 0, "error": 0},
+        "queue_depth": {kernel: 0},
+        "models": {kernel: {"generation": gen,
+                            "last_reload_ts": 1700000000.0}},
+        "latency": {"count": lat_n, "sum_seconds": lat_sum,
+                    "p50_ms": 1.0, "p99_ms": 2.0,
+                    "counts": lat_counts or {}},
+        "device_time": {"count": 0, "sum_seconds": 0.0, "p50_ms": 0.0,
+                        "p99_ms": 0.0, "counts": {}},
+    }
+
+
+# --- recorder seq / since_seq paging ----------------------------------------
+
+def test_span_seq_monotone_and_since_seq_filter():
+    obs.enable(capacity=32)
+    for i in range(5):
+        with obs.span(f"s{i}"):
+            pass
+    spans = obs.snapshot()
+    seqs = [s["seq"] for s in spans]
+    assert seqs == [1, 2, 3, 4, 5]
+    assert obs_trace.last_seq() == 5
+    assert [s["name"] for s in obs.snapshot(since_seq=3)] == ["s3", "s4"]
+    assert obs.snapshot(since_seq=5) == []
+    # eviction never rewinds seq: the cursor protocol survives a full
+    # ring turnover
+    obs.enable(capacity=32)  # same capacity: no-op, state kept
+    for i in range(40):
+        with obs.span(f"t{i}"):
+            pass
+    assert obs_trace.last_seq() == 45
+    assert obs.snapshot()[0]["seq"] == 14  # oldest evicted
+
+
+def test_since_seq_paging_over_http(tmp_path):
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8, trace=True)
+    assert app.add_model(conf, warmup=False) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        serve_bench.http_json(base + "/v1/kernels/tiny/infer",
+                              {"inputs": np.zeros((1, N_IN)).tolist()})
+        import urllib.request
+
+        with urllib.request.urlopen(base + "/v1/debug/trace") as resp:
+            full = resp.read().decode()
+            cursor = int(resp.headers["X-HPNN-Trace-Seq"])
+        n_full = len(full.splitlines())
+        assert n_full >= 3 and cursor >= n_full
+        # nothing new past the cursor
+        with urllib.request.urlopen(
+                base + f"/v1/debug/trace?since_seq={cursor}") as resp:
+            assert resp.read() == b""
+            assert int(resp.headers["X-HPNN-Trace-Seq"]) == cursor
+        # one more request: the page carries ONLY its spans
+        serve_bench.http_json(base + "/v1/kernels/tiny/infer",
+                              {"inputs": np.zeros((1, N_IN)).tolist()})
+        with urllib.request.urlopen(
+                base + f"/v1/debug/trace?since_seq={cursor}") as resp:
+            page = resp.read().decode()
+        assert 0 < len(page.splitlines()) < n_full + 2
+        assert all(json.loads(ln)["seq"] > cursor
+                   for ln in page.splitlines())
+        # bad since_seq: 400, not a stack trace
+        st, _, _ = _get_raw(base + "/v1/debug/trace?since_seq=soon")
+        assert st == 400
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def _get_raw(url, headers=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read(), dict(exc.headers)
+
+
+# --- the fleet collector ----------------------------------------------------
+
+def _pool_with_stub(stub_addr):
+    pool = WorkerPool(eject_after=2)
+    pool.register(stub_addr)
+    return pool
+
+
+def test_fleet_collector_incremental_cursor_and_restart_rewind():
+    from hpnn_tpu.serve.mesh.fleet import FleetObserver
+
+    cfg, httpd, addr = _stub_worker(
+        spans=[_mk_span(1), _mk_span(2)])
+    pool = _pool_with_stub(addr)
+    fleet = FleetObserver(pool, poll_interval_s=3600, capacity=64)
+    try:
+        assert fleet.drain_once() == 2
+        assert cfg["seen_since"][0] == 0
+        # second drain pages PAST the cursor: no re-shipping
+        assert fleet.drain_once() == 0
+        assert cfg["seen_since"][-1] == 2
+        cfg["spans"].append(_mk_span(3))
+        assert fleet.drain_once() == 1
+        spans = fleet.collected_spans()
+        assert len(spans) == 3  # no duplicates despite 3 drains
+        assert all(s["host"] == addr and s["role"] == "worker"
+                   for s in spans)
+        # worker restart: its seq rewinds below our cursor -> the
+        # collector re-pages from 0 instead of waiting forever
+        cfg["spans"][:] = [_mk_span(1, trace="t-new")]
+        assert fleet.drain_once() == 1
+        assert any(s["trace"] == "t-new"
+                   for s in fleet.collected_spans())
+        st = fleet.stats()
+        assert st["spans_collected_total"] == 4
+        assert st["workers_tracked"] == 1
+    finally:
+        httpd.shutdown()
+        pool.close()
+
+
+def test_fleet_collector_ring_id_restart_beats_cursor():
+    """A restarted worker whose NEW ring already out-ran the old
+    cursor (seq never goes backward from the router's view) is still
+    detected via the ring id header, and the early spans of the new
+    ring are not lost."""
+    from hpnn_tpu.serve.mesh.fleet import FleetObserver
+
+    cfg, httpd, addr = _stub_worker(
+        spans=[_mk_span(i) for i in range(1, 6)])
+    cfg["ring"] = "ring-aaaa"
+    pool = _pool_with_stub(addr)
+    fleet = FleetObserver(pool, poll_interval_s=3600, capacity=64)
+    try:
+        assert fleet.drain_once() == 5  # cursor now 5, ring-aaaa known
+        # restart: NEW ring, and by the next poll it recorded MORE
+        # spans than the cursor -- seq alone would silently skip 1..5
+        cfg["ring"] = "ring-bbbb"
+        cfg["spans"][:] = [_mk_span(i, trace="t-post")
+                           for i in range(1, 9)]
+        assert fleet.drain_once() == 8  # ALL new-ring spans collected
+        post = [s for s in fleet.collected_spans()
+                if s["trace"] == "t-post"]
+        assert sorted(s["seq"] for s in post) == list(range(1, 9))
+    finally:
+        httpd.shutdown()
+        pool.close()
+
+
+def test_fleet_merged_trace_survives_dead_worker():
+    """Tentpole pin (fast tier): the merged view contains router spans
+    role=router and worker spans host/role-tagged; killing the worker
+    keeps its already-collected spans queryable."""
+    from hpnn_tpu.serve.mesh.fleet import FleetObserver
+
+    obs.enable(capacity=64)
+    cfg, httpd, addr = _stub_worker(spans=[
+        _mk_span(1, name="serve.request"),
+        _mk_span(2, name="device_launch"),
+    ])
+    pool = _pool_with_stub(addr)
+    fleet = FleetObserver(pool, poll_interval_s=3600, capacity=64)
+    try:
+        t0 = time.monotonic()
+        obs.record("mesh.route", t0, t0 + 0.01, trace_id="t-fleet",
+                   worker=addr)
+        merged = fleet.merged_spans(trace_id="t-fleet")
+        by_name = {s["name"]: s for s in merged}
+        assert set(by_name) == {"mesh.route", "serve.request",
+                                "device_launch"}
+        assert by_name["mesh.route"]["role"] == "router"
+        assert by_name["mesh.route"]["host"] == fleet.host
+        assert by_name["device_launch"]["role"] == "worker"
+        assert by_name["device_launch"]["host"] == addr
+        # the worker dies: collected spans must NOT die with it
+        httpd.shutdown()
+        w = pool.workers()[0]
+        pool.report_failure(w, ConnectionRefusedError("gone"))
+        assert w.state == "dead"
+        merged2 = fleet.merged_spans(trace_id="t-fleet")
+        assert {s["name"] for s in merged2} == set(by_name)
+        # NDJSON rendering, time-ordered
+        dump = fleet.merged_dump(trace_id="t-fleet")
+        assert len(dump.splitlines()) == 3
+    finally:
+        pool.close()
+
+
+# --- metrics federation -----------------------------------------------------
+
+def test_fleet_rollup_equals_sum_and_histogram_merge():
+    evil = 'k"er\\nal\n2'
+    w1 = _worker_metrics(ok=10, rows=30, gen=2,
+                         lat_counts={"5": 8, "10": 2}, lat_n=10,
+                         lat_sum=0.05)
+    w2 = _worker_metrics(ok=7, rows=21, kernel=evil, gen=3,
+                         lat_counts={"5": 3, "20": 4}, lat_n=7,
+                         lat_sum=0.2)
+    workers = {"127.0.0.1:9001": w1, "127.0.0.1:9002": w2,
+               "127.0.0.1:9003": None}  # the dead-worker gap
+    roll = fleet_rollup(workers)
+    assert roll["workers_polled"] == 3 and roll["workers_up"] == 2
+    assert roll["requests"]["ok"] == 17
+    assert roll["rows_total"] == 51
+    assert roll["batches_total"] == 17
+    # histogram merge: counts add, quantiles recompute from the union
+    assert roll["latency"]["count"] == 17
+    assert roll["latency"]["counts"] == {"5": 11, "10": 2, "20": 4}
+    assert roll["latency"]["sum_seconds"] == 0.25
+    p99 = LatencyHistogram.percentile_from_counts(
+        {"5": 11, "10": 2, "20": 4}, 17, 99)
+    assert roll["latency"]["p99_ms"] == round(p99 * 1e3, 3)
+    # mixed-version fleet: a snapshot with count>0 but NO bucket detail
+    # (pre-'counts' worker) must read "unknown" as 0.0, never the
+    # overflow bucket's sentinel latency
+    assert LatencyHistogram.percentile_from_counts({}, 17, 99) == 0.0
+    old = dict(w1)
+    old["latency"] = {"count": 5, "sum_seconds": 0.01, "p50_ms": 1.0,
+                      "p99_ms": 2.0}  # no 'counts' key
+    merged = LatencyHistogram.merge_snapshots([old["latency"]])
+    assert merged["count"] == 5 and merged["p99_ms"] == 0.0
+    # generation min/max per kernel (reload-coherence signal)
+    assert roll["model_generation"]["tiny"] == {"min": 2, "max": 2}
+    assert roll["model_generation"][evil] == {"min": 3, "max": 3}
+
+
+def test_federated_prometheus_lints_with_hostile_names_and_gap():
+    """Satellite pin: the exposition lint passes on the FEDERATED
+    text -- hostile worker-advertised kernel names escaped, a dead
+    worker contributing only the up=0 gap, no duplicate series,
+    HELP/TYPE paired."""
+    evil = 'k"er\\nal\n2'
+    m = ServeMetrics()
+    m.count_request("ok")
+    m.latency.observe(0.01)
+    workers = {
+        "127.0.0.1:9001": _worker_metrics(ok=5, rows=15, kernel=evil),
+        "127.0.0.1:9002": _worker_metrics(ok=3, rows=9),
+        "127.0.0.1:9003": None,
+    }
+    text = m.render_fleet_prometheus(workers)
+    series = lint_prometheus(text)
+    names = {name for name, _ in series}
+    for want in ("hpnn_fleet_worker_up", "hpnn_fleet_requests_total",
+                 "hpnn_fleet_worker_requests_total",
+                 "hpnn_fleet_latency_seconds_count",
+                 "hpnn_fleet_model_generation_min",
+                 "hpnn_fleet_worker_model_generation"):
+        assert want in names, want
+    assert 'hpnn_fleet_worker_up{worker="127.0.0.1:9003"} 0' in text
+    assert 'hpnn_fleet_requests_total{outcome="ok"} 8' in text
+    # the dead worker contributes NOTHING beyond the gap gauge
+    dead_series = [(n, labels) for n, labels in series
+                   if ("worker", "127.0.0.1:9003") in labels
+                   and n != "hpnn_fleet_worker_up"]
+    assert dead_series == []
+
+
+def test_metrics_fleet_endpoint_e2e(tmp_path):
+    """?fleet=1 on a live router: per-worker JSON snapshots + rollup
+    equal to their sum, and the federated prom text lints."""
+    conf = _write_kernel_conf(tmp_path)
+    rapp = ServeApp(max_batch=16, max_queue_rows=256)
+    rapp.enable_mesh_router(required_workers=2,
+                            health_interval_s=0.2)
+    assert rapp.add_model(conf) is not None
+    rhttpd, _ = serve_in_thread("127.0.0.1", 0, rapp)
+    rport = rhttpd.server_address[1]
+    workers = []
+    try:
+        from hpnn_tpu.serve.mesh.worker import WorkerAgent
+
+        for _ in range(2):
+            wapp = ServeApp(max_batch=16, max_queue_rows=256)
+            assert wapp.add_model(conf, warmup=False) is not None
+            whttpd, _ = serve_in_thread("127.0.0.1", 0, wapp)
+            agent = WorkerAgent(
+                wapp, f"127.0.0.1:{rport}",
+                f"127.0.0.1:{whttpd.server_address[1]}", interval_s=0.3)
+            wapp.mesh_worker = agent
+            agent.start()
+            workers.append((wapp, whttpd))
+        base = f"http://127.0.0.1:{rport}"
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            st, _ = serve_bench.http_json(base + "/healthz")
+            if st == 200:
+                break
+            time.sleep(0.05)
+        rng = np.random.default_rng(7)
+        for rows in (1, 2, 3, 2, 1, 3):
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer",
+                {"inputs": rng.uniform(-1, 1, (rows, N_IN)).tolist()})
+            assert st == 200
+        st, fed = serve_bench.http_json(
+            base + "/metrics?fleet=1&format=json")
+        assert st == 200
+        ups = {a: s for a, s in fed["workers"].items() if s}
+        assert len(ups) == 2
+        want_ok = sum(s["requests"].get("ok", 0) for s in ups.values())
+        want_rows = sum(s["rows_total"] for s in ups.values())
+        assert fed["rollup"]["requests"]["ok"] == want_ok == 6
+        assert fed["rollup"]["rows_total"] == want_rows == 12
+        assert fed["rollup"]["latency"]["count"] == 6
+        assert fed["rollup"]["model_generation"]["tiny"] == \
+            {"min": 1, "max": 1}
+        st, raw, _ = _get_raw(base + "/metrics?fleet=1")
+        assert st == 200
+        lint_prometheus(raw.decode())
+        assert f'hpnn_fleet_requests_total{{outcome="ok"}} {want_ok}' \
+            in raw.decode()
+    finally:
+        for wapp, whttpd in workers:
+            whttpd.shutdown()
+            wapp.close(drain=True)
+        rhttpd.shutdown()
+        rapp.close(drain=True)
+
+
+# --- SLO tracking -----------------------------------------------------------
+
+def test_slo_trips_exactly_at_budget_threshold():
+    """Acceptance pin: the burn gauge trips exactly when injected
+    failures exceed the budget x threshold, not before."""
+    slo = SloTracker(availability=0.9, fast_s=10.0, slow_s=10.0,
+                     burn_threshold=2.0)  # trip at bad_frac >= 0.2
+    for _ in range(9):
+        slo.record_outcome("k", True)
+    slo.record_outcome("k", False)  # 1/10 bad: burn 1.0 < 2.0
+    snap = slo.snapshot()["kernels"]["k"]["availability"]
+    assert snap["fast_burn"] == pytest.approx(1.0)
+    assert snap["burning"] is False
+    slo.record_outcome("k", False)  # 2/11 bad: burn 1.82 < 2.0
+    assert not slo.snapshot()["kernels"]["k"]["availability"]["burning"]
+    slo.record_outcome("k", False)  # 3/12 = 0.25: burn 2.5 >= 2.0
+    snap = slo.snapshot()["kernels"]["k"]["availability"]
+    assert snap["burning"] is True
+    assert snap["fast_burn"] == pytest.approx(2.5)
+    assert slo.snapshot()["alerts_total"] == 1  # one alert, not per read
+
+
+def test_slo_multiwindow_alert_fires_and_rearms(monkeypatch, capsys):
+    monkeypatch.setenv("HPNN_LOG_JSON", "1")
+    slo = SloTracker(availability=0.9, fast_s=0.2, slow_s=0.4,
+                     burn_threshold=2.0)
+    for _ in range(4):
+        slo.record_outcome("k", False)  # 100% bad: both windows burn
+    snap = slo.snapshot()["kernels"]["k"]["availability"]
+    assert snap["burning"] is True
+    events = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+              if '"event"' in ln]
+    burn = [e for e in events if e["event"] == "slo_burn"]
+    assert len(burn) == 1
+    assert burn[0]["kernel"] == "k"
+    assert burn[0]["objective"] == "availability"
+    # the windows slide past the failures: the alert clears + re-arms
+    time.sleep(0.5)
+    slo.record_outcome("k", True)
+    snap = slo.snapshot()["kernels"]["k"]["availability"]
+    assert snap["burning"] is False
+    events = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+              if '"event"' in ln]
+    assert any(e["event"] == "slo_burn_cleared" for e in events)
+    # a second incident fires a second alert
+    for _ in range(4):
+        slo.record_outcome("k", False)
+    assert slo.snapshot()["kernels"]["k"]["availability"]["burning"]
+    assert slo.alerts_total == 2
+
+
+def test_slo_latency_objective_and_metrics_gauges():
+    m = ServeMetrics()
+    slo = SloTracker(p99_ms=50.0, fast_s=10.0, slow_s=10.0,
+                     burn_threshold=10.0)  # trip at >=10% slow
+    m.set_slo(slo)
+    for _ in range(8):
+        slo.record_latency("tiny", 0.001)
+    slo.record_latency("tiny", 0.2)  # 1/9 over target: burn 11.1
+    snap = m.snapshot()
+    lat = snap["slo"]["kernels"]["tiny"]["latency"]
+    assert lat["burning"] is True
+    text = m.render_prometheus()
+    lint_prometheus(text)
+    assert ('hpnn_slo_burn_rate{kernel="tiny",objective="latency",'
+            'window="fast"}') in text
+    assert ('hpnn_slo_burning{kernel="tiny",objective="latency"} 1'
+            in text)
+    assert "hpnn_slo_alerts_total 1" in text
+
+
+def test_slo_off_is_absent_and_zero_cost(tmp_path):
+    """Acceptance pin: without --slo-* flags nothing SLO-shaped exists
+    -- no tracker object, no snapshot key, no exposition series."""
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    assert app.add_model(conf, warmup=False) is not None
+    try:
+        assert app.slo is None
+        assert app.metrics.slo is None
+        xs = np.zeros((1, N_IN))
+        out = app.handle_infer("tiny", json.dumps(
+            {"inputs": xs.tolist()}).encode())
+        assert out["kernel"] == "tiny"
+        snap = app.metrics.snapshot()
+        assert "slo" not in snap
+        assert "hpnn_slo" not in app.metrics.render_prometheus()
+    finally:
+        app.close(drain=True)
+
+
+def test_slo_over_http_with_injected_failures(tmp_path, monkeypatch):
+    """E2e: server-caused 5xx failures (a failing backend) trip the
+    availability burn gauge over HTTP; client-caused 4xx do not."""
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8, slo_availability=0.9)
+    app.slo.fast_s = app.slo.slow_s = 10.0
+    app.slo.burn_threshold = 2.0
+    assert app.add_model(conf, warmup=False) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    xs = np.zeros((2, N_IN)).tolist()
+    try:
+        for _ in range(6):
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", {"inputs": xs})
+            assert st == 200
+        # client errors spend NO budget
+        st, _ = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": [[1.0]]})
+        assert st == 400
+        snap = app.slo.snapshot()["kernels"]["tiny"]["availability"]
+        assert snap["fast_burn"] == 0.0
+        # unknown-kernel 404s (client-supplied path segment) must not
+        # mint objectives -- an unauthenticated cardinality leak
+        for i in range(3):
+            st, _ = serve_bench.http_json(
+                base + f"/v1/kernels/junk{i}/infer", {"inputs": xs})
+            assert st == 404
+        assert set(app.slo.snapshot()["kernels"]) == {"tiny"}
+        # inject server failures: the backend dies at dispatch
+        b = app.batchers["tiny"]
+
+        class _DeadBackend:
+            def pipeline_depth(self):
+                return 1
+
+            def dispatch(self, *a, **k):
+                raise RuntimeError("injected device failure")
+
+            def collect(self, handle):  # pragma: no cover
+                raise RuntimeError("unreachable")
+
+        orig = b.backend
+        b.backend = _DeadBackend()
+        for _ in range(4):
+            st, body = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", {"inputs": xs})
+            assert st == 500
+        b.backend = orig
+        snap = app.slo.snapshot()["kernels"]["tiny"]["availability"]
+        # 4 bad / 10 counted = 0.4 frac, budget 0.1 -> burn 4.0 >= 2.0
+        assert snap["burning"] is True
+        st, raw, _ = _get_raw(base + "/metrics")
+        assert ('hpnn_slo_burning{kernel="tiny",'
+                'objective="availability"} 1') in raw.decode()
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- mesh lifecycle events --------------------------------------------------
+
+def test_lifecycle_events_land_in_recorder_and_json_log(monkeypatch,
+                                                        capsys):
+    obs.enable(capacity=64)
+    monkeypatch.setenv("HPNN_LOG_JSON", "1")
+    pool = WorkerPool(eject_after=1)
+    try:
+        w = pool.register("127.0.0.1:7001")
+        pool.report_failure(w, ConnectionRefusedError("boom"))
+        pool.report_ok(w)  # readmission
+        spans = obs.snapshot(trace_id="mesh")
+        names = [s["name"] for s in spans]
+        assert names == ["mesh.worker_registered", "mesh.worker_ejected",
+                         "mesh.worker_readmitted"]
+        ejected = spans[1]
+        assert ejected["worker"] == "127.0.0.1:7001"
+        assert ejected["via"] == "dispatch"
+        events = [json.loads(ln)
+                  for ln in capsys.readouterr().out.splitlines()
+                  if '"event"' in ln]
+        assert [e["event"] for e in events] == [
+            "mesh_worker_registered", "mesh_worker_ejected",
+            "mesh_worker_readmitted"]
+    finally:
+        pool.close()
+
+
+def test_lifecycle_console_lines_byte_identical_in_text_mode(capsys):
+    """Default (text) mode keeps the PR-9 console grammar exactly --
+    the structured form is opt-in via HPNN_LOG_JSON."""
+    nn_log.set_verbosity(2)
+    pool = WorkerPool(eject_after=1)
+    try:
+        w = pool.register("127.0.0.1:7002")
+        pool.report_failure(w, ConnectionRefusedError("boom"))
+        pool.report_ok(w)
+        out = capsys.readouterr().out
+        assert "NN: mesh: worker 127.0.0.1:7002 registered\n" in out
+        assert ("NN(WARN): mesh: worker 127.0.0.1:7002 ejected "
+                "(ConnectionRefusedError: boom)\n") in out
+        assert "NN: mesh: worker 127.0.0.1:7002 readmitted\n" in out
+    finally:
+        pool.close()
+        nn_log.set_verbosity(0)
+
+
+def test_worker_heartbeat_advertises_jobs(tmp_path):
+    """Job traces are fleet-discoverable: the heartbeat names the
+    running job + its trace id in the router's worker table."""
+    pool = WorkerPool(eject_after=2)
+    try:
+        pool.register("127.0.0.1:7003", {"tiny": {"generation": 1}},
+                      jobs={"running": "job-000001",
+                            "trace": "job:job-000001", "queued": 0})
+        tbl = pool.table()
+        assert tbl["127.0.0.1:7003"]["jobs"]["trace"] == "job:job-000001"
+    finally:
+        pool.close()
+
+
+# --- post-mortem dumps (bugfix satellite) -----------------------------------
+
+def test_dump_filename_carries_role_and_collected_spans(tmp_path):
+    obs.enable(capacity=32)
+    obs_trace.set_role("router")
+    with obs.span("local_work"):
+        pass
+    remote = [_mk_span(1, name="remote_device", trace="t-r")]
+    remote[0]["host"] = "10.0.0.2:8001"
+    remote[0]["role"] = "worker"
+    path = obs.dump_to_dir(str(tmp_path), reason="shutdown",
+                           extra_spans=remote)
+    assert path is not None
+    assert os.path.basename(path) == \
+        f"trace-shutdown-router-{os.getpid()}.ndjson"
+    lines = [json.loads(ln) for ln in open(path).read().splitlines()]
+    names = {ln["name"] for ln in lines}
+    assert names == {"local_work", "remote_device"}
+    rd = next(ln for ln in lines if ln["name"] == "remote_device")
+    assert rd["host"] == "10.0.0.2:8001" and rd["role"] == "worker"
+    # role cleared: legacy filename back
+    obs_trace.set_role(None)
+    path2 = obs.dump_to_dir(str(tmp_path), reason="shutdown")
+    assert os.path.basename(path2) == \
+        f"trace-shutdown-{os.getpid()}.ndjson"
+
+
+# --- the acceptance e2e (slow): real subprocess mesh ------------------------
+
+@pytest.mark.slow
+def test_merged_cross_host_trace_e2e_with_worker_kill(tmp_path,
+                                                      monkeypatch):
+    """Acceptance: one trace id through a 2-subprocess-worker mesh
+    under load yields the COMPLETE merged route -> worker -> device
+    tree (host/role-tagged) from a single router GET -- including
+    after the worker that served it is SIGKILLed."""
+    # deep rings everywhere: the background load must not turn the
+    # recorder/store over faster than the test can assert (the workers
+    # inherit the env; ops would size these the same way on a real
+    # fleet under sustained traffic)
+    monkeypatch.setenv("HPNN_TRACE_BUFFER", "65536")
+    monkeypatch.setenv("HPNN_FLEET_TRACE_BUFFER", "65536")
+    conf = _write_kernel_conf(tmp_path)
+    rapp = ServeApp(max_batch=16, max_queue_rows=512, trace=True)
+    rapp.enable_mesh_router(required_workers=2, health_interval_s=0.2)
+    assert rapp.add_model(conf) is not None
+    rhttpd, _ = serve_in_thread("127.0.0.1", 0, rapp)
+    rport = rhttpd.server_address[1]
+    base = f"http://127.0.0.1:{rport}"
+    procs = []
+    stop = threading.Event()
+    try:
+        for _ in range(2):
+            procs.append(mesh_bench.spawn_worker(
+                conf, f"127.0.0.1:{rport}", ("--trace",)))
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            st, _ = serve_bench.http_json(base + "/healthz")
+            if st == 200:
+                break
+            time.sleep(0.1)
+        assert st == 200, "router never reached quorum"
+        xs = np.random.default_rng(3).uniform(-1, 1, (3, N_IN))
+
+        def hammer():  # background load: the tree must merge UNDER load
+            while not stop.is_set():
+                serve_bench.http_json(base + "/v1/kernels/tiny/infer",
+                                      {"inputs": xs.tolist()})
+                time.sleep(0.02)
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()},
+            headers={"X-HPNN-Trace-Id": "fleettrace01"})
+        assert st == 200 and body["trace"] == "fleettrace01"
+
+        def merged():
+            _, raw, _ = _get_raw(
+                base + "/v1/debug/trace?trace=fleettrace01")
+            return [json.loads(ln) for ln in raw.decode().splitlines()]
+
+        def worker_device_spans(spans):
+            return [s for s in spans if s["name"] == "device_launch"
+                    and s.get("role") == "worker"]
+
+        # the query-time drain pulls the worker's half within a poll
+        deadline = time.monotonic() + 30
+        spans = []
+        while time.monotonic() < deadline:
+            spans = merged()
+            if (any(s["name"] == "mesh.route" for s in spans)
+                    and worker_device_spans(spans)):
+                break
+            time.sleep(0.2)
+        names = {s["name"] for s in spans}
+        # router half AND worker half, one endpoint, one trace id
+        assert {"serve.request", "mesh.route", "queue_wait",
+                "device_launch"} <= names, names
+        routes = [s for s in spans if s["name"] == "mesh.route"]
+        assert routes and all(s["role"] == "router" for s in routes)
+        victim_addr = routes[0]["worker"]
+        wdev = worker_device_spans(spans)
+        assert wdev and all(s["host"] == victim_addr for s in wdev)
+        # kill the worker that served the traced request
+        victim = next(p for p, port in procs
+                      if victim_addr.endswith(f":{port}"))
+        victim.send_signal(signal.SIGKILL)
+        t_kill = time.monotonic()
+        while time.monotonic() - t_kill < 15.0:
+            tbl = rapp.mesh_router.pool.table()
+            if tbl.get(victim_addr, {}).get("state") == "dead":
+                break
+            time.sleep(0.05)
+        stop.set()
+        for t in threads:
+            t.join()
+        # the dead worker's spans are STILL in the merged tree
+        spans2 = merged()
+        wdev2 = worker_device_spans(spans2)
+        assert wdev2 and any(s["host"] == victim_addr for s in wdev2), \
+            "dead worker's spans were lost with it"
+        # federation marks the corpse as a gap, survivor still scraped
+        st, fed = serve_bench.http_json(
+            base + "/metrics?fleet=1&format=json")
+        assert st == 200
+        assert fed["workers"][victim_addr] is None
+        live_snaps = [s for s in fed["workers"].values() if s]
+        assert len(live_snaps) == 1
+        assert fed["rollup"]["requests"].get("ok", 0) == \
+            live_snaps[0]["requests"].get("ok", 0)
+    finally:
+        stop.set()
+        for proc, _port in procs:
+            if proc.poll() is None:
+                proc.kill()
+        rhttpd.shutdown()
+        rapp.close(drain=True)
