@@ -21,14 +21,15 @@ MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_tile_convergence.py
 SERVE_TESTS = tests/test_serve.py
 SERVE_MESH_TESTS = tests/test_mesh.py
+CHAOS_TESTS = tests/test_chaos.py
 CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
 OBS_TESTS = tests/test_obs.py tests/test_fleet_obs.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
-	    $(SERVE_MESH_TESTS) $(CKPT_TESTS) $(JOBS_TESTS) \
-	    $(OBS_TESTS) -q
+	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(CKPT_TESTS) \
+	    $(JOBS_TESTS) $(OBS_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -36,13 +37,26 @@ check:
 serve-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(SERVE_TESTS) -q
 
-# multi-host serve-mesh tier (ISSUE 9): QoS/pool/backend units + the
-# acceptance pins -- single-worker mesh byte-identical to the local
+# multi-host serve-mesh tier (ISSUE 9 + 11): QoS/pool/backend units +
+# the acceptance pins -- single-worker mesh byte-identical to the local
 # fast tier, worker-loss failover with zero non-200s, fleet-coherent
-# generation reload across two workers, quota/lane/deadline semantics.
-# The kill -9 subprocess e2e is slow-marked (runs here, not in tier 1)
+# generation reload across two workers (content-addressed blobs on
+# disjoint dirs), router standby takeover + heartbeat follow, spill
+# protection, quota/lane/deadline semantics.  The kill -9 subprocess
+# e2es (worker AND primary router) are slow-marked (run here, not in
+# tier 1)
 mesh-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(SERVE_MESH_TESTS) -q
+
+# fault-injection tier (ISSUE 11): chaos spec/schedule units, the
+# keep-alive transport (pool reuse, stale-socket retry, idle
+# retirement), jittered backoff, verified blob fetches, and the
+# TRANSPORT_ERRORS edge cases (IncompleteRead mid-body, reset after
+# request sent with idempotent retry-once, timeout during response
+# read) driven through a real 2-worker mesh.  Fast: also in `make
+# check`
+chaos-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(CHAOS_TESTS) -q
 
 # checkpoint tier: snapshot atomicity/retention units, serve hot reload,
 # the resume-parity e2e (kill-at-epoch-k + --resume == uninterrupted,
@@ -133,8 +147,12 @@ mfu-bench:
 	    $(if $(REAL),--real)
 
 # multi-host serve mesh: router overhead vs the single-process fast
-# tier, 2-worker scaling, and kill -9 failover (zero non-200 floor +
-# ejection latency); emits MESH_BENCH.json, rc!=0 when a floor misses.
+# tier, 2-worker scaling (+ keep-alive reuse ratio), retry-under-chaos
+# (paced injected resets, zero non-200 floor), kill -9 worker failover
+# (zero non-200 floor + ejection latency), and router-pair takeover
+# (kill -9 the PRIMARY; zero non-200 after the documented single
+# retry + takeover-latency floor); emits MESH_BENCH.json, rc!=0 when
+# a floor misses.
 # Default forces CPU everywhere; `make mesh-bench REAL=1` keeps the
 # ambient platform so the workers run on chips
 mesh-bench:
@@ -150,6 +168,6 @@ obs-bench:
 	python scripts/obs_bench.py --out OBS_BENCH.json \
 	    $(if $(REAL),--real)
 
-.PHONY: check check-all serve-check mesh-check ckpt-check ckpt-bench \
-    jobs-check jobs-bench obs-check obs-bench native bench serve-bench \
-    io-bench epoch-bench mfu-bench mesh-bench
+.PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
+    ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
+    serve-bench io-bench epoch-bench mfu-bench mesh-bench

@@ -556,16 +556,48 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     help="destination for POST /v1/debug/profile "
                     "jax.profiler captures (default: a fresh temp dir "
                     "per capture)")
-    ap.add_argument("--mesh-role", choices=("router", "worker"),
+    ap.add_argument("--mesh-role", choices=("router", "worker",
+                                            "standby"),
                     default=None,
                     help="multi-host serve mesh: 'router' fans infer "
                     "requests over registered worker hosts (no local "
                     "compute; /healthz warms until --workers N are "
                     "live); 'worker' serves normally AND registers "
-                    "with --router (heartbeat + generation catch-up)")
+                    "with --router (heartbeat + generation catch-up); "
+                    "'standby' passively mirrors --primary and takes "
+                    "over routing when the primary's health checks "
+                    "flatline")
     ap.add_argument("--router", default=None, metavar="HOST:PORT",
                     help="the router to register with (required for "
                     "--mesh-role worker)")
+    ap.add_argument("--standby", default=None, metavar="HOST:PORT",
+                    help="(router) advertise this standby address in "
+                    "every registration ack, so worker heartbeats "
+                    "fail over to it when this router dies")
+    ap.add_argument("--primary", default=None, metavar="HOST:PORT",
+                    help="(standby) the primary router to mirror and "
+                    "take over from (required for --mesh-role "
+                    "standby)")
+    ap.add_argument("--takeover-after", type=int, default=None,
+                    metavar="N",
+                    help="(standby) consecutive unreachable mirror "
+                    "polls before takeover (default "
+                    "$HPNN_MESH_TAKEOVER_AFTER or 3)")
+    ap.add_argument("--router-token", default=None, metavar="TOKEN",
+                    help="spill-protection token routers stamp on "
+                    "dispatch RPCs (X-HPNN-Router) and workers learn "
+                    "from the registration ack.  Default: "
+                    "$HPNN_MESH_ROUTER_TOKEN, else a random "
+                    "per-process one -- router PAIRS should share an "
+                    "explicit token (or an --auth-token, which lets "
+                    "the standby mirror it)")
+    ap.add_argument("--require-router", action="store_true",
+                    default=False,
+                    help="(worker) only serve infer traffic bearing "
+                    "the router's X-HPNN-Router token (403 otherwise) "
+                    "-- router-enforced per-client quotas cannot be "
+                    "bypassed by direct worker hits.  Default: "
+                    "$HPNN_MESH_REQUIRE_ROUTER=1")
     ap.add_argument("--advertise", default=None, metavar="HOST:PORT",
                     help="address the router should reach THIS worker "
                     "at (default: 127.0.0.1:<bound port>)")
@@ -626,6 +658,11 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                          "HOST:PORT (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    if args.mesh_role == "standby" and not args.primary:
+        sys.stderr.write("--mesh-role standby requires --primary "
+                         "HOST:PORT (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
     if args.slo_availability is not None \
             and not 0.0 <= args.slo_availability < 1.0:
         sys.stderr.write(f"--slo-availability must be in [0, 1): "
@@ -639,6 +676,10 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         return -1
     auth_token = args.auth_token or os.environ.get("HPNN_SERVE_TOKEN") \
         or None
+    router_token = args.router_token \
+        or os.environ.get("HPNN_MESH_ROUTER_TOKEN") or None
+    require_router = args.require_router \
+        or os.environ.get("HPNN_MESH_REQUIRE_ROUTER") == "1"
     # name this process's mesh role for post-mortem dump files
     # (trace-<reason>-<role>-<pid>.ndjson): a killed fleet's dumps must
     # be tellable apart without opening them
@@ -659,7 +700,8 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                    quota_rows=args.quota_rows,
                    quota_burst=args.quota_burst,
                    slo_p99_ms=args.slo_p99_ms,
-                   slo_availability=args.slo_availability)
+                   slo_availability=args.slo_availability,
+                   require_router=require_router)
     if args.mesh_role == "router":
         # before add_model: batchers are wired to the worker pool at
         # creation.  (A router never computes locally -- add_model
@@ -667,10 +709,26 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         # warmup_mode override is needed here.)
         app.enable_mesh_router(
             required_workers=max(1, args.workers),
-            health_interval_s=args.mesh_health_interval)
+            health_interval_s=args.mesh_health_interval,
+            standby_addr=args.standby,
+            router_token=router_token)
+        sby = f", standby {args.standby}" if args.standby else ""
         sys.stdout.write(f"SERVE: mesh router (quorum "
                          f"{max(1, args.workers)} worker(s); workers "
-                         "register via POST /v1/mesh/register)\n")
+                         f"register via POST /v1/mesh/register{sby})\n")
+    elif args.mesh_role == "standby":
+        # a full mesh router held passive: mirrors --primary and takes
+        # over when its health checks flatline
+        app.enable_mesh_standby(
+            args.primary,
+            required_workers=max(1, args.workers),
+            health_interval_s=args.mesh_health_interval,
+            router_token=router_token,
+            takeover_after=args.takeover_after)
+        sys.stdout.write(f"SERVE: mesh standby (mirroring "
+                         f"{args.primary}; takeover after "
+                         f"{app.mesh_standby.takeover_after} missed "
+                         "polls)\n")
     n_ok = 0
     for conf in args.confs:
         with phase("register"):

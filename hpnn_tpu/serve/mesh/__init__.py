@@ -30,6 +30,18 @@ instead of reassembling activations.
   eject/readmit/failover/reload broadcast) as console lines, structured
   ``nn_event`` records (``HPNN_LOG_JSON=1``) and flight-recorder spans
   under the ``mesh`` trace id.
+* :mod:`transport` -- the keep-alive RPC layer every mesh HTTP call
+  rides (ISSUE 11): pooled connections with liveness peeks, stale
+  keep-alive retry, jittered-exponential ``Backoff``, and verified
+  content-addressed blob fetches.
+* :mod:`chaos`    -- deterministic fault injection (``HPNN_FAULT``):
+  seeded/counted connection resets, latency, 5xx, truncated bodies
+  injected below every mesh RPC, so failover/retry/backoff paths are
+  testable instead of hoped-for.
+* :mod:`standby`  -- ``StandbyMonitor``: the passive router mirror
+  (worker table, kernel generations + blobs, spill token) that takes
+  over when the primary's health checks flatline -- the mesh's last
+  SPOF removed.
 
 Everything here is stdlib + numpy; jax is only ever touched by the
 workers' own registries.
@@ -39,12 +51,13 @@ from .backend import NoLiveWorker, RemoteBackend, RemoteHTTPError
 from .events import MESH_TRACE_ID, mesh_event
 from .fleet import FleetObserver
 from .qos import LANE_NAMES, LANES, QuotaTable, desired_workers
-from .router import MeshRouter, WorkerPool
+from .router import BlobStore, MeshRouter, WorkerPool
+from .standby import StandbyMonitor
 from .worker import WorkerAgent
 
 __all__ = [
     "NoLiveWorker", "RemoteBackend", "RemoteHTTPError",
     "LANES", "LANE_NAMES", "QuotaTable", "desired_workers",
-    "MeshRouter", "WorkerPool", "WorkerAgent",
-    "FleetObserver", "MESH_TRACE_ID", "mesh_event",
+    "MeshRouter", "WorkerPool", "WorkerAgent", "BlobStore",
+    "StandbyMonitor", "FleetObserver", "MESH_TRACE_ID", "mesh_event",
 ]

@@ -38,21 +38,18 @@ a merged dump shows route -> worker -> device.
 
 from __future__ import annotations
 
-import http.client
 import json
-import socket
 import time
 
 import numpy as np
 
 from ..batcher import DeadlineExceeded, QueueFull
 from ..registry import bucket_rows
+from . import transport
 
-# transport-level failures that mean "this worker is gone/unreachable"
-# (retry elsewhere), as opposed to an HTTP reply that means "the worker
-# answered and said no" (propagate)
-TRANSPORT_ERRORS = (ConnectionError, http.client.HTTPException,
-                    socket.timeout, TimeoutError, OSError)
+# re-exported for the rest of the mesh (router/worker/fleet import it
+# from here); the tuple itself lives with the transport layer now
+TRANSPORT_ERRORS = transport.TRANSPORT_ERRORS
 
 
 class RemoteHTTPError(Exception):
@@ -70,52 +67,38 @@ class NoLiveWorker(Exception):
     candidate already failed this dispatch)."""
 
 
+def _decode_json(raw: bytes) -> dict:
+    try:
+        decoded = json.loads(raw.decode("utf-8")) if raw else {}
+    except (UnicodeDecodeError, json.JSONDecodeError):
+        decoded = {}
+    return decoded if isinstance(decoded, dict) else {}
+
+
 def post_json(addr: str, path: str, payload: dict,
               timeout_s: float = 10.0,
               headers: dict | None = None) -> tuple[int, dict, bytes]:
-    """One stdlib HTTP POST to ``host:port``; returns (status, decoded
-    body, raw bytes).  Transport failures raise (TRANSPORT_ERRORS); any
-    HTTP status returns.  Fresh connection per call -- worker RPCs are
-    coalesced batches, so connection setup is amortized over the rows,
-    and a dead worker is detected at connect time instead of poisoning
-    a pooled socket."""
-    host, _, port = addr.rpartition(":")
-    conn = http.client.HTTPConnection(host or "127.0.0.1", int(port),
-                                      timeout=timeout_s)
-    try:
-        body = json.dumps(payload).encode("utf-8")
-        h = {"Content-Type": "application/json"}
-        if headers:
-            h.update(headers)
-        conn.request("POST", path, body=body, headers=h)
-        resp = conn.getresponse()
-        raw = resp.read()
-        try:
-            decoded = json.loads(raw.decode("utf-8")) if raw else {}
-        except (UnicodeDecodeError, json.JSONDecodeError):
-            decoded = {}
-        return resp.status, decoded, raw
-    finally:
-        conn.close()
+    """One HTTP POST to ``host:port`` through the mesh's keep-alive
+    transport (``mesh.transport``: pooled connections, stale-socket
+    retry, ``HPNN_FAULT`` chaos); returns (status, decoded body, raw
+    bytes).  Transport failures raise (TRANSPORT_ERRORS); any HTTP
+    status returns."""
+    body = json.dumps(payload).encode("utf-8")
+    h = {"Content-Type": "application/json"}
+    if headers:
+        h.update(headers)
+    status, raw, _ = transport.request(addr, "POST", path, body=body,
+                                       headers=h, timeout_s=timeout_s)
+    return status, _decode_json(raw), raw
 
 
 def get_json(addr: str, path: str,
              timeout_s: float = 5.0,
              headers: dict | None = None) -> tuple[int, dict]:
-    host, _, port = addr.rpartition(":")
-    conn = http.client.HTTPConnection(host or "127.0.0.1", int(port),
-                                      timeout=timeout_s)
-    try:
-        conn.request("GET", path, headers=headers or {})
-        resp = conn.getresponse()
-        raw = resp.read()
-        try:
-            decoded = json.loads(raw.decode("utf-8")) if raw else {}
-        except (UnicodeDecodeError, json.JSONDecodeError):
-            decoded = {}
-        return resp.status, decoded
-    finally:
-        conn.close()
+    status, raw, _ = transport.request(addr, "GET", path,
+                                       headers=headers,
+                                       timeout_s=timeout_s)
+    return status, _decode_json(raw)
 
 
 class _RemoteHandle:
@@ -191,6 +174,13 @@ class RemoteBackend:
 
         payload = {"inputs": xs.tolist()}
         headers = {}
+        token = getattr(self.pool, "router_token", None)
+        if token:
+            # spill protection: workers started with --require-router
+            # only serve infer traffic bearing the router's token, so
+            # per-client quotas enforced here cannot be bypassed by
+            # hitting a worker directly
+            headers["X-HPNN-Router"] = token
         if gen is not None:
             headers["X-HPNN-Generation"] = str(int(gen))
         if trace is not None:

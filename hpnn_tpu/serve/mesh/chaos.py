@@ -1,0 +1,232 @@
+"""Deterministic fault injection for the mesh HTTP plumbing.
+
+The mesh's resilience story -- standby takeover, retry-once-elsewhere,
+heartbeat backoff, blob re-fetch -- is exactly the code that never runs
+in a healthy test environment.  This module makes those paths
+*testable*: every mesh RPC (worker dispatch, heartbeat, health poll,
+blob fetch, fleet scrape -- everything that goes through
+``mesh.transport.request``) consults a process-global rule table and,
+on a DETERMINISTIC schedule, injects one of the failure modes a real
+fleet produces:
+
+  ========== ==========================================================
+  reset       ``ConnectionResetError`` before the request is sent (the
+              peer is gone; nothing reached it)
+  reset-after the request IS sent and processed, then the connection
+              resets before the response is read -- the case that makes
+              "retry-once is safe only because inference is idempotent"
+              a testable claim instead of a hope
+  timeout     ``socket.timeout`` during the response read (peer hung
+              after accepting the request)
+  truncate    ``http.client.IncompleteRead`` mid-body (proxy died, TCP
+              segment lost at the worst moment)
+  http        a fabricated 5xx reply (the peer answered and said no);
+              never reaches the network
+  latency     an injected delay before the request proceeds normally
+  ========== ==========================================================
+
+Spec grammar (``HPNN_FAULT`` env var, or :func:`configure`)::
+
+    spec  := rule (';' rule)*
+    rule  := kind ['@' substr] [':' key '=' val (',' key '=' val)*]
+    kind  := reset | reset-after | timeout | truncate | http | latency
+    keys  := after=N    skip the first N matching calls
+             every=N    then fire on every Nth matching call (default 1)
+             times=N    fire at most N times total (default unlimited)
+             gap_ms=F   never fire within F ms of this rule's previous
+                        injection (paces faults under load so recovery
+                        machinery gets its window; time-based, so
+                        schedules using it are paced rather than
+                        call-exact)
+             p=F        fire with probability F from the rule's SEEDED
+                        stream (deterministic given call order)
+             seed=N     the rule's RNG seed (default 0)
+             ms=F       latency: injected delay in milliseconds
+             code=N     http: fabricated status (default 503)
+
+``@substr`` restricts a rule to requests whose path contains the
+substring (e.g. ``reset@/infer:every=7``); rules are tried in spec
+order and at most ONE fires per request.  Counters are process-global,
+so ``after``/``every``/``times`` schedules are exact -- a test that
+says ``truncate@/infer:times=1`` gets exactly one truncated body and
+can assert what the retry machinery did about it.
+
+Zero cost when off: an unset ``HPNN_FAULT`` parses once to an empty
+table and every later :func:`pick` is a single attribute check.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+
+from ...utils.nn_log import nn_dbg, nn_warn
+
+KINDS = ("reset", "reset-after", "timeout", "truncate", "http",
+         "latency")
+
+_INT_KEYS = ("after", "every", "times", "seed", "code")
+_FLOAT_KEYS = ("p", "ms", "gap_ms")
+
+
+class FaultRule:
+    """One parsed rule + its live schedule state."""
+
+    __slots__ = ("kind", "match", "after", "every", "times", "p",
+                 "seed", "ms", "code", "gap_ms", "calls", "fired",
+                 "_rng", "_t_last_fire")
+
+    def __init__(self, kind: str, match: str | None = None,
+                 after: int = 0, every: int = 1, times: int = 0,
+                 p: float = 1.0, seed: int = 0, ms: float = 100.0,
+                 code: int = 503, gap_ms: float = 0.0):
+        if kind not in KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} "
+                             f"(one of {', '.join(KINDS)})")
+        if every < 1:
+            raise ValueError("every must be >= 1")
+        if not 0.0 <= p <= 1.0:
+            raise ValueError("p must be in [0, 1]")
+        self.kind = kind
+        self.match = match or None
+        self.after = int(after)
+        self.every = int(every)
+        self.times = int(times)      # 0 = unlimited
+        self.p = float(p)
+        self.seed = int(seed)
+        self.ms = float(ms)
+        self.code = int(code)
+        self.gap_ms = float(gap_ms)
+        self.calls = 0               # matching calls seen
+        self.fired = 0               # injections performed
+        self._rng = random.Random(self.seed)
+        self._t_last_fire: float | None = None
+
+    def should_fire(self, path: str) -> bool:
+        """Advance this rule's schedule for one matching call.  Caller
+        holds the module lock."""
+        if self.match is not None and self.match not in path:
+            return False
+        if self.times and self.fired >= self.times:
+            return False
+        self.calls += 1
+        if self.calls <= self.after:
+            return False
+        if (self.calls - self.after - 1) % self.every != 0:
+            return False
+        if self.gap_ms:
+            import time
+
+            now = time.monotonic()
+            if (self._t_last_fire is not None
+                    and (now - self._t_last_fire) * 1e3 < self.gap_ms):
+                return False
+        if self.p < 1.0 and self._rng.random() >= self.p:
+            return False
+        if self.gap_ms:
+            self._t_last_fire = now
+        self.fired += 1
+        return True
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "match": self.match,
+                "after": self.after, "every": self.every,
+                "times": self.times, "gap_ms": self.gap_ms,
+                "p": self.p, "seed": self.seed,
+                "calls": self.calls, "fired": self.fired}
+
+
+def parse_spec(spec: str) -> list[FaultRule]:
+    """Parse a fault spec (grammar in the module doc); raises
+    ValueError on anything malformed."""
+    rules: list[FaultRule] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        head, _, args = part.partition(":")
+        kind, _, match = head.partition("@")
+        kw: dict = {}
+        if args:
+            for item in args.split(","):
+                key, eq, val = item.strip().partition("=")
+                if not eq:
+                    raise ValueError(
+                        f"bad fault option {item!r} (want key=value)")
+                if key in _INT_KEYS:
+                    kw[key] = int(val)
+                elif key in _FLOAT_KEYS:
+                    kw[key] = float(val)
+                else:
+                    raise ValueError(f"unknown fault option {key!r}")
+        rules.append(FaultRule(kind.strip(), match.strip() or None,
+                               **kw))
+    return rules
+
+
+# --- process-global rule table ----------------------------------------------
+
+_lock = threading.Lock()
+_rules: list[FaultRule] | None = None   # None = env not consulted yet
+_armed = False
+
+
+def configure(spec: str | None) -> list[FaultRule]:
+    """Install a fault spec programmatically (tests, the chaos bench);
+    ``None``/empty disarms.  Returns the parsed rules."""
+    global _rules, _armed
+    rules = parse_spec(spec) if spec else []
+    with _lock:
+        _rules = rules
+        _armed = bool(rules)
+    if rules:
+        nn_dbg(f"chaos: armed with {len(rules)} rule(s): "
+               + "; ".join(r.kind + (f"@{r.match}" if r.match else "")
+                           for r in rules) + "\n")
+    return rules
+
+
+def reset() -> None:
+    """Disarm and forget (the env is re-consulted on next use)."""
+    global _rules, _armed
+    with _lock:
+        _rules = None
+        _armed = False
+
+
+def _configure_from_env() -> None:
+    import os
+
+    spec = os.environ.get("HPNN_FAULT", "")
+    try:
+        configure(spec)
+    except ValueError as exc:
+        # a typo'd knob must degrade to "no chaos", never kill a server
+        nn_warn(f"chaos: ignoring malformed HPNN_FAULT ({exc})\n")
+        configure(None)
+
+
+def pick(path: str) -> FaultRule | None:
+    """The transport layer's hook: the first rule whose schedule fires
+    for this request path, or None.  At most one rule fires per call."""
+    if _rules is None:
+        # first use: consult the env (racing parsers are idempotent)
+        _configure_from_env()
+    if not _armed:
+        return None
+    with _lock:
+        for rule in _rules or ():
+            if rule.should_fire(path):
+                nn_dbg(f"chaos: injecting {rule.kind} on {path} "
+                       f"(fired {rule.fired})\n")
+                return rule
+    return None
+
+
+def stats() -> dict:
+    """Injection accounting (the chaos bench row reads this)."""
+    with _lock:
+        rules = list(_rules or ())
+    return {"armed": _armed,
+            "injected_total": sum(r.fired for r in rules),
+            "rules": [r.to_dict() for r in rules]}

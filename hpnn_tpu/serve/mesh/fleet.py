@@ -36,7 +36,6 @@ pays nothing.
 
 from __future__ import annotations
 
-import http.client
 import json
 import socket
 import threading
@@ -46,6 +45,7 @@ from collections import deque
 from ...obs import trace as obs_trace
 from ...utils.env import env_float, env_int
 from ...utils.nn_log import nn_dbg
+from . import transport
 from .backend import TRANSPORT_ERRORS
 
 _DEFAULT_POLL_S = 2.0
@@ -54,19 +54,11 @@ _DEFAULT_CAPACITY = 4096
 
 def get_raw(addr: str, path: str, timeout_s: float = 5.0,
             headers: dict | None = None) -> tuple[int, bytes, dict]:
-    """One stdlib GET returning (status, raw body, response headers) --
-    the NDJSON trace endpoint is not JSON, so ``backend.get_json``
-    cannot fetch it."""
-    host, _, port = addr.rpartition(":")
-    conn = http.client.HTTPConnection(host or "127.0.0.1", int(port),
-                                      timeout=timeout_s)
-    try:
-        conn.request("GET", path, headers=headers or {})
-        resp = conn.getresponse()
-        raw = resp.read()
-        return resp.status, raw, dict(resp.getheaders())
-    finally:
-        conn.close()
+    """One GET returning (status, raw body, response headers) through
+    the mesh's keep-alive transport -- the NDJSON trace endpoint is not
+    JSON, so ``backend.get_json`` cannot fetch it."""
+    return transport.request(addr, "GET", path, headers=headers,
+                             timeout_s=timeout_s)
 
 
 class FleetObserver:

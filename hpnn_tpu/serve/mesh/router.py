@@ -29,8 +29,10 @@ generations under one label longer than the broadcast takes, and
 
 from __future__ import annotations
 
+import hashlib
 import threading
 import time
+from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
 from ...utils.env import env_int as _env_int
@@ -47,6 +49,58 @@ from .events import mesh_event
 STATE_LIVE = "live"
 STATE_WARMING = "warming"   # registered, /healthz still 503-warming
 STATE_DEAD = "dead"
+
+
+class BlobStore:
+    """Content-addressed kernel bytes the router serves at
+    ``GET /v1/mesh/blob/<sha256>`` (tentpole b): reload broadcasts and
+    registration acks carry ``{sha256, size}`` instead of a filesystem
+    path, and workers on DISJOINT filesystems pull the weights over
+    HTTP, verifying the hash on their side.  The sha256 is the same
+    digest ``ckpt/snapshot.py`` records in the checkpoint manifest
+    (the bytes are the ``kernel.opt`` text encoding), so a blob is
+    cross-checkable against the manifest that produced it.
+
+    Bounded LRU by total bytes (``HPNN_MESH_BLOB_CACHE_MB``, default
+    256): old generations age out; the CURRENT generation of every
+    served kernel is re-inserted on demand from the router's own source
+    file."""
+
+    def __init__(self, max_mb: int | None = None):
+        self.max_bytes = (max_mb if max_mb is not None
+                          else _env_int("HPNN_MESH_BLOB_CACHE_MB",
+                                        256)) * (1 << 20)
+        self._blobs: OrderedDict[str, bytes] = OrderedDict()
+        self._bytes = 0
+        self._lock = threading.Lock()
+
+    def put(self, data: bytes) -> dict:
+        """Insert (idempotent) and return the ``{sha256, size}`` meta
+        a broadcast/ack carries."""
+        sha = hashlib.sha256(data).hexdigest()
+        with self._lock:
+            if sha in self._blobs:
+                self._blobs.move_to_end(sha)
+            else:
+                self._blobs[sha] = data
+                self._bytes += len(data)
+                while (self._bytes > self.max_bytes
+                       and len(self._blobs) > 1):
+                    _old, dropped = self._blobs.popitem(last=False)
+                    self._bytes -= len(dropped)
+        return {"sha256": sha, "size": len(data)}
+
+    def get(self, sha: str) -> bytes | None:
+        with self._lock:
+            data = self._blobs.get(sha)
+            if data is not None:
+                self._blobs.move_to_end(sha)
+            return data
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"blobs": len(self._blobs), "bytes": self._bytes,
+                    "max_bytes": self.max_bytes}
 
 
 class Worker:
@@ -83,10 +137,15 @@ class Worker:
 
 class WorkerPool:
     def __init__(self, eject_after: int | None = None,
-                 auth_token: str | None = None):
+                 auth_token: str | None = None,
+                 router_token: str | None = None):
         self.eject_after = (eject_after if eject_after is not None
                             else _env_int("HPNN_MESH_EJECT_AFTER", 2))
         self.auth_token = auth_token
+        # the spill-protection token RemoteBackend stamps on every
+        # dispatch RPC (X-HPNN-Router); workers learn it from the
+        # registration ack
+        self.router_token = router_token
         self._workers: dict[str, Worker] = {}
         self._affinity: dict[tuple[str, int], str] = {}
         self._rr = 0
@@ -294,15 +353,35 @@ class WorkerPool:
 
 
 class MeshRouter:
-    """The app-facing coordinator: pool + fleet-coherent reload."""
+    """The app-facing coordinator: pool + fleet-coherent reload + the
+    content-addressed blob store.  ``standby_addr`` names this router's
+    health-checked standby (advertised to workers in every registration
+    ack, so their heartbeats know where to fail over); ``router_token``
+    is the spill-protection secret (minted when not supplied -- standby
+    pairs should share one via ``--router-token`` /
+    ``HPNN_MESH_ROUTER_TOKEN`` so takeover does not orphan
+    ``--require-router`` workers; the standby also adopts the
+    primary's token from the auth-guarded ``/v1/mesh/state`` mirror)."""
 
     def __init__(self, app, required: int = 1,
-                 health_interval_s: float = 1.0):
+                 health_interval_s: float = 1.0,
+                 standby_addr: str | None = None,
+                 router_token: str | None = None):
+        import secrets
+
         from .fleet import FleetObserver
 
         self.app = app
         self.required = max(1, int(required))
-        self.pool = WorkerPool(auth_token=app.auth_token)
+        self.standby_addr = standby_addr
+        self.router_token = router_token or secrets.token_hex(16)
+        self.blobs = BlobStore()
+        # per-kernel blob meta cache, keyed by the generation it was
+        # computed at: recomputed (one file read + hash) after a reload
+        self._blob_meta: dict[str, tuple[int, dict]] = {}
+        self._blob_lock = threading.Lock()
+        self.pool = WorkerPool(auth_token=app.auth_token,
+                               router_token=self.router_token)
         self.pool.start_health_loop(health_interval_s)
         # fleet observability (ISSUE 10): incremental worker-ring
         # collection + metrics federation; idle when tracing is off on
@@ -317,37 +396,121 @@ class MeshRouter:
     def backend_for(self, model) -> RemoteBackend:
         return RemoteBackend(self.pool, model)
 
+    def set_router_token(self, token: str) -> None:
+        """Adopt a (standby-mirrored) spill-protection token: future
+        dispatch RPCs and registration acks carry it."""
+        self.router_token = token
+        self.pool.router_token = token
+
     def close(self) -> None:
         self.fleet.close()
         self.pool.close()
+
+    # --- content-addressed weights (GET /v1/mesh/blob/<sha>) -------------
+    def blob_for(self, name: str) -> dict | None:
+        """The ``{sha256, size}`` meta of ``name``'s CURRENT weights,
+        inserting the bytes into the blob store on demand (reads the
+        model's source file once per generation).  None when the model
+        has no on-disk source to serve."""
+        model = self.app.registry.get(name)
+        if model is None:
+            return None
+        with self._blob_lock:
+            cached = self._blob_meta.get(name)
+            if (cached is not None and cached[0] == model.generation
+                    and self.blobs.get(cached[1]["sha256"])
+                    is not None):
+                # meta current AND the bytes still resident: an
+                # LRU-evicted blob must be re-read from source below,
+                # or the ack would advertise a sha this router 404s
+                return cached[1]
+            src = model.source
+            if not src:
+                return None
+            try:
+                with open(src, "rb") as fp:
+                    data = fp.read()
+            except OSError:
+                return None
+            meta = self.blobs.put(data)
+            self._blob_meta[name] = (model.generation, meta)
+            return meta
+
+    def blob_bytes(self, sha: str) -> bytes | None:
+        """The HTTP layer's lookup for ``GET /v1/mesh/blob/<sha>``; a
+        miss re-checks every served model's current source (an LRU
+        eviction or router restart must not 404 the fleet's CURRENT
+        generation)."""
+        data = self.blobs.get(sha)
+        if data is not None:
+            return data
+        for name in self.app.registry.names():
+            meta = self.blob_for(name)
+            if meta is not None and meta["sha256"] == sha:
+                return self.blobs.get(sha)
+        return None
 
     # --- registration (POST /v1/mesh/register) ---------------------------
     def register_worker(self, addr: str, kernels: dict | None,
                         jobs: dict | None = None) -> dict:
         self.pool.register(addr, kernels, jobs=jobs)
         # the ack tells the worker where the fleet SHOULD be: current
-        # generation + weights source per kernel, so an ejected/late
-        # worker catches itself up before taking traffic again
-        ack_kernels = {}
+        # generation + weights blob (and source path, for shared-mount
+        # fleets) per kernel, so an ejected/late worker catches itself
+        # up before taking traffic again -- plus the standby to follow
+        # on takeover and the spill-protection token
+        ack = {"ok": True, "live": self.pool.live_count(),
+               "required": self.required,
+               "kernels": self._kernel_state(),
+               "router_token": self.router_token}
+        if self.standby_addr:
+            ack["standby"] = self.standby_addr
+        return ack
+
+    def _kernel_state(self) -> dict:
+        state = {}
         for name in self.app.registry.names():
             model = self.app.registry.get(name)
-            if model is not None:
-                ack_kernels[name] = {"generation": model.generation,
-                                     "source": model.source}
-        return {"ok": True, "live": self.pool.live_count(),
-                "required": self.required, "kernels": ack_kernels}
+            if model is None:
+                continue
+            info = {"generation": model.generation,
+                    "source": model.source}
+            blob = self.blob_for(name)
+            if blob is not None:
+                info["blob"] = blob
+            state[name] = info
+        return state
+
+    # --- standby mirror (GET /v1/mesh/state) -----------------------------
+    def state_snapshot(self, include_token: bool = False) -> dict:
+        """What a standby needs to mirror: the worker table, per-kernel
+        generation + blob, and -- only on an AUTH-GUARDED request
+        (``include_token``) -- the spill-protection token, so an
+        unauthenticated client can never read the secret that
+        ``--require-router`` workers trust."""
+        snap = {"role": "router", "workers": self.pool.table(),
+                "kernels": self._kernel_state(),
+                "required": self.required}
+        if self.standby_addr:
+            snap["standby"] = self.standby_addr
+        if include_token:
+            snap["router_token"] = self.router_token
+        return snap
 
     # --- readiness (healthz quorum) --------------------------------------
     def readiness(self) -> dict:
         table = self.pool.table()
         live = sum(1 for w in table.values() if w["state"] == STATE_LIVE)
-        return {"role": "router", "required": self.required,
-                "live": live, "quorum": live >= self.required,
-                "workers": {wid: {"state": w["state"],
-                                  "inflight": w["inflight"],
-                                  "consecutive_failures":
-                                      w["consecutive_failures"]}
-                            for wid, w in table.items()}}
+        out = {"role": "router", "required": self.required,
+               "live": live, "quorum": live >= self.required,
+               "workers": {wid: {"state": w["state"],
+                                 "inflight": w["inflight"],
+                                 "consecutive_failures":
+                                     w["consecutive_failures"]}
+                           for wid, w in table.items()}}
+        if self.standby_addr:
+            out["standby"] = self.standby_addr
+        return out
 
     # --- fleet-coherent reload ------------------------------------------
     def coherent_reload(self, name: str,
@@ -382,6 +545,21 @@ class MeshRouter:
         if load_kernel(src) is None:
             raise ValueError(f"failed to load kernel from {src}")
         target = model.generation + 1
+        # content-addressed distribution (tentpole b): the broadcast
+        # carries {sha256, size} -- never a filesystem path -- and the
+        # workers pull the bytes from THIS router's blob endpoint,
+        # verifying the hash on their side.  That is what lets a fleet
+        # of cloud VMs with disjoint filesystems land one coherent
+        # reload.
+        try:
+            with open(src, "rb") as fp:
+                data = fp.read()
+        except OSError as exc:
+            raise ValueError(f"failed to read kernel bytes from {src}: "
+                             f"{exc}")
+        blob = self.blobs.put(data)
+        with self._blob_lock:
+            self._blob_meta[name] = (target, blob)
         ok_workers, failed = [], []
         headers = {}
         if self.app.auth_token:
@@ -392,7 +570,7 @@ class MeshRouter:
             try:
                 status, body, _ = post_json(
                     w.addr, f"/v1/kernels/{name}/reload",
-                    {"kernel": src, "set_generation": target},
+                    {"blob": blob, "set_generation": target},
                     timeout_s=30.0, headers=headers)
             except TRANSPORT_ERRORS as exc:
                 self.pool.report_failure(w, exc)
@@ -420,7 +598,8 @@ class MeshRouter:
                                       broadcast=False)
         result["mesh"] = {"target_generation": target,
                           "workers_reloaded": ok_workers,
-                          "workers_failed": failed}
+                          "workers_failed": failed,
+                          "blob": blob}
         return result
 
     # --- metrics ---------------------------------------------------------
@@ -429,9 +608,14 @@ class MeshRouter:
         by_state: dict[str, int] = {}
         for w in table.values():
             by_state[w["state"]] = by_state.get(w["state"], 0) + 1
+        from . import transport
+
         return {"role": "router", "required": self.required,
                 "live": by_state.get(STATE_LIVE, 0),
                 "workers_by_state": by_state,
                 "failovers_total": self.pool.failovers_total,
                 "workers": table,
-                "fleet_collector": self.fleet.stats()}
+                "fleet_collector": self.fleet.stats(),
+                "blobs": self.blobs.stats(),
+                "transport": transport.default_pool().stats(),
+                "standby": self.standby_addr}

@@ -1,0 +1,361 @@
+"""Keep-alive HTTP transport for the serve mesh.
+
+Every mesh RPC used to open a fresh TCP connection (PR 9's
+``post_json``): the worker dispatch path paid connect + slow-start per
+batch, the health loop per poll, the fleet collector per page.
+Communication-layer studies of distributed DNN stacks (Awan et al.,
+arXiv:1810.11112) put connection management squarely in the tail-latency
+budget -- so the mesh now speaks through ONE transport:
+
+* **connection pool** -- ``request()`` draws from a process-global pool
+  of keep-alive connections keyed by ``host:port``
+  (``HPNN_MESH_KEEPALIVE=0`` restores fresh-per-call).  Idle sockets
+  are liveness-checked at acquire (a peer that closed while we were
+  idle is detected by a zero-byte peek, not by a failed RPC) and
+  retired after ``HPNN_MESH_KEEPALIVE_IDLE_S`` unused; at most
+  ``HPNN_MESH_POOL_SIZE`` idle sockets are kept per peer.
+* **stale-connection retry** -- a REUSED socket that dies before the
+  status line arrives (``RemoteDisconnected``/reset/broken pipe at
+  send: the classic keep-alive race against the peer's idle timeout)
+  is retried ONCE on a fresh connection.  A failure after response
+  bytes arrived, or on a fresh connection, propagates -- those are real
+  transport errors the mesh's failover machinery must see.
+* **fault injection** -- every request consults :mod:`chaos`
+  (``HPNN_FAULT``), which is what makes the failover/retry/backoff
+  paths deterministic to test: the chaos layer injects its faults
+  HERE, below every caller.
+* **backoff** -- :class:`Backoff` is the shared jittered-exponential
+  schedule (worker heartbeat re-registration, blob re-fetch): bounded,
+  deadline-aware at the call sites, and jittered so a hundred workers
+  losing one router do not re-register in lockstep.
+* **blob fetch** -- :func:`fetch_blob` pulls a content-addressed weight
+  blob (``GET /v1/mesh/blob/<sha256>``) with bounded retries and
+  VERIFIES the sha256 before handing the bytes over -- a worker never
+  loads weights that do not hash to what the router announced.
+
+``TRANSPORT_ERRORS`` lives here (re-exported by :mod:`backend` for
+compatibility): the tuple of exception types that mean "the peer is
+gone/unreachable" as opposed to "the peer answered and said no".
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.client
+import os
+import random
+import socket
+import threading
+import time
+from collections import deque
+
+from ...utils.env import env_float, env_int
+from . import chaos
+
+# transport-level failures that mean "this peer is gone/unreachable"
+# (retry elsewhere / back off), as opposed to an HTTP reply that means
+# "the peer answered and said no" (propagate)
+TRANSPORT_ERRORS = (ConnectionError, http.client.HTTPException,
+                    socket.timeout, TimeoutError, OSError)
+
+# a REUSED keep-alive socket failing before any response byte: the peer
+# closed it while idle -- retry once on a fresh connection (the request
+# either never left or never reached an intact peer; mesh RPCs are
+# idempotent regardless, see backend.py)
+_STALE_ERRORS = (http.client.RemoteDisconnected, ConnectionResetError,
+                 BrokenPipeError, ConnectionAbortedError)
+
+
+def split_addr(addr: str) -> tuple[str, int]:
+    host, _, port = addr.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _sock_alive(sock) -> bool:
+    """A zero-byte peek on an IDLE socket: readable + empty means the
+    peer sent FIN while we were away -- retire it before an RPC trips
+    over the corpse."""
+    import select
+
+    try:
+        readable, _, _ = select.select([sock], [], [], 0)
+        if not readable:
+            return True  # nothing pending: still open
+        return sock.recv(1, socket.MSG_PEEK) != b""
+    except OSError:
+        return False
+
+
+class ConnectionPool:
+    """Keep-alive ``http.client`` connections keyed by peer address.
+
+    Thread-safe; concurrency above the idle cap simply creates fresh
+    connections (and pools the first ``max_idle`` back).  The pool never
+    blocks waiting for a socket."""
+
+    def __init__(self, max_idle: int | None = None,
+                 idle_timeout_s: float | None = None,
+                 enabled: bool | None = None):
+        self.max_idle = (max_idle if max_idle is not None
+                         else env_int("HPNN_MESH_POOL_SIZE", 8))
+        self.idle_timeout_s = (
+            idle_timeout_s if idle_timeout_s is not None
+            else env_float("HPNN_MESH_KEEPALIVE_IDLE_S", 30.0))
+        self.enabled = (enabled if enabled is not None
+                        else env_int("HPNN_MESH_KEEPALIVE", 1) != 0)
+        self._idle: dict[str, deque] = {}   # addr -> (conn, t_mono)
+        self._lock = threading.Lock()
+        self.reused_total = 0
+        self.fresh_total = 0
+        self.retired_total = 0
+
+    def acquire(self, addr: str, timeout_s: float,
+                fresh: bool = False
+                ) -> tuple[http.client.HTTPConnection, bool]:
+        """A connection to ``addr`` with its timeout set: (conn,
+        reused).  Reused connections were liveness-peeked;
+        ``fresh=True`` bypasses the idle bucket entirely (the
+        stale-retry path must never draw a SECOND pooled corpse)."""
+        now = time.monotonic()
+        while self.enabled and not fresh:
+            with self._lock:
+                bucket = self._idle.get(addr)
+                entry = bucket.popleft() if bucket else None
+            if entry is None:
+                break
+            conn, t_idle = entry
+            if (now - t_idle > self.idle_timeout_s
+                    or conn.sock is None
+                    or not _sock_alive(conn.sock)):
+                with self._lock:
+                    self.retired_total += 1
+                conn.close()
+                continue
+            conn.timeout = timeout_s
+            conn.sock.settimeout(timeout_s)
+            with self._lock:
+                self.reused_total += 1
+            return conn, True
+        host, port = split_addr(addr)
+        with self._lock:
+            self.fresh_total += 1
+        return http.client.HTTPConnection(host, port,
+                                          timeout=timeout_s), False
+
+    def release(self, addr: str, conn) -> None:
+        """Return a connection whose response was FULLY read."""
+        if not self.enabled or conn.sock is None:
+            conn.close()
+            return
+        with self._lock:
+            bucket = self._idle.setdefault(addr, deque())
+            if len(bucket) < self.max_idle:
+                bucket.append((conn, time.monotonic()))
+                return
+        conn.close()
+
+    def discard(self, conn) -> None:
+        conn.close()
+
+    def stats(self) -> dict:
+        with self._lock:
+            idle = sum(len(b) for b in self._idle.values())
+            reused, fresh = self.reused_total, self.fresh_total
+            retired = self.retired_total
+        total = reused + fresh
+        return {"enabled": self.enabled,
+                "reused_total": reused,
+                "fresh_total": fresh,
+                "retired_total": retired,
+                "idle": idle,
+                "reuse_ratio": round(reused / total, 4)
+                if total else 0.0}
+
+    def close(self) -> None:
+        with self._lock:
+            buckets = list(self._idle.values())
+            self._idle.clear()
+        for b in buckets:
+            for conn, _t in b:
+                conn.close()
+
+
+_default_pool: ConnectionPool | None = None
+_default_pool_lock = threading.Lock()
+
+
+def default_pool() -> ConnectionPool:
+    global _default_pool
+    if _default_pool is None:
+        with _default_pool_lock:
+            if _default_pool is None:
+                _default_pool = ConnectionPool()
+    return _default_pool
+
+
+def request(addr: str, method: str, path: str,
+            body: bytes | None = None,
+            headers: dict | None = None,
+            timeout_s: float = 10.0,
+            pool: ConnectionPool | None = None
+            ) -> tuple[int, bytes, dict]:
+    """One mesh RPC through the keep-alive pool (+ chaos): returns
+    (status, raw body bytes, response headers).  Transport failures
+    raise (``TRANSPORT_ERRORS``); any HTTP status returns."""
+    pool = pool or default_pool()
+    rule = chaos.pick(path)
+    if rule is not None:
+        if rule.kind == "latency":
+            time.sleep(rule.ms / 1e3)
+        elif rule.kind == "reset":
+            raise ConnectionResetError(
+                "chaos: injected connection reset (pre-send)")
+        elif rule.kind == "http":
+            raw = (b'{"error": "chaos: injected HTTP %d", '
+                   b'"reason": "chaos"}' % rule.code)
+            return rule.code, raw, {}
+    h = dict(headers or {})
+    last_exc: Exception | None = None
+    for attempt in (0, 1):
+        conn, reused = pool.acquire(addr, timeout_s,
+                                    fresh=attempt > 0)
+        try:
+            conn.request(method, path, body=body, headers=h)
+            resp = conn.getresponse()
+            raw = resp.read()
+        except _STALE_ERRORS as exc:
+            pool.discard(conn)
+            if reused and attempt == 0:
+                # keep-alive race: the peer idled this socket out under
+                # us before the status line -- one fresh-connection retry
+                last_exc = exc
+                continue
+            raise
+        except BaseException:
+            pool.discard(conn)
+            raise
+        if rule is not None and rule.kind in ("reset-after", "timeout",
+                                              "truncate"):
+            # post-send faults: the peer DID process the request; the
+            # failure is losing the answer.  The socket's framing is a
+            # lie from here on, so never pool it.
+            pool.discard(conn)
+            if rule.kind == "reset-after":
+                raise ConnectionResetError(
+                    "chaos: injected reset after request sent")
+            if rule.kind == "timeout":
+                raise socket.timeout(
+                    "chaos: injected timeout during response read")
+            if rule.kind == "truncate":
+                raise http.client.IncompleteRead(
+                    raw[:len(raw) // 2], len(raw) - len(raw) // 2)
+        resp_headers = dict(resp.getheaders())
+        if resp.will_close:
+            pool.discard(conn)
+        else:
+            pool.release(addr, conn)
+        return resp.status, raw, resp_headers
+    raise last_exc  # pragma: no cover - loop always returns or raises
+
+
+class Backoff:
+    """Jittered exponential backoff: ``base * factor^n`` capped at
+    ``cap``, each delay multiplied by ``1 ± jitter`` so a fleet of
+    retriers decorrelates.  Callers sleep; this only does arithmetic."""
+
+    def __init__(self, base_s: float = 0.5, cap_s: float = 30.0,
+                 factor: float = 2.0, jitter: float = 0.25,
+                 rng: random.Random | None = None):
+        self.base_s = float(base_s)
+        self.cap_s = float(cap_s)
+        self.factor = float(factor)
+        self.jitter = float(jitter)
+        self._rng = rng or random.Random()
+        self._n = 0
+
+    @property
+    def failures(self) -> int:
+        return self._n
+
+    def next_delay(self) -> float:
+        d = min(self.cap_s, self.base_s * (self.factor ** self._n))
+        self._n += 1
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, d)
+
+    def reset(self) -> None:
+        self._n = 0
+
+
+class BlobError(Exception):
+    """A content-addressed blob could not be fetched or failed its
+    sha256/size verification."""
+
+
+def fetch_blob(addr: str, sha256: str, size: int | None,
+               dest_dir: str, timeout_s: float = 15.0,
+               headers: dict | None = None,
+               attempts: int = 3) -> str:
+    """Pull ``GET /v1/mesh/blob/<sha256>`` from ``addr``, VERIFY the
+    bytes hash to ``sha256`` (and match ``size`` when given), and
+    atomically write them to ``dest_dir/<sha256>.opt``.  Returns the
+    local path.  Retries transport failures/5xx with jittered backoff,
+    bounded by ``attempts`` and the ``timeout_s`` deadline; raises
+    :class:`BlobError` when the blob cannot be landed.
+
+    Content addressing makes this idempotent: a file already present
+    under the right name is re-verified and reused, so concurrent
+    reload broadcasts for one generation fetch once."""
+    if not sha256 or not all(c in "0123456789abcdef"
+                             for c in sha256.lower()):
+        raise BlobError(f"bad sha256 {sha256!r}")
+    sha256 = sha256.lower()
+    dest = os.path.join(dest_dir, f"{sha256}.opt")
+    if os.path.isfile(dest):
+        with open(dest, "rb") as fp:
+            if hashlib.sha256(fp.read()).hexdigest() == sha256:
+                return dest
+    deadline = time.monotonic() + timeout_s
+    backoff = Backoff(base_s=0.2, cap_s=5.0)
+    last = "no attempt made"
+    for i in range(max(1, attempts)):
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        if i:
+            delay = min(backoff.next_delay(), max(0.0, remaining))
+            time.sleep(delay)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+        try:
+            status, raw, _ = request(
+                addr, "GET", f"/v1/mesh/blob/{sha256}",
+                headers=headers, timeout_s=remaining)
+        except TRANSPORT_ERRORS as exc:
+            last = f"{type(exc).__name__}: {exc}"
+            continue
+        if status != 200:
+            last = f"HTTP {status}"
+            if status == 404:
+                # the router does not have it; retrying cannot help
+                raise BlobError(
+                    f"blob {sha256} not found on {addr}")
+            continue
+        actual = hashlib.sha256(raw).hexdigest()
+        if actual != sha256:
+            # corruption in flight (or a lying peer): retryable, but
+            # NEVER loadable
+            last = f"sha256 mismatch (got {actual[:12]}...)"
+            continue
+        if size is not None and len(raw) != int(size):
+            last = f"size mismatch ({len(raw)} != {size})"
+            continue
+        from ...io.atomic import atomic_write_bytes
+
+        os.makedirs(dest_dir, exist_ok=True)
+        atomic_write_bytes(dest, raw)
+        return dest
+    raise BlobError(f"blob {sha256} from {addr}: giving up after "
+                    f"{attempts} attempt(s) ({last})")

@@ -4,16 +4,28 @@ A mesh worker IS a complete single-process server (same registry,
 batcher, tiers, metrics, tracing) -- the only worker-specific machinery
 is this agent, which on a daemon loop
 
-1. POSTs ``/v1/mesh/register`` to the router every
-   ``HPNN_MESH_HEARTBEAT_S`` seconds, advertising its address and the
-   per-kernel weights generation it currently serves (the router's
-   placement prefers generation-matched workers);
-2. reads the router's ack -- the fleet's CURRENT generation + weights
-   source per kernel -- and catches itself up when it is BEHIND
-   (reload at the router's ``set_generation``): that is how an ejected
-   or freshly restarted worker rejoins at the right weights without any
-   operator action.  A worker AHEAD of the router (the window between a
-   broadcast landing here and the router's own flip) never rolls back.
+1. POSTs ``/v1/mesh/register`` to its current router every
+   ``HPNN_MESH_HEARTBEAT_S`` seconds -- JITTERED (x0.8-1.2) so a fleet
+   of workers does not heartbeat in lockstep -- advertising its address
+   and the per-kernel weights generation it currently serves;
+2. reads the router's ack -- the fleet's CURRENT generation plus the
+   content-addressed weights blob (and source path, for shared-mount
+   fleets) per kernel -- and catches itself up when it is BEHIND:
+   the blob is pulled from the router over HTTP and sha256-verified,
+   so a worker on a DISJOINT filesystem rejoins at the right weights
+   with no shared mount and no operator action.  A worker AHEAD of the
+   router (the window between a broadcast landing here and the
+   router's own flip) never rolls back;
+3. on registration failure BACKS OFF exponentially (jittered, capped
+   at ``HPNN_MESH_HEARTBEAT_CAP_S``) instead of tight-looping log spam
+   against a dead router, and -- when the ack ever named a standby --
+   ALTERNATES between the primary and the standby, so heartbeats land
+   on whichever router survives a takeover within a few backoff steps.
+
+The ack also carries the router's spill-protection token
+(``X-HPNN-Router``): a worker started with ``--require-router`` only
+serves infer traffic stamped with it, so per-client quotas enforced at
+the router cannot be bypassed by hitting the worker directly.
 
 The agent also flips ``registry.retain_generations`` on: mesh reloads
 must keep previous generations pinnable, or ``X-HPNN-Generation``
@@ -22,11 +34,16 @@ through the router would silently fall back to current weights.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import random
+import tempfile
 import threading
 import time
 
+from ...utils.env import env_float
 from ...utils.nn_log import nn_warn
+from . import transport
 from .backend import TRANSPORT_ERRORS, post_json
 from .events import mesh_event
 
@@ -39,26 +56,58 @@ def _heartbeat_s(default: float = 2.0) -> float:
         return default
 
 
+def _path_matches_blob(path: str, blob: dict) -> bool:
+    """Does the file at ``path`` already hold exactly the announced
+    bytes?  Shared-mount fleets short-circuit the HTTP fetch this way;
+    a same-named but DIFFERENT file on a disjoint host does not."""
+    try:
+        with open(path, "rb") as fp:
+            return (hashlib.sha256(fp.read()).hexdigest()
+                    == str(blob.get("sha256", "")).lower())
+    except OSError:
+        return False
+
+
 class WorkerAgent:
     def __init__(self, app, router_addr: str, advertise_addr: str,
-                 interval_s: float | None = None):
+                 interval_s: float | None = None,
+                 blob_dir: str | None = None):
         self.app = app
-        self.router_addr = router_addr
+        self.router_addr = router_addr   # the configured primary
+        self.standby: str | None = None  # learned from the ack
+        self.current = router_addr       # where heartbeats go NOW
         self.advertise = advertise_addr
         self.interval_s = (interval_s if interval_s is not None
                            else _heartbeat_s())
+        self.router_token: str | None = None  # spill-protection secret
+        # local home for fetched content-addressed blobs: per-process
+        # by default so two workers on one host never race a file
+        self.blob_dir = blob_dir \
+            or os.environ.get("HPNN_MESH_BLOB_DIR") \
+            or os.path.join(tempfile.gettempdir(),
+                            f"hpnn-blobs-{os.getpid()}")
         self.registered = False
         self._closed = False
         self._thread: threading.Thread | None = None
         self._warned = False
+        self._rng = random.Random()
+        # registration-failure backoff: base = one heartbeat period,
+        # capped so a long-dead router costs one probe per cap period
+        self._backoff = transport.Backoff(
+            base_s=self.interval_s,
+            cap_s=env_float("HPNN_MESH_HEARTBEAT_CAP_S", 30.0),
+            rng=self._rng)
         # previous generations must stay pinnable through mesh reloads
         app.registry.retain_generations = True
 
     # --- one heartbeat ---------------------------------------------------
     def beat(self) -> bool:
-        """Register/heartbeat once; returns True when the router acked.
-        Catch-up reloads run inline (they are rare and the loop is a
-        daemon thread, not a request path)."""
+        """Register/heartbeat once against ``self.current``; returns
+        True when that router acked.  Catch-up reloads run inline (they
+        are rare and the loop is a daemon thread, not a request path).
+        On failure the target alternates to the other router of the
+        pair (when one is known) so a takeover is followed without any
+        push channel."""
         kernels = {}
         for name in self.app.registry.names():
             model = self.app.registry.get(name)
@@ -79,31 +128,56 @@ class WorkerAgent:
             # `?trace=job:<id>` on the router finds the right worker's
             # spans without asking every host
             payload["jobs"] = self.app.jobs.active()
+        target = self.current
         try:
             status, ack, _ = post_json(
-                self.router_addr, "/v1/mesh/register",
+                target, "/v1/mesh/register",
                 payload, timeout_s=5.0, headers=headers)
         except TRANSPORT_ERRORS as exc:
             if not self._warned:
-                # once, not every 2s: the router may simply start later
-                nn_warn(f"mesh: cannot reach router "
-                        f"{self.router_addr} ({exc}); retrying every "
-                        f"{self.interval_s:g}s\n")
+                # once, not every beat: the router may simply start
+                # later (and the loop is backing off anyway)
+                nn_warn(f"mesh: cannot reach router {target} ({exc}); "
+                        "retrying with backoff\n")
                 self._warned = True
-            self.registered = False
+            self._register_failed(target)
             return False
         if status != 200:
-            if not self._warned:
-                nn_warn(f"mesh: router {self.router_addr} rejected "
-                        f"registration (HTTP {status}: "
-                        f"{ack.get('error')})\n")
+            if (ack.get("reason") != "standby_passive"
+                    and not self._warned):
+                nn_warn(f"mesh: router {target} rejected registration "
+                        f"(HTTP {status}: {ack.get('error')})\n")
                 self._warned = True
-            self.registered = False
+            # a passive standby saying "not yet" is expected while the
+            # primary lives: alternate straight back
+            self._register_failed(target)
             return False
         self._warned = False
         self.registered = True
+        self._backoff.reset()
+        standby = ack.get("standby")
+        if isinstance(standby, str) and standby:
+            self.standby = standby
+        token = ack.get("router_token")
+        if isinstance(token, str) and token:
+            self.router_token = token
         self._catch_up(ack.get("kernels") or {})
         return True
+
+    def _register_failed(self, target: str) -> None:
+        self.registered = False
+        if self.standby is not None:
+            # alternate within the pair: after a takeover the survivor
+            # answers within one flip (plus the backoff delay)
+            other = (self.standby if target == self.router_addr
+                     else self.router_addr)
+            if other and other != target:
+                self.current = other
+                mesh_event("worker_router_switch",
+                           f"mesh: heartbeat switching to {other} "
+                           f"(after failure against {target})\n",
+                           level="dbg", worker=self.advertise,
+                           target=other, failed=target)
 
     def _catch_up(self, ack_kernels: dict) -> None:
         for name, info in ack_kernels.items():
@@ -112,19 +186,42 @@ class WorkerAgent:
                 continue
             want = info.get("generation")
             src = info.get("source")
-            if not isinstance(want, int) or not src:
+            blob = info.get("blob")
+            if not isinstance(want, int):
                 continue
             if model.generation >= want:
                 continue  # current, or ahead mid-broadcast: never back
-            if not os.path.exists(src):
+            path = None
+            if isinstance(blob, dict) and blob.get("sha256"):
+                if (src and os.path.exists(src)
+                        and _path_matches_blob(src, blob)):
+                    path = src  # shared mount: the bytes are local
+                else:
+                    headers = None
+                    if self.app.auth_token:
+                        headers = {"Authorization":
+                                   f"Bearer {self.app.auth_token}"}
+                    try:
+                        path = transport.fetch_blob(
+                            self.current, str(blob["sha256"]),
+                            blob.get("size"), self.blob_dir,
+                            timeout_s=20.0, headers=headers)
+                    except transport.BlobError as exc:
+                        nn_warn(f"mesh: cannot catch '{name}' up to "
+                                f"generation {want}: {exc}\n")
+                        continue
+            elif src and os.path.exists(src):
+                path = src  # pre-blob router: trust the shared mount
+            if path is None:
                 nn_warn(f"mesh: cannot catch '{name}' up to generation "
-                        f"{want}: {src} not readable from this host\n")
+                        f"{want}: no blob announced and {src!r} not "
+                        "readable from this host\n")
                 continue
             try:
-                self.app.reload_model(name, src, set_generation=want)
+                self.app.reload_model(name, path, set_generation=want)
                 mesh_event("worker_catch_up",
                            f"mesh: caught '{name}' up to generation "
-                           f"{want} from {src}\n",
+                           f"{want} from {path}\n",
                            level="dbg", kernel=name, generation=want,
                            worker=self.advertise)
             except (ValueError, KeyError) as exc:
@@ -132,11 +229,19 @@ class WorkerAgent:
                         f"{exc}\n")
 
     # --- lifecycle -------------------------------------------------------
+    def next_delay(self, ok: bool) -> float:
+        """The loop's sleep after one beat: a jittered heartbeat period
+        in steady state, the (jittered, capped) exponential backoff
+        schedule while registration keeps failing."""
+        if ok:
+            return self.interval_s * self._rng.uniform(0.8, 1.2)
+        return max(self.interval_s * 0.25, self._backoff.next_delay())
+
     def start(self) -> "WorkerAgent":
         def loop():
             while not self._closed:
-                self.beat()
-                time.sleep(self.interval_s)
+                ok = self.beat()
+                time.sleep(self.next_delay(ok))
 
         self._thread = threading.Thread(
             target=loop, name="hpnn-mesh-worker", daemon=True)
@@ -148,6 +253,10 @@ class WorkerAgent:
 
     def info(self) -> dict:
         """What the worker's /healthz reports under ``mesh``."""
-        return {"role": "worker", "router": self.router_addr,
-                "advertise": self.advertise,
-                "registered": self.registered}
+        out = {"role": "worker", "router": self.router_addr,
+               "current_router": self.current,
+               "advertise": self.advertise,
+               "registered": self.registered}
+        if self.standby is not None:
+            out["standby"] = self.standby
+        return out

@@ -72,10 +72,25 @@ Endpoints:
   finalize its A/B window.
 * ``POST /v1/mesh/register`` -- a mesh worker's registration heartbeat
   (``serve_nn --mesh-role worker``); the router's ack carries the
-  fleet's current weights generation + source per kernel so late
-  workers catch themselves up.  503 on a server without a router role.
+  fleet's current weights generation + content-addressed blob (and
+  source path) per kernel so late workers catch themselves up, plus
+  the standby address to fail heartbeats over to and the
+  spill-protection router token.  503 on a server without a router
+  role, or on a PASSIVE standby (``standby_passive`` -- the primary
+  still owns the fleet).
 * ``GET /v1/mesh/workers`` -- the router's worker table (state,
   in-flight depth, routed counts, per-kernel generations).
+* ``GET /v1/mesh/blob/<sha256>`` -- content-addressed kernel bytes
+  (the blob a reload broadcast / registration ack names): workers on
+  disjoint filesystems pull weights here and verify the sha256
+  client-side.  404 for unknown hashes; when an auth token is
+  configured the weights sit behind it (workers and the standby stamp
+  every fetch).
+* ``GET /v1/mesh/state`` -- the standby's mirror feed: worker table,
+  per-kernel generation + blob meta, plus the spill-protection token.
+  Requires the auth token whenever one is configured; with auth off
+  the endpoint is open but the token is omitted (a public secret
+  protects nothing).
 
 QoS request headers (honored by every server; the mesh router is where
 they matter most):
@@ -100,14 +115,18 @@ Status mapping (distinct by failure class, so clients can react):
   202   training job accepted (queued)
   400   malformed body / wrong input width / too many rows
   401   missing/invalid auth token on a mutating endpoint
-  404   unknown kernel / job / pinned generation
+  403   infer traffic without the router's ``X-HPNN-Router`` token
+        on a ``--require-router`` worker (spill protection)
+  404   unknown kernel / job / pinned generation / blob hash
   409   reload failed / job action in a conflicting state
   429   queue full or quota exceeded (backpressure -- the
         Retry-After header is computed from the queue's measured
         drain rate / the quota bucket's refill rate)
   501   device profiler unavailable on this host/backend
   503   server draining (shutdown in progress) / jobs disabled /
-        no live mesh worker
+        no live mesh worker / passive standby (``standby_passive``:
+        the client's documented move is ONE retry against the
+        other router of the pair)
   504   deadline exceeded (admission, queued, or computed past the
         per-request deadline)
   ====  ==========================================================
@@ -144,6 +163,7 @@ _JOB_RE = re.compile(r"^/v1/jobs/([^/]+)$")
 _JOB_EVENTS_RE = re.compile(r"^/v1/jobs/([^/]+)/events$")
 _JOB_ACTION_RE = re.compile(
     r"^/v1/jobs/([^/]+)/(cancel|promote|rollback)$")
+_BLOB_RE = re.compile(r"^/v1/mesh/blob/([0-9a-f]{64})$")
 
 
 class _HTTPError(Exception):
@@ -222,9 +242,15 @@ class ServeApp:
                  quota_rows: float = 0.0,
                  quota_burst: float | None = None,
                  slo_p99_ms: float | None = None,
-                 slo_availability: float | None = None):
+                 slo_availability: float | None = None,
+                 require_router: bool = False):
         self.metrics = metrics or ServeMetrics()
         self.auth_token = auth_token or None
+        # spill protection (worker-side): only serve infer traffic
+        # stamped with the router's X-HPNN-Router token (learned from
+        # the registration ack), so router-enforced per-client quotas
+        # cannot be bypassed by hitting this worker directly
+        self.require_router = bool(require_router)
         # SLO tracking (ISSUE 10): constructed only when an objective
         # is configured -- the off path is `self.slo is None`
         self.slo = None
@@ -237,6 +263,7 @@ class ServeApp:
         self.jobs = None  # JobScheduler once enable_jobs() runs
         self.mesh_router = None  # MeshRouter once enable_mesh_router()
         self.mesh_worker = None  # WorkerAgent when serving as a worker
+        self.mesh_standby = None  # StandbyMonitor on a standby router
         # per-client token-bucket quotas (rows/sec; 0 = no quota)
         self.quota = (mesh_qos.QuotaTable(quota_rows, quota_burst)
                       if quota_rows and quota_rows > 0 else None)
@@ -362,6 +389,8 @@ class ServeApp:
             self.jobs.drain()
         if self.mesh_worker is not None:
             self.mesh_worker.close()
+        if self.mesh_standby is not None:
+            self.mesh_standby.close()
         for b in self.batchers.values():
             b.close(drain=drain)
         if self.mesh_router is not None:
@@ -410,13 +439,18 @@ class ServeApp:
 
     # --- multi-host serve mesh ------------------------------------------
     def enable_mesh_router(self, required_workers: int = 1,
-                           health_interval_s: float = 1.0):
+                           health_interval_s: float = 1.0,
+                           standby_addr: str | None = None,
+                           router_token: str | None = None):
         """Turn this app into a mesh ROUTER (``serve_nn --mesh-role
         router``): models registered after this call get a
         ``RemoteBackend`` that fans their batches over the worker pool,
         /healthz reports ``warming`` until a quorum of workers is live,
         and reloads become fleet-coherent broadcasts.  Must run before
-        ``add_model`` -- the backend is wired at batcher creation."""
+        ``add_model`` -- the backend is wired at batcher creation.
+        ``standby_addr`` advertises this router's standby to workers;
+        ``router_token`` pins the spill-protection secret (default: a
+        random per-process one)."""
         from .mesh.router import MeshRouter
 
         if self.batchers:
@@ -425,9 +459,39 @@ class ServeApp:
                                "batcher creation)")
         self.mesh_router = MeshRouter(
             self, required=required_workers,
-            health_interval_s=health_interval_s)
+            health_interval_s=health_interval_s,
+            standby_addr=standby_addr,
+            router_token=router_token)
         self.metrics.set_mesh_source(self.mesh_router.metrics_snapshot)
         return self.mesh_router
+
+    def enable_mesh_standby(self, primary_addr: str,
+                            required_workers: int = 1,
+                            health_interval_s: float = 1.0,
+                            router_token: str | None = None,
+                            takeover_after: int | None = None,
+                            poll_interval_s: float | None = None):
+        """Turn this app into the PASSIVE STANDBY of ``primary_addr``
+        (``serve_nn --mesh-role standby --primary HOST:PORT``): a full
+        mesh router whose admission answers 503 ``standby_passive``
+        while a monitor mirrors the primary (worker table, kernel
+        generations via content-addressed blobs, spill token) and takes
+        over after ``takeover_after`` consecutive unreachable polls.
+        Must run before ``add_model``, like ``enable_mesh_router``."""
+        from .mesh.standby import StandbyMonitor
+
+        self.enable_mesh_router(required_workers=required_workers,
+                                health_interval_s=health_interval_s,
+                                router_token=router_token)
+        self.mesh_standby = StandbyMonitor(
+            self, primary_addr, takeover_after=takeover_after,
+            poll_interval_s=poll_interval_s).start()
+        return self.mesh_standby
+
+    def standby_passive(self) -> bool:
+        """True while this server is a standby that has NOT taken over
+        (admission for infer/reload/registration answers 503)."""
+        return self.mesh_standby is not None and self.mesh_standby.passive
 
     def handle_mesh_register(self, body: bytes) -> dict:
         """POST /v1/mesh/register: a worker's registration heartbeat."""
@@ -435,6 +499,12 @@ class ServeApp:
             raise _HTTPError(503, "mesh_disabled",
                              "this server is not a mesh router "
                              "(start serve_nn with --mesh-role router)")
+        if self.standby_passive():
+            # the primary still owns the fleet: the worker's heartbeat
+            # loop alternates straight back to it
+            raise _HTTPError(503, "standby_passive",
+                             "this router is a passive standby of "
+                             f"{self.mesh_standby.primary}")
         try:
             req = json.loads(body.decode("utf-8"))
         except (UnicodeDecodeError, json.JSONDecodeError) as exc:
@@ -460,6 +530,21 @@ class ServeApp:
             jobs = None  # advisory field: ignore junk, don't reject
         return self.mesh_router.register_worker(addr, kernels,
                                                 jobs=jobs)
+
+    def handle_mesh_state(self, headers) -> dict:
+        """GET /v1/mesh/state: the standby's mirror feed.  When an
+        auth token is configured the WHOLE endpoint requires it (the
+        worker table + blob shas are fleet internals), and the spill
+        token rides along for the authorized caller; with auth off the
+        endpoint is open but the token is omitted -- a public secret
+        would make the spill protection it backs decorative."""
+        if self.mesh_router is None:
+            raise _HTTPError(404, "mesh_disabled",
+                             "this server is not a mesh router")
+        if not self.authorized(headers):
+            raise _HTTPError(401, "unauthorized",
+                             "missing or invalid auth token")
+        return self.mesh_router.state_snapshot(bool(self.auth_token))
 
     def autoscale_snapshot(self) -> dict:
         """The autoscaling signal /metrics renders: queued rows, the
@@ -624,6 +709,26 @@ class ServeApp:
                      peer: str | None = None) -> dict:
         from ..obs import trace as obs_trace
 
+        if self.standby_passive():
+            # documented client contract: one retry against the other
+            # router of the pair (the primary, who still owns traffic)
+            raise _HTTPError(503, "standby_passive",
+                             "this router is a passive standby of "
+                             f"{self.mesh_standby.primary}")
+        if self.require_router and self.mesh_worker is not None:
+            # spill protection: only the router's stamped traffic is
+            # served, so router-side quotas cannot be bypassed by
+            # direct worker hits
+            want = self.mesh_worker.router_token
+            got = (headers.get("X-HPNN-Router") or "") if headers else ""
+            if not want or not hmac.compare_digest(
+                    got.encode("utf-8", "surrogateescape"),
+                    want.encode("utf-8")):
+                raise _HTTPError(
+                    403, "router_only",
+                    "this worker only serves traffic routed through "
+                    "the mesh router (missing or invalid "
+                    "X-HPNN-Router token)")
         b = self.batchers.get(name)
         if b is None:
             raise _HTTPError(404, "not_found", f"unknown kernel '{name}'")
@@ -758,10 +863,20 @@ class ServeApp:
         ``{"kernel": "<path>"}`` picks the weights file; default is the
         model's last source.  ``{"set_generation": G}`` (the mesh
         coordinator's broadcast form) pins the post-swap generation
-        counter so the whole fleet lands on one number.  409 when the
-        file fails to load (the old weights keep serving)."""
+        counter so the whole fleet lands on one number, and
+        ``{"blob": {"sha256", "size"}}`` names a CONTENT-ADDRESSED
+        weights blob instead of a path: the worker pulls the bytes from
+        its router's ``/v1/mesh/blob/<sha>`` endpoint and verifies the
+        hash before loading -- no shared filesystem required.  409 when
+        the weights cannot be landed (the old weights keep serving)."""
+        if self.standby_passive():
+            raise _HTTPError(503, "standby_passive",
+                             "this router is a passive standby; reload "
+                             "through the primary "
+                             f"({self.mesh_standby.primary})")
         kernel_path = None
         set_generation = None
+        blob = None
         if body.strip():
             try:
                 req = json.loads(body.decode("utf-8"))
@@ -783,6 +898,38 @@ class ServeApp:
                     raise _HTTPError(400, "bad_request",
                                      "'set_generation' must be an "
                                      "integer")
+            blob = req.get("blob")
+            if blob is not None and not (isinstance(blob, dict)
+                                         and blob.get("sha256")):
+                raise _HTTPError(400, "bad_request",
+                                 "'blob' must be an object with "
+                                 "'sha256'")
+        if blob is not None and kernel_path is None:
+            # content-addressed reload: pull the announced bytes from
+            # the router this worker heartbeats to, verify, then load
+            # from the local blob cache -- the broadcast carried no
+            # path on purpose (disjoint filesystems)
+            from .mesh import transport
+            from .mesh.transport import BlobError
+
+            agent = self.mesh_worker
+            if agent is None:
+                raise _HTTPError(
+                    409, "reload_failed",
+                    "blob reload needs a mesh worker agent (no "
+                    "router to fetch the bytes from)")
+            fetch_headers = None
+            if self.auth_token:
+                fetch_headers = {"Authorization":
+                                 f"Bearer {self.auth_token}"}
+            try:
+                kernel_path = transport.fetch_blob(
+                    agent.current, str(blob["sha256"]),
+                    blob.get("size"), agent.blob_dir, timeout_s=20.0,
+                    headers=fetch_headers)
+            except BlobError as exc:
+                raise _HTTPError(409, "reload_failed",
+                                 f"blob fetch failed: {exc}")
         try:
             return self.reload_model(name, kernel_path,
                                      set_generation=set_generation)
@@ -917,8 +1064,16 @@ class _Handler(BaseHTTPRequestHandler):
                 mesh = router.readiness()
             elif self.app.mesh_worker is not None:
                 mesh = self.app.mesh_worker.info()
+            if self.app.mesh_standby is not None:
+                # a standby reports its own readiness axis: "passive"
+                # (503 -- do not route here) until takeover, then the
+                # normal router quorum contract
+                mesh = dict(mesh or {})
+                mesh.update(self.app.mesh_standby.info())
             if self.app._closed:
                 status = "draining"
+            elif self.app.standby_passive():
+                status = "passive"
             elif warming:
                 status = "warming"
             elif mesh is not None and mesh.get("quorum") is False:
@@ -957,6 +1112,32 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {"workers": router.pool.table(),
                               "required": router.required,
                               "live": router.pool.live_count()})
+            return
+        if path == "/v1/mesh/state":
+            try:
+                self._reply(200, self.app.handle_mesh_state(self.headers))
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+            return
+        m = _BLOB_RE.match(path)
+        if m is not None:
+            if not self.app.authorized(self.headers):
+                # weight bytes are the model: behind the auth token
+                # whenever one is configured (workers/standby send it
+                # on every fetch)
+                self._reply(401, {"error": "missing or invalid auth "
+                                  "token", "reason": "unauthorized"})
+                return
+            router = self.app.mesh_router
+            data = (router.blob_bytes(m.group(1))
+                    if router is not None else None)
+            if data is None:
+                self._reply(404, {"error": f"unknown blob {m.group(1)}",
+                                  "reason": "not_found"})
+                return
+            self._reply(200, data,
+                        content_type="application/octet-stream")
             return
         if path == "/v1/debug/trace":
             from ..obs import trace as obs_trace
@@ -1255,6 +1436,41 @@ class _Server(ThreadingHTTPServer):
     # queue-full admission control ever runs.  Backpressure must come
     # from the 429 path, not the TCP accept queue.
     request_queue_size = 128
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # live client sockets: with keep-alive mesh transport, a
+        # "dead" server whose handler threads keep answering pooled
+        # connections is not dead at all -- tests that simulate
+        # kill -9 in-process must be able to sever them
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
+
+    def process_request(self, request, client_address):
+        with self._conns_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conns_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def abort_connections(self) -> None:
+        """Hard-sever every live client connection -- the in-process
+        stand-in for process death.  ``shutdown()`` alone only stops
+        NEW connections; established keep-alive sockets (worker RPC
+        pools, heartbeats, standby mirrors) would keep being served by
+        their handler threads, which no real SIGKILL allows."""
+        import socket as _socket
+
+        with self._conns_lock:
+            conns = list(self._conns)
+        for sock in conns:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
 
 
 def make_server(host: str, port: int, app: ServeApp) -> ThreadingHTTPServer:

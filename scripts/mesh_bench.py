@@ -16,11 +16,23 @@ workers' ``--router`` at a remote address):
    restart): the scaling row.  NOTE on a single-core host two worker
    PROCESSES share one CPU, so the honest expectation here is "no
    collapse" (floor 0.5x), not 2x -- the 2x claim needs two real hosts
-   (``REAL=1`` on a chip fleet);
-4. **failover** -- under sustained load one of two workers is killed
+   (``REAL=1`` on a chip fleet).  The row also records the keep-alive
+   transport's connection-reuse ratio (floor: the mesh must actually
+   reuse sockets, not reopen TCP per RPC);
+4. **chaos** -- the same 2-worker mesh under load with the
+   deterministic fault layer injecting connection resets on the worker
+   RPC (``mesh.chaos``): every reset must be absorbed by
+   eject + retry-once-elsewhere (floor: ZERO non-200 at the client,
+   injected count exact);
+5. **failover** -- under sustained load one of two workers is killed
    with SIGKILL mid-flight; the row records non-200 responses (floor:
    ZERO -- in-flight batches must retry-once-elsewhere) and the
-   ejection latency until the router's pool marks the corpse dead.
+   ejection latency until the router's pool marks the corpse dead;
+6. **takeover** -- a router PAIR (primary + standby subprocesses)
+   fronting one worker; the PRIMARY is killed with SIGKILL under load.
+   The row records the takeover latency (kill -> the standby's
+   /healthz goes ready) and non-200s AFTER the client's single
+   documented retry against the survivor (floor: zero).
 
 Honesty rules (bench.py protocol): every latency is a client-observed
 wall time, non-200s are counted never dropped, floors are asserted and
@@ -49,13 +61,16 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 def spawn_worker(conf: str, router_addr: str | None = None,
                  extra_args: tuple = (), real: bool = False,
-                 timeout_s: float = 180.0):
-    """Start one serve_nn worker subprocess on an ephemeral port and
-    wait for its "SERVE: listening" line.  Returns (proc, port).  A
-    stdout drain thread keeps the pipe from filling."""
+                 timeout_s: float = 180.0, port: int = 0):
+    """Start one serve_nn subprocess (worker by default; router/standby
+    via ``extra_args``) and wait for its "SERVE: listening" line.
+    Returns (proc, port).  A stdout drain thread keeps the pipe from
+    filling.  ``port=0`` (default) binds an ephemeral one; router pairs
+    pass fixed ports because each member must name the other before
+    either is up."""
     cmd = [sys.executable, "-u",
            os.path.join(REPO, "apps", "serve_nn.py"),
-           "-p", "0", "--warmup-mode", "off"]
+           "-p", str(port), "--warmup-mode", "off"]
     if router_addr:
         cmd += ["--mesh-role", "worker", "--router", router_addr]
     cmd += list(extra_args) + [conf]
@@ -82,6 +97,21 @@ def spawn_worker(conf: str, router_addr: str | None = None,
         raise RuntimeError(f"worker did not bind within {timeout_s}s "
                            f"(cmd: {' '.join(cmd)})")
     return proc, port_box[0]
+
+
+def free_ports(n: int) -> list[int]:
+    """N distinct free TCP ports (bind-0 then release).  Router pairs
+    need ports up front: each member names the other before either
+    binds."""
+    import socket
+
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
 
 
 def wait_healthz_ok(base: str, timeout_s: float = 60.0) -> dict:
@@ -223,8 +253,67 @@ def main() -> int:
             mesh2["rows_per_s"] / mesh1["rows_per_s"], 3) \
             if mesh1["rows_per_s"] else None
         row["value"] = mesh2["rows_per_s"]
+        # keep-alive transport accounting over everything routed so far
+        transport_stats = rapp.mesh_router.metrics_snapshot()["transport"]
+        row["transport"] = transport_stats
 
-        # --- 4. kill -9 failover under load -----------------------------
+        # --- 4. retry-under-chaos: injected resets on the worker RPC ----
+        # resets are PACED (gap_ms) so the health loop's readmission
+        # window fits between faults: the claim under test is "every
+        # reset is absorbed by eject + retry-once-elsewhere", not
+        # "both workers dead at once still serves"
+        from hpnn_tpu.serve.mesh import chaos
+
+        n_faults = 4
+        chaos.configure(f"reset@/infer:times={n_faults},gap_ms=1500")
+        chaos_statuses: dict[str, int] = {}
+        clock = threading.Lock()
+        cstop = threading.Event()
+
+        def chaos_hammer():
+            xs = inputs[:4].tolist()
+            while not cstop.is_set():
+                try:
+                    st, _ = serve_bench.http_json(
+                        rbase + "/v1/kernels/mesh/infer",
+                        {"inputs": xs, "timeout_ms": 10000},
+                        timeout_s=15.0)
+                except Exception:
+                    st = -1
+                with clock:
+                    chaos_statuses[str(st)] = \
+                        chaos_statuses.get(str(st), 0) + 1
+
+        cthreads = [threading.Thread(target=chaos_hammer, daemon=True)
+                    for _ in range(4)]
+        t_chaos0 = time.monotonic()
+        for t in cthreads:
+            t.start()
+        # run until every fault fired (+ one readmission window)
+        while (chaos.stats()["injected_total"] < n_faults
+               and time.monotonic() - t_chaos0 < 30.0):
+            time.sleep(0.1)
+        time.sleep(1.0)
+        cstop.set()
+        for t in cthreads:
+            t.join()
+        injected = chaos.stats()["injected_total"]
+        chaos.reset()
+        chaos_non200 = sum(n for s, n in chaos_statuses.items()
+                           if s != "200")
+        row["chaos"] = {
+            "statuses": chaos_statuses, "non_200": chaos_non200,
+            "injected_resets": injected,
+            "failovers_total": rapp.mesh_router.pool.failovers_total,
+            "duration_s": round(time.monotonic() - t_chaos0, 3),
+        }
+        # both workers must be readmitted before the failover row
+        deadline = time.monotonic() + 30
+        while (rapp.mesh_router.pool.live_count() < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+
+        # --- 5. kill -9 failover under load -----------------------------
         statuses: dict[str, int] = {}
         slock = threading.Lock()
         stop = threading.Event()
@@ -271,6 +360,97 @@ def main() -> int:
         rhttpd.shutdown()
         rapp.close(drain=True)
 
+        # --- 6. router-pair takeover: kill -9 the PRIMARY ----------------
+        os.environ["HPNN_MESH_STANDBY_POLL_S"] = "0.3"
+        os.environ["HPNN_MESH_TAKEOVER_AFTER"] = "2"
+        os.environ["HPNN_MESH_HEARTBEAT_S"] = "0.3"
+        pport, sport = free_ports(2)
+        pri, sby = f"127.0.0.1:{pport}", f"127.0.0.1:{sport}"
+        pair_procs: list = []
+        tk_statuses: dict[str, int] = {}
+        tk_lock = threading.Lock()
+        tk_stop = threading.Event()
+        try:
+            pair_procs.append(spawn_worker(
+                conf, None, ("--mesh-role", "router",
+                             "--standby", sby, "--workers", "1"),
+                real=args.real, port=pport))
+            pair_procs.append(spawn_worker(
+                conf, None, ("--mesh-role", "standby",
+                             "--primary", pri),
+                real=args.real, port=sport))
+            pair_procs.append(spawn_worker(conf, pri, wargs,
+                                           real=args.real))
+            wait_healthz_ok(f"http://{pri}")
+
+            def tk_hammer():
+                xs = inputs[:4].tolist()
+                payload = {"inputs": xs, "timeout_ms": 10000}
+                while not tk_stop.is_set():
+                    try:
+                        st, _ = serve_bench.http_json(
+                            f"http://{pri}/v1/kernels/mesh/infer",
+                            payload, timeout_s=15.0)
+                    except Exception:
+                        st = -1
+                    if st in (-1, 503):
+                        # the client's single documented retry: wait
+                        # for the survivor to report ready, retry ONCE
+                        deadline = time.monotonic() + 30.0
+                        while time.monotonic() < deadline:
+                            try:
+                                hs, _ = serve_bench.http_json(
+                                    f"http://{sby}/healthz",
+                                    timeout_s=5.0)
+                            except Exception:
+                                hs = -1
+                            if hs == 200:
+                                break
+                            time.sleep(0.1)
+                        try:
+                            st, _ = serve_bench.http_json(
+                                f"http://{sby}/v1/kernels/mesh/infer",
+                                payload, timeout_s=15.0)
+                        except Exception:
+                            st = -1
+                    with tk_lock:
+                        tk_statuses[str(st)] = \
+                            tk_statuses.get(str(st), 0) + 1
+
+            tk_threads = [threading.Thread(target=tk_hammer,
+                                           daemon=True)
+                          for _ in range(3)]
+            for t in tk_threads:
+                t.start()
+            time.sleep(args.failover_seconds / 3)
+            pair_procs[0][0].send_signal(signal.SIGKILL)
+            t_kill = time.monotonic()
+            takeover_s = None
+            while time.monotonic() - t_kill < 60.0:
+                try:
+                    hs, _ = serve_bench.http_json(
+                        f"http://{sby}/healthz", timeout_s=5.0)
+                except Exception:
+                    hs = -1
+                if hs == 200:
+                    takeover_s = time.monotonic() - t_kill
+                    break
+                time.sleep(0.05)
+            time.sleep(args.failover_seconds / 3)
+            tk_stop.set()
+            for t in tk_threads:
+                t.join()
+        finally:
+            tk_stop.set()
+            for proc, _port in pair_procs:
+                if proc.poll() is None:
+                    proc.kill()
+        tk_non200 = sum(n for s, n in tk_statuses.items() if s != "200")
+        row["takeover"] = {
+            "statuses": tk_statuses, "non_200": tk_non200,
+            "takeover_s": round(takeover_s, 3) if takeover_s else None,
+        }
+
         # --- floors ------------------------------------------------------
         if mesh1["statuses"] != {"200": args.requests}:
             failed.append(f"mesh_1w non-200s: {mesh1['statuses']}")
@@ -287,6 +467,22 @@ def main() -> int:
             failed.append(
                 f"router overhead blew past the floor: p50 "
                 f"{mesh1['p50_ms']}ms vs local {local['p50_ms']}ms")
+        if transport_stats["reuse_ratio"] < 0.5:
+            failed.append(
+                f"keep-alive reuse collapsed: "
+                f"{transport_stats['reuse_ratio']} (floor 0.5)")
+        if chaos_non200 != 0:
+            failed.append(f"chaos non-200s: {chaos_non200} "
+                          f"({chaos_statuses})")
+        if injected < n_faults:
+            failed.append(f"chaos injected only {injected}/{n_faults} "
+                          "resets (load too short?)")
+        if tk_non200 != 0:
+            failed.append(f"takeover non-200s: {tk_non200} "
+                          f"({tk_statuses})")
+        if takeover_s is None or takeover_s > 20.0:
+            failed.append(f"standby takeover took {takeover_s}s "
+                          "(floor 20s)")
     finally:
         for proc, _port in procs:
             if proc.poll() is None:

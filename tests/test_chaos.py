@@ -1,0 +1,495 @@
+"""Fault-injection layer + keep-alive transport (ISSUE 11): chaos spec
+parsing and deterministic schedules, the connection pool (reuse, idle
+retirement, stale keep-alive retry), jittered backoff, verified blob
+fetches, and the TRANSPORT_ERRORS mapping edge cases the mesh's
+retry-once-elsewhere contract depends on -- ``IncompleteRead``
+mid-body, connection reset AFTER the request was sent (idempotent
+retry must still hold: the victim processed it, the client still gets
+exactly one answer), and a timeout during the response read.
+"""
+
+import http.client
+import http.server
+import json
+import os
+import socket
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import serve_bench  # noqa: E402
+
+from hpnn_tpu.serve import ServeApp  # noqa: E402
+from hpnn_tpu.serve.mesh import chaos, transport  # noqa: E402
+from hpnn_tpu.serve.mesh.backend import (  # noqa: E402
+    TRANSPORT_ERRORS,
+    get_json,
+)
+from hpnn_tpu.serve.mesh.worker import WorkerAgent  # noqa: E402
+from hpnn_tpu.serve.server import serve_in_thread  # noqa: E402
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """Chaos rules are process-global: never leak them across tests."""
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# --- spec parsing + deterministic schedules ---------------------------------
+
+def test_fault_spec_parse():
+    rules = chaos.parse_spec(
+        "reset@/infer:after=2,every=3,times=2;"
+        "latency:ms=50,p=0.5,seed=7;http:code=502")
+    assert [r.kind for r in rules] == ["reset", "latency", "http"]
+    assert rules[0].match == "/infer"
+    assert (rules[0].after, rules[0].every, rules[0].times) == (2, 3, 2)
+    assert rules[1].ms == 50.0 and rules[1].p == 0.5
+    assert rules[1].seed == 7
+    assert rules[2].code == 502
+    assert chaos.parse_spec("") == []
+    for bad in ("explode", "reset:every=0", "latency:p=2",
+                "reset:bogus=1", "reset:every"):
+        with pytest.raises(ValueError):
+            chaos.parse_spec(bad)
+
+
+def test_fault_schedule_after_every_times_exact():
+    chaos.configure("reset@/infer:after=2,every=3,times=2")
+    fired = [chaos.pick("/v1/kernels/k/infer") is not None
+             for _ in range(12)]
+    # skip 2, then every 3rd matching call, at most 2 times total
+    assert fired == [False, False, True, False, False, True,
+                     False, False, False, False, False, False]
+    # non-matching paths never advance the schedule
+    chaos.configure("reset@/infer:every=1")
+    assert chaos.pick("/healthz") is None
+    assert chaos.pick("/v1/kernels/k/infer") is not None
+
+
+def test_fault_probability_is_seeded_deterministic():
+    def run():
+        chaos.configure("http:p=0.4,seed=123")
+        return [chaos.pick("/x") is not None for _ in range(32)]
+
+    a, b = run(), run()
+    assert a == b                     # same seed, same call order
+    assert 0 < sum(a) < 32            # actually probabilistic
+    chaos.configure("http:p=0.4,seed=124")
+    assert [chaos.pick("/x") is not None for _ in range(32)] != a
+
+
+def test_malformed_env_spec_disarms_not_raises(monkeypatch):
+    monkeypatch.setenv("HPNN_FAULT", "not-a-kind:wat")
+    chaos.reset()
+    assert chaos.pick("/anything") is None  # degraded, no exception
+    assert chaos.stats()["armed"] is False
+
+
+# --- a tiny real HTTP peer for transport tests ------------------------------
+
+class _Peer:
+    """Counting stdlib server: /echo answers JSON, /blob/<name> serves
+    bytes, /flaky 500s its first N hits, one thread per connection
+    (keep-alive honored, like the real serve front-end)."""
+
+    def __init__(self, flaky_fails: int = 0):
+        peer = self
+        peer.requests = 0
+        peer.flaky_left = flaky_fails
+        peer.blobs: dict[str, bytes] = {}
+        peer.conns: list = []  # server-side sockets (sever in tests)
+
+        class H(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                peer.conns.append(self.connection)
+
+            def log_message(self, *a):
+                pass
+
+            def _send(self, status, body, ctype="application/json"):
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                peer.requests += 1
+                if self.path.startswith("/v1/mesh/blob/"):
+                    sha = self.path.rsplit("/", 1)[1]
+                    data = peer.blobs.get(sha)
+                    if data is None:
+                        self._send(404, b'{"reason": "not_found"}')
+                    else:
+                        self._send(200, data,
+                                   "application/octet-stream")
+                    return
+                if self.path == "/flaky" and peer.flaky_left > 0:
+                    peer.flaky_left -= 1
+                    self._send(500, b'{"error": "flaky"}')
+                    return
+                self._send(200, json.dumps(
+                    {"n": peer.requests}).encode())
+
+            def do_POST(self):
+                peer.requests += 1
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                self._send(200, json.dumps(
+                    {"n": peer.requests, "len": len(body)}).encode())
+
+        self.httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), H)
+        self.httpd.daemon_threads = True
+        self.addr = "127.0.0.1:%d" % self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# --- keep-alive pool --------------------------------------------------------
+
+def test_pool_reuses_connections():
+    peer = _Peer()
+    pool = transport.ConnectionPool(enabled=True)
+    try:
+        for _ in range(4):
+            status, raw, _ = transport.request(
+                peer.addr, "GET", "/echo", timeout_s=5.0, pool=pool)
+            assert status == 200
+        stats = pool.stats()
+        assert stats["fresh_total"] == 1
+        assert stats["reused_total"] == 3
+        assert stats["reuse_ratio"] == 0.75
+    finally:
+        peer.close()
+
+
+def test_pool_disabled_is_fresh_per_call():
+    peer = _Peer()
+    pool = transport.ConnectionPool(enabled=False)
+    try:
+        for _ in range(3):
+            status, _, _ = transport.request(
+                peer.addr, "GET", "/echo", timeout_s=5.0, pool=pool)
+            assert status == 200
+        assert pool.stats() == {
+            "enabled": False, "reused_total": 0, "fresh_total": 3,
+            "retired_total": 0, "idle": 0, "reuse_ratio": 0.0}
+    finally:
+        peer.close()
+
+
+def test_pool_retires_idle_and_dead_sockets():
+    peer = _Peer()
+    pool = transport.ConnectionPool(enabled=True, idle_timeout_s=0.05)
+    try:
+        transport.request(peer.addr, "GET", "/echo", timeout_s=5.0,
+                          pool=pool)
+        time.sleep(0.1)  # past the idle timeout
+        transport.request(peer.addr, "GET", "/echo", timeout_s=5.0,
+                          pool=pool)
+        assert pool.stats()["retired_total"] == 1
+        assert pool.stats()["fresh_total"] == 2
+    finally:
+        peer.close()
+    # peer gone entirely: the pooled socket is detected dead at
+    # acquire (liveness peek), not handed to the RPC
+    time.sleep(0.02)
+    with pytest.raises(TRANSPORT_ERRORS):
+        transport.request(peer.addr, "GET", "/echo", timeout_s=1.0,
+                          pool=pool)
+
+
+def test_stale_keepalive_socket_retried_once_fresh(monkeypatch):
+    """The keep-alive race: a pooled socket the peer closed under us
+    dies with RemoteDisconnected/reset at send time; the transport
+    retries ONCE on a fresh connection instead of surfacing a fake
+    transport error.  The liveness peek is blinded so the corpse is
+    handed out (in the wild, the race is the FIN arriving between the
+    peek and the send)."""
+    peer = _Peer()
+    pool = transport.ConnectionPool(enabled=True)
+    try:
+        status, _, _ = transport.request(peer.addr, "GET", "/echo",
+                                         timeout_s=5.0, pool=pool)
+        assert status == 200 and pool.stats()["idle"] == 1
+        # sever the ESTABLISHED connection server-side (the listening
+        # socket stays up -- the retry must find a live peer)
+        for sock in peer.conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+        monkeypatch.setattr(transport, "_sock_alive", lambda s: True)
+        status, raw, _ = transport.request(peer.addr, "GET", "/echo",
+                                           timeout_s=5.0, pool=pool)
+        assert status == 200  # healed by the one fresh-connection retry
+        stats = pool.stats()
+        assert stats["reused_total"] == 1  # the corpse was handed out
+        assert stats["fresh_total"] == 2   # ...and replaced exactly once
+    finally:
+        peer.close()
+
+
+# --- chaos injected below the transport -------------------------------------
+
+def test_chaos_http_and_latency_injection():
+    peer = _Peer()
+    pool = transport.ConnectionPool(enabled=True)
+    try:
+        chaos.configure("http@/echo:times=1,code=502")
+        status, raw, _ = transport.request(
+            peer.addr, "GET", "/echo", timeout_s=5.0, pool=pool)
+        assert status == 502
+        assert json.loads(raw)["reason"] == "chaos"
+        assert peer.requests == 0  # fabricated: never hit the wire
+        chaos.configure("latency@/echo:times=1,ms=80")
+        t0 = time.monotonic()
+        status, _, _ = transport.request(
+            peer.addr, "GET", "/echo", timeout_s=5.0, pool=pool)
+        assert status == 200 and time.monotonic() - t0 >= 0.08
+        assert peer.requests == 1  # latency proceeds to the peer
+        assert chaos.stats()["injected_total"] == 1
+    finally:
+        peer.close()
+
+
+def test_chaos_post_send_faults_reach_the_peer():
+    """reset-after / timeout / truncate are injected AFTER the request
+    was processed: the peer's counter moves even though the caller sees
+    a transport error -- exactly the lost-response case idempotent
+    retry exists for."""
+    peer = _Peer()
+    pool = transport.ConnectionPool(enabled=True)
+    expected = {"reset-after": ConnectionResetError,
+                "timeout": socket.timeout,
+                "truncate": http.client.IncompleteRead}
+    try:
+        for i, (kind, exc_type) in enumerate(expected.items()):
+            chaos.configure(f"{kind}@/echo:times=1")
+            with pytest.raises(exc_type):
+                transport.request(peer.addr, "GET", "/echo",
+                                  timeout_s=5.0, pool=pool)
+            assert peer.requests == i + 1  # the peer DID process it
+            assert isinstance(exc_type("", b"") if exc_type
+                              is http.client.IncompleteRead
+                              else exc_type(""), TRANSPORT_ERRORS)
+    finally:
+        peer.close()
+
+
+# --- backoff ----------------------------------------------------------------
+
+def test_backoff_growth_cap_jitter_reset():
+    import random
+
+    b = transport.Backoff(base_s=1.0, cap_s=8.0, jitter=0.0)
+    assert [b.next_delay() for _ in range(5)] == [1, 2, 4, 8, 8]
+    b.reset()
+    assert b.next_delay() == 1.0
+    j = transport.Backoff(base_s=1.0, cap_s=64.0, jitter=0.25,
+                          rng=random.Random(3))
+    delays = [j.next_delay() for _ in range(4)]
+    for want, got in zip([1, 2, 4, 8], delays):
+        assert want * 0.75 <= got <= want * 1.25
+    assert delays != [1, 2, 4, 8]  # jitter actually applied
+
+
+def test_worker_heartbeat_delay_jittered_and_backed_off():
+    app = ServeApp(max_batch=8)
+    agent = WorkerAgent(app, "127.0.0.1:1", "127.0.0.1:2",
+                        interval_s=2.0)
+    ok_delays = [agent.next_delay(True) for _ in range(16)]
+    assert all(1.6 <= d <= 2.4 for d in ok_delays)
+    assert len(set(ok_delays)) > 1  # jittered, not a lockstep fleet
+    bad = [agent.next_delay(False) for _ in range(6)]
+    # exponential growth from the heartbeat base, capped at 30s
+    assert bad[0] < bad[2] < bad[4]
+    assert all(0.5 <= d <= 30.0 * 1.25 for d in bad)
+    agent._backoff.reset()
+    assert agent.next_delay(False) <= 2.0 * 1.25
+    app.close(drain=False)
+
+
+# --- verified blob fetch ----------------------------------------------------
+
+def _sha(data: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(data).hexdigest()
+
+
+def test_fetch_blob_verifies_and_is_idempotent(tmp_path):
+    peer = _Peer()
+    data = b"kernel bytes " * 100
+    sha = _sha(data)
+    peer.blobs[sha] = data
+    try:
+        path = transport.fetch_blob(peer.addr, sha, len(data),
+                                    str(tmp_path))
+        with open(path, "rb") as fp:
+            assert fp.read() == data
+        served = peer.requests
+        # idempotent: a verified local copy short-circuits the fetch
+        assert transport.fetch_blob(peer.addr, sha, len(data),
+                                    str(tmp_path)) == path
+        assert peer.requests == served
+        # unknown hash: immediate BlobError (no retry can help a 404)
+        with pytest.raises(transport.BlobError):
+            transport.fetch_blob(peer.addr, _sha(b"other"), 1,
+                                 str(tmp_path))
+    finally:
+        peer.close()
+
+
+def test_fetch_blob_rejects_tampered_bytes(tmp_path):
+    peer = _Peer()
+    data = b"real weights"
+    sha = _sha(data)
+    peer.blobs[sha] = b"tampered weights!!"  # lying peer
+    try:
+        with pytest.raises(transport.BlobError) as ei:
+            transport.fetch_blob(peer.addr, sha, None, str(tmp_path),
+                                 timeout_s=3.0, attempts=2)
+        assert "mismatch" in str(ei.value)
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), f"{sha}.opt"))
+    finally:
+        peer.close()
+
+
+def test_fetch_blob_retries_transient_failures(tmp_path):
+    peer = _Peer()
+    data = os.urandom(256)
+    sha = _sha(data)
+    try:
+        # 5xx twice (flaky route), then the blob route works
+        chaos.configure(f"http@/v1/mesh/blob/{sha}:times=2,code=503")
+        peer.blobs[sha] = data
+        path = transport.fetch_blob(peer.addr, sha, len(data),
+                                    str(tmp_path), timeout_s=10.0,
+                                    attempts=4)
+        with open(path, "rb") as fp:
+            assert fp.read() == data
+        assert chaos.stats()["injected_total"] == 2
+    finally:
+        peer.close()
+
+
+# --- TRANSPORT_ERRORS mapping edge cases through a real mesh ----------------
+
+def _write_kernel_conf(tmp_path, name="tiny", seed=1234):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    return str(conf)
+
+
+def _mk_worker(conf, router_port=None, **kw):
+    app = ServeApp(max_batch=16, max_queue_rows=512, **kw)
+    assert app.add_model(conf, warmup=False) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    port = httpd.server_address[1]
+    if router_port is not None:
+        agent = WorkerAgent(app, f"127.0.0.1:{router_port}",
+                            f"127.0.0.1:{port}", interval_s=0.3)
+        app.mesh_worker = agent
+        agent.start()
+    return app, httpd, port
+
+
+def _mk_router(conf, required=1, **kw):
+    app = ServeApp(max_batch=16, max_queue_rows=512, **kw)
+    app.enable_mesh_router(required_workers=required,
+                           health_interval_s=0.2)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    return app, httpd, httpd.server_address[1]
+
+
+def _wait_quorum(port, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status, body = serve_bench.http_json(
+            f"http://127.0.0.1:{port}/healthz")
+        if status == 200:
+            return body
+        time.sleep(0.05)
+    raise AssertionError(f"router on :{port} never reached quorum")
+
+
+@pytest.mark.parametrize("kind,processed", [
+    ("reset", 1),        # pre-send: the victim never saw the request
+    ("reset-after", 2),  # post-send: victim processed it, answer lost
+    ("truncate", 2),     # IncompleteRead mid-body
+    ("timeout", 2),      # timeout during the response read
+])
+def test_transport_error_maps_to_retry_once_elsewhere(tmp_path, kind,
+                                                      processed):
+    """Each TRANSPORT_ERRORS class observed on the worker RPC ejects
+    the worker and retries the batch ONCE elsewhere; the client gets
+    exactly ONE 200 either way (inference is idempotent, so the
+    processed-but-lost case double-computes, never double-answers)."""
+    conf = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=2)
+    w1app, w1httpd, _ = _mk_worker(conf, router_port=rport)
+    w2app, w2httpd, _ = _mk_worker(conf, router_port=rport)
+    try:
+        _wait_quorum(rport)
+        chaos.configure(f"{kind}@/infer:times=1")
+        xs = np.zeros((2, N_IN))
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/infer",
+            {"inputs": xs.tolist(), "timeout_ms": 20000})
+        assert st == 200
+        assert chaos.stats()["injected_total"] == 1
+        assert rapp.mesh_router.pool.failovers_total == 1
+        served = sum(
+            app.metrics.snapshot()["requests"].get("ok", 0)
+            for app in (w1app, w2app))
+        assert served == processed
+    finally:
+        chaos.reset()
+        for httpd, app in ((w1httpd, w1app), (w2httpd, w2app),
+                           (rhttpd, rapp)):
+            httpd.shutdown()
+            app.close(drain=True)
+
+
+def test_transport_errors_tuple_covers_the_edge_classes():
+    for exc in (http.client.IncompleteRead(b"", 1),
+                http.client.RemoteDisconnected("gone"),
+                ConnectionResetError("reset"),
+                socket.timeout("read timed out"),
+                BrokenPipeError("pipe")):
+        assert isinstance(exc, TRANSPORT_ERRORS), exc
+    # HTTP answers are NOT transport errors: a 404/409 must propagate,
+    # never trigger the retry-elsewhere path
+    from hpnn_tpu.serve.mesh.backend import RemoteHTTPError
+
+    assert not isinstance(RemoteHTTPError(404, "x", "y"),
+                          TRANSPORT_ERRORS)

@@ -442,9 +442,12 @@ def _wait_quorum(port, timeout_s=15.0):
 
 def _kill_worker(httpd, app):
     """In-process stand-in for a worker death: closing the listening
-    socket makes the router's next RPC see connection-refused, exactly
-    like a kill -9 does."""
+    socket AND severing established connections makes the router's
+    next RPC see connection-refused/reset, exactly like a kill -9 does
+    (with the keep-alive transport, shutdown alone would leave pooled
+    sockets being served by still-live handler threads)."""
     httpd.shutdown()
+    httpd.abort_connections()
     httpd.server_close()
     app.close(drain=False)
 
@@ -733,6 +736,18 @@ def test_mesh_register_auth_guarded(tmp_path):
             base + "/v1/mesh/register", {"addr": "myhost"},
             headers={"Authorization": "Bearer sesame"})
         assert status == 400 and "HOST:PORT" in body["error"]
+        # with auth configured, the fleet internals are guarded too:
+        # state (worker table + blob shas) and the weight blobs
+        # themselves answer 401 without the token (ISSUE 11)
+        status, body = serve_bench.http_json(base + "/v1/mesh/state")
+        assert status == 401
+        status, body = serve_bench.http_json(
+            base + "/v1/mesh/blob/" + "0" * 64)
+        assert status == 401  # auth first, existence second
+        status, body = serve_bench.http_json(
+            base + "/v1/mesh/state",
+            headers={"Authorization": "Bearer sesame"})
+        assert status == 200 and body["router_token"]
         # a non-router server refuses registrations outright
         lapp = ServeApp(max_batch=8)
         assert lapp.add_model(conf, warmup=False, name="l")
@@ -956,6 +971,259 @@ def test_serve_nn_worker_requires_router(tmp_path, capsys):
     assert "--router" in capsys.readouterr().err
 
 
+# --- zero-SPOF fleet (ISSUE 11) ---------------------------------------------
+
+_free_ports = mesh_bench.free_ports  # one port protocol, one place
+
+
+def _kill_server(httpd, app):
+    """In-process stand-in for killing a ROUTER: same severing as
+    _kill_worker (keep-alive sockets must die with the process)."""
+    _kill_worker(httpd, app)
+
+
+def _mk_standby(conf, primary_port, required=1, **kw):
+    app = ServeApp(max_batch=16, max_queue_rows=512, **kw)
+    app.enable_mesh_standby(f"127.0.0.1:{primary_port}",
+                            required_workers=required,
+                            health_interval_s=0.2,
+                            takeover_after=2, poll_interval_s=0.2)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    return app, httpd, httpd.server_address[1]
+
+
+def test_standby_mirror_takeover_and_heartbeat_follow(tmp_path):
+    """Router-pair tentpole, fast tier: the standby passively mirrors
+    the primary (worker table + kernel state), answers 503
+    standby_passive meanwhile, activates after consecutive unreachable
+    polls when the primary dies, and the worker's heartbeat loop
+    follows the ack-advertised standby -- infer traffic completes on
+    the survivor after the client's single documented retry."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    papp, phttpd, pport = _mk_router(conf, required=1)
+    sapp, shttpd, sport = _mk_standby(conf, pport)
+    papp.mesh_router.standby_addr = f"127.0.0.1:{sport}"
+    wapp, whttpd, _ = _mk_worker(conf, router_port=pport)
+    agent = wapp.mesh_worker
+    xs = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    payload = {"inputs": xs.tolist()}
+    try:
+        _wait_quorum(pport)
+        # the ack taught the worker both the standby and the token
+        deadline = time.monotonic() + 5
+        while agent.standby is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert agent.standby == f"127.0.0.1:{sport}"
+        assert agent.router_token  # spill secret distributed
+        st, before = serve_bench.http_json(
+            f"http://127.0.0.1:{pport}/v1/kernels/tiny/infer", payload)
+        assert st == 200
+        # while the primary lives: the standby refuses traffic AND
+        # registrations, and reports its own readiness axis
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{sport}/v1/kernels/tiny/infer", payload)
+        assert st == 503 and body["reason"] == "standby_passive"
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{sport}/v1/mesh/register",
+            {"addr": "127.0.0.1:9"})
+        assert st == 503 and body["reason"] == "standby_passive"
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{sport}/healthz")
+        assert st == 503 and body["status"] == "passive"
+        assert body["mesh"]["role"] == "standby"
+        assert body["mesh"]["primary"] == f"127.0.0.1:{pport}"
+        # the passive mirror already holds the worker table
+        deadline = time.monotonic() + 5
+        while (not sapp.mesh_router.pool.live_count()
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert sapp.mesh_router.pool.live_count() >= 1
+        # kill the PRIMARY (in-process: sever everything)
+        _kill_server(phttpd, papp)
+        phttpd = None
+        # takeover: 2 consecutive missed 0.2s polls
+        deadline = time.monotonic() + 10
+        while (sapp.mesh_standby.passive
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert not sapp.mesh_standby.passive
+        assert sapp.mesh_standby.takeovers_total == 1
+        # the documented client contract: ONE retry against the
+        # survivor once it reports ready
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            st, body = serve_bench.http_json(
+                f"http://127.0.0.1:{sport}/healthz")
+            if st == 200:
+                break
+            time.sleep(0.05)
+        st, after = serve_bench.http_json(
+            f"http://127.0.0.1:{sport}/v1/kernels/tiny/infer", payload)
+        assert st == 200
+        assert after["outputs"] == before["outputs"]  # same weights
+        # the worker's heartbeat followed the standby
+        deadline = time.monotonic() + 20
+        while ((agent.current != f"127.0.0.1:{sport}"
+                or not agent.registered)
+               and time.monotonic() < deadline):
+            time.sleep(0.05)
+        assert agent.current == f"127.0.0.1:{sport}"
+        assert agent.registered
+    finally:
+        for httpd, app in ((whttpd, wapp), (shttpd, sapp),
+                           (phttpd, papp)):
+            if httpd is not None:
+                httpd.shutdown()
+                app.close(drain=True)
+
+
+def test_blob_reload_lands_on_disjoint_dirs(tmp_path):
+    """Content-addressed distribution (acceptance): two workers whose
+    blob caches live in DISJOINT directories both land a coherent
+    reload from a broadcast that carries only {sha256, size} -- the
+    bytes travel over HTTP from the router's blob store and are
+    sha256-verified worker-side; no shared path is ever dereferenced."""
+    import hashlib
+
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=2)
+    w1app, w1httpd, w1port = _mk_worker(conf, router_port=rport)
+    w2app, w2httpd, w2port = _mk_worker(conf, router_port=rport)
+    # disjoint per-worker blob homes (distinct temp dirs, as on
+    # distinct hosts); ALSO make the broadcast's source path
+    # meaningless to the workers by writing the new weights outside
+    # anything they look at
+    w1app.mesh_worker.blob_dir = str(tmp_path / "host1-blobs")
+    w2app.mesh_worker.blob_dir = str(tmp_path / "host2-blobs")
+    base = f"http://127.0.0.1:{rport}"
+    try:
+        _wait_quorum(rport)
+        from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+        from hpnn_tpu.models.kernel import generate_kernel
+
+        k2, _ = generate_kernel(7777, N_IN, [N_HID], N_OUT)
+        router_only = tmp_path / "router-only"
+        router_only.mkdir()
+        newpath = str(router_only / "kernel.opt")
+        dump_kernel_to_path(k2, newpath)
+        with open(newpath, "rb") as fp:
+            new_bytes = fp.read()
+        sha = hashlib.sha256(new_bytes).hexdigest()
+
+        result = rapp.reload_model("tiny", newpath)
+        assert result["generation"] == 2
+        assert result["mesh"]["blob"] == {"sha256": sha,
+                                          "size": len(new_bytes)}
+        assert result["mesh"]["workers_failed"] == []
+        # every host landed generation 2, each from its OWN blob cache
+        for wapp, wdir in ((w1app, "host1-blobs"),
+                           (w2app, "host2-blobs")):
+            model = wapp.registry.get("tiny")
+            assert model.generation == 2
+            assert model.source == str(
+                tmp_path / wdir / f"{sha}.opt")
+            with open(model.source, "rb") as fp:
+                assert fp.read() == new_bytes  # verified bytes
+        # and the fleet serves the new weights coherently
+        xs = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+        st, via_router = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()})
+        assert st == 200 and via_router["generation"] == 2
+        st, direct = serve_bench.http_json(
+            f"http://127.0.0.1:{w1port}/v1/kernels/tiny/infer",
+            {"inputs": xs.tolist()})
+        assert st == 200 and direct["outputs"] == via_router["outputs"]
+        # the router serves the blob content-addressed over HTTP
+        import urllib.request
+
+        with urllib.request.urlopen(
+                base + f"/v1/mesh/blob/{sha}") as resp:
+            assert resp.read() == new_bytes
+        st, _ = serve_bench.http_json(base + "/v1/mesh/blob/" + "0" * 64)
+        assert st == 404
+    finally:
+        for httpd, app in ((w1httpd, w1app), (w2httpd, w2app),
+                           (rhttpd, rapp)):
+            httpd.shutdown()
+            app.close(drain=True)
+
+
+def test_worker_spill_protection_requires_router_token(tmp_path):
+    """Satellite: a --require-router worker rejects infer traffic not
+    bearing the router's X-HPNN-Router token (403 router_only), so
+    router-enforced quotas cannot be bypassed by direct worker hits;
+    routed traffic and correctly-stamped direct traffic still serve."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=1)
+    wapp, whttpd, wport = _mk_worker(conf, router_port=rport,
+                                     require_router=True)
+    xs = np.zeros((2, N_IN)).tolist()
+    try:
+        _wait_quorum(rport)
+        agent = wapp.mesh_worker
+        deadline = time.monotonic() + 5
+        while agent.router_token is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        wbase = f"http://127.0.0.1:{wport}"
+        # direct hit without the token: rejected
+        st, body, _ = _post_raw(wbase, "/v1/kernels/tiny/infer",
+                                {"inputs": xs})
+        assert st == 403
+        assert json.loads(body)["reason"] == "router_only"
+        # wrong token: rejected (compared constant-time)
+        st, body, _ = _post_raw(wbase, "/v1/kernels/tiny/infer",
+                                {"inputs": xs},
+                                headers={"X-HPNN-Router": "nope"})
+        assert st == 403
+        # the router's stamped traffic serves
+        st, _ = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/infer",
+            {"inputs": xs})
+        assert st == 200
+        # ...and so does a direct hit bearing the real token (operator
+        # debugging with the secret in hand)
+        st, body, _ = _post_raw(
+            wbase, "/v1/kernels/tiny/infer", {"inputs": xs},
+            headers={"X-HPNN-Router": agent.router_token})
+        assert st == 200
+        # the 403 is a distinct metrics outcome
+        m = serve_bench.fetch_metrics(wbase)
+        assert m["requests"]["router_only"] == 2
+    finally:
+        for httpd, app in ((whttpd, wapp), (rhttpd, rapp)):
+            httpd.shutdown()
+            app.close(drain=True)
+
+
+def test_heartbeat_backs_off_against_dead_router(tmp_path):
+    """Satellite: a dead router means jittered exponential backoff
+    (capped), not a tight loop of failures; a router that comes BACK
+    resets the schedule on the first acked beat."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    (port,) = _free_ports(1)
+    wapp = ServeApp(max_batch=8)
+    assert wapp.add_model(conf, warmup=False) is not None
+    agent = WorkerAgent(wapp, f"127.0.0.1:{port}", "127.0.0.1:1",
+                        interval_s=0.2)
+    try:
+        for _ in range(4):
+            assert agent.beat() is False
+        assert agent._backoff.failures == 0  # next_delay owns growth
+        delays = [agent.next_delay(False) for _ in range(5)]
+        assert delays[0] < delays[2] < delays[4] <= 30.0 * 1.25
+        # the router appears: one acked beat resets the schedule
+        rapp, rhttpd, _rp = _mk_router(conf, required=1)
+        real_port = rhttpd.server_address[1]
+        agent.router_addr = agent.current = f"127.0.0.1:{real_port}"
+        assert agent.beat() is True
+        assert agent.next_delay(False) <= 0.2 * 2 * 1.25
+        rhttpd.shutdown()
+        rapp.close(drain=True)
+    finally:
+        wapp.close(drain=False)
+
+
 # --- heavy e2e: real subprocess workers, real kill -9 -----------------------
 
 @pytest.mark.slow
@@ -1026,3 +1294,123 @@ def test_kill9_failover_e2e_subprocess(tmp_path):
                 proc.kill()
         rhttpd.shutdown()
         rapp.close(drain=True)
+
+
+@pytest.mark.slow
+def test_kill9_primary_router_standby_takeover_e2e(tmp_path,
+                                                   monkeypatch):
+    """The zero-SPOF acceptance pin with REAL process death: a
+    serve_nn router PAIR (primary + standby subprocesses) fronting two
+    serve_nn worker subprocesses; kill -9 the PRIMARY under concurrent
+    load.  The standby takes over, worker heartbeats follow it, and
+    every request completes 200 -- in-flight failures recover within
+    the client's single documented retry (wait for the survivor's
+    /healthz to go ready, retry the request ONCE against it)."""
+    conf, _, _ = _write_kernel_conf(tmp_path)
+    # fast failover knobs for the subprocesses (inherited env)
+    monkeypatch.setenv("HPNN_MESH_STANDBY_POLL_S", "0.3")
+    monkeypatch.setenv("HPNN_MESH_TAKEOVER_AFTER", "2")
+    monkeypatch.setenv("HPNN_MESH_HEARTBEAT_S", "0.3")
+    pport, sport = _free_ports(2)
+    pri_addr, sby_addr = f"127.0.0.1:{pport}", f"127.0.0.1:{sport}"
+    procs = []
+    statuses = []
+    lock = threading.Lock()
+    stop = threading.Event()
+    active = {"base": f"http://{pri_addr}"}
+    try:
+        procs.append(mesh_bench.spawn_worker(
+            conf, None, extra_args=("--mesh-role", "router",
+                                    "--standby", sby_addr,
+                                    "--workers", "2"),
+            port=pport))
+        procs.append(mesh_bench.spawn_worker(
+            conf, None, extra_args=("--mesh-role", "standby",
+                                    "--primary", pri_addr),
+            port=sport))
+        for _ in range(2):
+            procs.append(mesh_bench.spawn_worker(conf, pri_addr))
+        mesh_bench.wait_healthz_ok(f"http://{pri_addr}",
+                                   timeout_s=180.0)
+        xs = np.random.default_rng(3).uniform(-1, 1, (3, N_IN))
+        payload = {"inputs": xs.tolist(), "timeout_ms": 15000}
+
+        def documented_retry():
+            """The client contract: wait for the survivor to report
+            ready, then retry the request ONCE against it."""
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                try:
+                    st, body = serve_bench.http_json(
+                        f"http://{sby_addr}/healthz", timeout_s=5.0)
+                except Exception:
+                    st, body = -1, {}
+                if st == 200:
+                    active["base"] = f"http://{sby_addr}"
+                    break
+                time.sleep(0.1)
+            try:
+                st, _ = serve_bench.http_json(
+                    f"http://{sby_addr}/v1/kernels/tiny/infer",
+                    payload, timeout_s=20.0)
+            except Exception:
+                st = -1
+            return st
+
+        def hammer():
+            while not stop.is_set():
+                try:
+                    st, _ = serve_bench.http_json(
+                        active["base"] + "/v1/kernels/tiny/infer",
+                        payload, timeout_s=20.0)
+                except Exception:
+                    st = -1
+                if st in (-1, 503):
+                    # the single documented retry window
+                    st = documented_retry()
+                with lock:
+                    statuses.append(st)
+
+        threads = [threading.Thread(target=hammer) for _ in range(3)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with lock:
+                if len(statuses) >= 20:
+                    break
+            time.sleep(0.05)
+        with lock:
+            n_before = len(statuses)
+        assert n_before >= 20
+        # kill -9 the PRIMARY router mid-load
+        primary_proc, _ = procs[0]
+        primary_proc.send_signal(signal.SIGKILL)
+        # the survivor must take over and serve sustained load
+        mesh_bench.wait_healthz_ok(f"http://{sby_addr}",
+                                   timeout_s=60.0)
+        t_ok = time.monotonic()
+        while time.monotonic() - t_ok < 8.0:
+            with lock:
+                if len(statuses) >= n_before + 30:
+                    break
+            time.sleep(0.1)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert len(statuses) >= n_before + 10
+        bad = [s for s in statuses if s != 200]
+        assert bad == [], (f"non-200 after primary kill -9 (beyond the "
+                           f"documented retry): {bad}")
+        # the standby really owns the fleet: both workers re-registered
+        st, tbl = serve_bench.http_json(
+            f"http://{sby_addr}/v1/mesh/workers")
+        assert st == 200
+        live = [w for w in tbl["workers"].values()
+                if w["state"] == "live"]
+        assert len(live) == 2
+    finally:
+        stop.set()
+        for proc, _port in procs:
+            if proc.poll() is None:
+                proc.kill()
