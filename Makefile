@@ -15,14 +15,16 @@ FAST_TESTS = tests/test_ops.py tests/test_conf.py tests/test_kernel_io.py \
              tests/test_samples.py tests/test_glibc_random.py \
              tests/test_tools.py tests/test_api_quirks.py \
              tests/test_native_io.py tests/test_corpus.py \
-             tests/test_scale_scripts.py tests/test_bench_probe.py
+             tests/test_scale_scripts.py tests/test_bench_probe.py \
+             tests/test_env.py
 MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
              tests/test_pallas_convergence.py tests/test_cli_e2e.py \
              tests/test_tile_convergence.py
 SERVE_TESTS = tests/test_serve.py
 SERVE_MESH_TESTS = tests/test_mesh.py
 CHAOS_TESTS = tests/test_chaos.py
-CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py
+CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py \
+             tests/test_dp_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
 OBS_TESTS = tests/test_obs.py tests/test_fleet_obs.py
 
@@ -62,6 +64,9 @@ chaos-check:
 # the resume-parity e2e (kill-at-epoch-k + --resume == uninterrupted,
 # byte-for-byte, in-process AND across real process death), and the
 # epoch-pipeline parity pins (pipeline on == HPNN_NO_EPOCH_PIPELINE=1)
+# -- including the mesh-scale DP pipeline (ISSUE 12): sharded-resident
+# [batch] epochs byte-identical to the restage route on the 8-device
+# mesh, 1/N-sharded update state bitwise vs replicated, DP kill/resume
 ckpt-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(CKPT_TESTS) -q
 
@@ -137,6 +142,16 @@ epoch-bench:
 	python scripts/epoch_bench.py --out EPOCH_BENCH.json \
 	    $(if $(REAL),--real)
 
+# mesh-scale DP rows (ISSUE 12): the [batch] route, restage vs the
+# sharded-resident pipeline on the virtual 8-device mesh -- real BPM
+# minibatch epochs.  Merges a "dp" section into EPOCH_BENCH.json
+# (single-device rows preserved); rc!=0 when the permutation-only-H2D
+# or 1/N-update-state floors miss.  tests/test_bench_probe.py holds
+# the committed artifact to the same floors in `make check` tier 1
+dp-epoch-bench:
+	python scripts/epoch_bench.py --dp 256 --rows 10000 \
+	    --out EPOCH_BENCH.json $(if $(REAL),--real)
+
 # batched-tile epoch MFU sweep (ISSUE 6): {tile} x {storage} x {route}
 # cells + per-sample baseline + convergence-trajectory envelope; emits
 # MFU_BENCH.json, rc!=0 when the winner misses the >=5x-over-r05 floor.
@@ -170,4 +185,4 @@ obs-bench:
 
 .PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
     ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
-    serve-bench io-bench epoch-bench mfu-bench mesh-bench
+    serve-bench io-bench epoch-bench dp-epoch-bench mfu-bench mesh-bench

@@ -288,15 +288,83 @@ _prefetch_thread = None
 # seeded shuffle and the training launch: listing, corpus load/gather,
 # device upload dispatch); shuffle_s isolates the glibc shuffle, which is
 # a byte-parity obligation identical in every mode; setup_* record the
-# pipeline's one-time corpus residency cost.
+# pipeline's one-time corpus residency cost.  The opt_state_* pair
+# (ISSUE 12) reports the MEASURED per-device footprint of the sharded
+# update state (BPM momentum + bf16-route f32 masters) next to what full
+# replication would cost; dp_devices is the data-axis width it was
+# measured over.
 EPOCH_METRICS = {"epochs": 0, "h2d_bytes": 0, "stage_s": 0.0,
                  "shuffle_s": 0.0, "setup_h2d_bytes": 0, "setup_s": 0.0,
-                 "mode": None}
+                 "mode": None, "opt_state_bytes_per_device": 0,
+                 "opt_state_replicated_bytes": 0, "dp_devices": 1}
 
 
 def reset_epoch_metrics() -> None:
     EPOCH_METRICS.update(epochs=0, h2d_bytes=0, stage_s=0.0, shuffle_s=0.0,
-                         setup_h2d_bytes=0, setup_s=0.0, mode=None)
+                         setup_h2d_bytes=0, setup_s=0.0, mode=None,
+                         opt_state_bytes_per_device=0,
+                         opt_state_replicated_bytes=0, dp_devices=1)
+
+
+def _dp_device_count() -> int:
+    """Device count for the [batch] DP routes: every visible device,
+    capped by ``HPNN_DP_DEVICES`` (operators pinning a run to a mesh
+    slice; tests comparing the sharded trajectory against the
+    single-device one in the same process).  On the pure-DP routes the
+    cap IS the data-axis width; on the hybrid [model]+[batch] route it
+    caps the WHOLE (data x model) grid -- the model axis keeps its
+    share, so ``HPNN_DP_DEVICES=4`` with ``[model] 2`` yields a 2x2
+    grid, not a 4x2 one."""
+    import jax
+
+    from .utils.env import env_int
+
+    ndev = jax.device_count()
+    cap = env_int("HPNN_DP_DEVICES", 0)
+    return max(1, min(ndev, cap)) if cap > 0 else ndev
+
+
+def _dp_slot_map(s: int, bsz: int, n_batches: int, bsz_pad: int):
+    """Epoch-invariant [batch] slot geometry, the ONE source for both
+    the restage staging scratch and the resident pipeline (the
+    resident==restage byte-parity guarantee rides on the two routes
+    agreeing): real row i lands at flat slot (i//bsz)*bsz_pad + i%bsz,
+    every other slot is a masked pad.  Returns (pos, mask) with mask
+    (n_batches, bsz_pad) float64 of 1.0 on real slots."""
+    pos = (np.arange(s) // bsz) * bsz_pad + np.arange(s) % bsz
+    mask = np.zeros((n_batches, bsz_pad), np.float64)
+    mask.reshape(-1)[pos] = 1.0
+    return pos, mask
+
+
+def _dp_banner_lines(s: int, bsz: int, n_batches: int, bsz_pad: int,
+                     n_data: int, unsharded: bool) -> list[str]:
+    """[batch] minibatch-route console banners -- like ``_dp_slot_map``,
+    the ONE source for the restage and resident paths (the strings are
+    a resident==restage byte-parity surface).  The hybrid-mesh banner
+    stays restage-only: the pipeline never takes the hybrid route."""
+    lines = []
+    if unsharded:
+        lines.append("DP: one device visible; minibatch training runs "
+                     "unsharded\n")
+    padded_rows = n_batches * bsz_pad - s
+    if padded_rows:
+        lines.append(f"DP: padding {padded_rows} masked row(s) "
+                     f"(S={s}, batch={bsz} -> {bsz_pad} over {n_data} "
+                     "data-shard(s))\n")
+    return lines
+
+
+def _dp_tiled_banner(group: int, pad_to: int, meshed: bool,
+                     storage) -> str:
+    """[batch]+[tile] engine banner, shared restage/resident (parity
+    surface)."""
+    eff = -(-group // pad_to) * pad_to
+    return ("DP: batched-tile convergence engine (group=" + str(group)
+            + (f" -> {eff} over {pad_to} data-shard(s)" if eff != group
+               else "")
+            + (f", mesh={pad_to}" if meshed else "")
+            + (f", storage={storage}" if storage else "") + ")\n")
 
 
 class _EpochPipeline:
@@ -321,24 +389,43 @@ class _EpochPipeline:
     shard trains -- double-buffered H2D under the busy device, weights
     still carried on device launch to launch.
 
+    ``[batch] B`` runs (ISSUE 12) ride the DP variant of the same
+    contract: the corpus lives sharded ``P("data", None)`` over the
+    data mesh, each epoch's shuffle becomes an int32 slot map consumed
+    by an on-device gather + batch reshape, and the update state (BPM
+    momentum; the f32 masters under [dtype] bf16) is carried
+    1/N-sharded cross-replica (``parallel.dp``, Xu et al.
+    arXiv:2004.13336) with its per-device bytes MEASURED into
+    ``EPOCH_METRICS`` every epoch.
+
     Byte parity: the trajectory is bit-identical to the restaging path
     (gather-then-cast == cast-then-gather; the wdtype device carry
-    round-trips through float64 losslessly), and the console stream is
+    round-trips through float64 losslessly; sharded update state is a
+    value-preserving relayout), and the console stream is
     byte-identical at the grammar levels (-vv) -- deferred segments are
     replayed in order, pre-rendered with the verbosity snapshotted at
     format time.  ``HPNN_NO_EPOCH_PIPELINE=1`` is the escape hatch.
     """
 
-    def __init__(self, rc, dtype, wdtype, shard_rows: int):
+    def __init__(self, rc, dtype, wdtype, shard_rows: int,
+                 dp: str | None = None, mesh=None):
         self.rc = rc                      # ResidentCorpus (listing order)
         self.dtype = dtype
         self.wdtype = wdtype
         self.shard_rows = shard_rows
-        self.mode = "sharded" if shard_rows else "resident"
+        self.dp = dp                      # None | "sgd" | "tiled"
+        self.mesh = mesh                  # data mesh ([batch] multi-device)
+        if dp:
+            self.mode = "dp-tiled-resident" if dp == "tiled" \
+                else "dp-resident"
+        else:
+            self.mode = "sharded" if shard_rows else "resident"
         self.weights = None               # device carry across epochs
+        self.shapes = None                # static weight shapes (DP carry)
         self.x_dev = None
         self.t_dev = None
         self.train_fn = None
+        self._dp_state = None             # lazy per-run DP epoch geometry
         # deferred console segments, strictly ordered: ("out", text)
         # literals (the trainer's EPOCH banners) and Futures resolving
         # to (rendered_stdout, epoch_summary)
@@ -352,10 +439,21 @@ class _EpochPipeline:
     def build(cls, nn, conf):
         """Resident pipeline for this run, or None when the corpus is
         missing/empty or has non-replayable diagnostics (the caller
-        stays on the per-epoch restaging path)."""
+        stays on the per-epoch restaging path).
+
+        ``[batch] B`` runs (ISSUE 12) build the DP variant: the corpus
+        is uploaded ONCE sharded ``P("data", None)`` over the data mesh
+        (rows zero-padded to the axis -- never gathered), and every
+        epoch becomes an on-device permutation-gather feeding the
+        minibatch engine (``dp == "sgd"``) or the batched-tile
+        convergence engine (``dp == "tiled"``, a [tile] request).  The
+        host-streaming shard mode stays single-device machinery: a
+        [batch] corpus over the per-device budget restages instead.
+        """
         import jax.numpy as jnp
 
         from .obs import trace as obs_trace
+        from .utils.env import env_int
 
         names = list_sample_dir(conf.samples)
         if not names:
@@ -372,30 +470,63 @@ class _EpochPipeline:
         wdtype = jnp.float32 if dtype == jnp.bfloat16 else dtype
         itemsize = jnp.dtype(dtype).itemsize
         row_bytes = (rc.X.shape[1] + rc.T.shape[1]) * itemsize
+        dp = None
+        mesh = None
+        n_data = 1
+        if conf.batch > 0:
+            dp = "tiled" if _tile_request(conf) else "sgd"
+            ndev = _dp_device_count()
+            if ndev > 1:
+                from .parallel import make_mesh
+
+                mesh = make_mesh(n_data=ndev, n_model=1)
+                n_data = ndev
         shard_rows = 0
-        env = os.environ.get("HPNN_EPOCH_SHARD_ROWS")
-        if env:
-            try:
-                v = int(env)
-            except ValueError:
-                v = 0
-            if 0 < v < rc.n_rows:
-                shard_rows = v
+        if os.environ.get("HPNN_EPOCH_SHARD_ROWS"):
+            # a SET knob suppresses the budget check entirely (the
+            # pre-consolidation contract: out-of-range/malformed values
+            # force the full-resident upload, they do not re-arm it)
+            env = env_int("HPNN_EPOCH_SHARD_ROWS", 0)
+            if 0 < env < rc.n_rows:
+                shard_rows = env
         else:
-            try:
-                budget = int(os.environ.get("HPNN_EPOCH_DEVICE_BUDGET_MB",
-                                            "4096") or 0) << 20
-            except ValueError:
-                budget = 4096 << 20  # malformed env: the safe default
-            if budget and rc.n_rows * row_bytes > budget:
+            budget = env_int("HPNN_EPOCH_DEVICE_BUDGET_MB", 4096,
+                             lo=0) << 20
+            if budget and rc.n_rows * row_bytes // n_data > budget:
                 # two shards live at once (double buffering)
                 shard_rows = max(1, budget // row_bytes // 2)
-        pipe = cls(rc, dtype, wdtype, shard_rows)
+        if dp and shard_rows:
+            nn_dbg("epoch pipeline: [batch] corpus over the per-device "
+                   "budget (host-stream sharding is single-device "
+                   "machinery); restaging\n")
+            return None
+        pipe = cls(rc, dtype, wdtype, shard_rows, dp=dp, mesh=mesh)
         if not shard_rows:
             # the ONE corpus upload of the whole run (cast once on the
             # way up -- elementwise, so identical to per-epoch casting)
-            pipe.x_dev = jnp.asarray(rc.X, dtype=dtype)
-            pipe.t_dev = jnp.asarray(rc.T, dtype=dtype)
+            if mesh is not None:
+                import jax
+
+                from .parallel.mesh import batch_sharding
+
+                # rows zero-padded to the data axis so the sharding
+                # divides; the permutation indexes real rows only, so
+                # the padding is never gathered
+                pad = (-rc.n_rows) % n_data
+                X, T = rc.X, rc.T
+                if pad:
+                    X = np.concatenate(
+                        [X, np.zeros((pad, X.shape[1]), X.dtype)])
+                    T = np.concatenate(
+                        [T, np.zeros((pad, T.shape[1]), T.dtype)])
+                bs = batch_sharding(mesh)
+                pipe.x_dev = jax.device_put(jnp.asarray(X, dtype=dtype),
+                                            bs)
+                pipe.t_dev = jax.device_put(jnp.asarray(T, dtype=dtype),
+                                            bs)
+            else:
+                pipe.x_dev = jnp.asarray(rc.X, dtype=dtype)
+                pipe.t_dev = jnp.asarray(rc.T, dtype=dtype)
             EPOCH_METRICS["setup_h2d_bytes"] += (pipe.x_dev.nbytes
                                                  + pipe.t_dev.nbytes)
             # nothing reads the host rows again on this route (events
@@ -403,8 +534,10 @@ class _EpochPipeline:
             # of keeping ~2x the corpus in RSS for the whole run
             rc.release_rows()
         EPOCH_METRICS["setup_s"] += time.perf_counter() - t0
+        EPOCH_METRICS["dp_devices"] = n_data
         nn_dbg(f"epoch pipeline: {pipe.mode}, {rc.n_rows} row(s)"
-               + (f", shard={shard_rows}" if shard_rows else "") + "\n")
+               + (f", shard={shard_rows}" if shard_rows else "")
+               + (f", mesh={n_data}" if mesh is not None else "") + "\n")
         return pipe
 
     # --- per-epoch --------------------------------------------------------
@@ -416,6 +549,10 @@ class _EpochPipeline:
 
         from . import ops
 
+        if self.dp == "sgd":
+            return self._run_epoch_dp_sgd(nn, sel, kind, momentum)
+        if self.dp == "tiled":
+            return self._run_epoch_dp_tiled(nn, sel, kind, momentum)
         t0 = time.perf_counter()
         if self.train_fn is None:
             if _tile_request(nn.conf):
@@ -459,6 +596,169 @@ class _EpochPipeline:
         self.pending.append(fut)
         nn.last_epoch_stats = None        # real after join()
         return stats
+
+    # --- [batch] DP epochs (ISSUE 12) -------------------------------------
+
+    def _dp_setup(self, nn, kind: str, momentum: bool):
+        """Lazy per-run DP epoch geometry: batch shapes, the
+        epoch-invariant mask and slot map, banner lines, the resident
+        weight carry layout.  Mirrors ``_train_kernel_dp``'s per-epoch
+        derivations exactly so the console stream stays byte-identical
+        to the restaging route."""
+        import jax.numpy as jnp
+
+        from . import ops
+        from .parallel.dp import dp_resident_carry
+        from .parallel.mesh import DATA_AXIS
+
+        conf = nn.conf
+        s = self.rc.n_rows
+        bsz = min(conf.batch, s)
+        n_batches = -(-s // bsz)
+        n_data = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
+        bsz_pad = -(-bsz // n_data) * n_data if self.mesh is not None \
+            else bsz
+        banners = _dp_banner_lines(s, bsz, n_batches, bsz_pad, n_data,
+                                   unsharded=self.mesh is None)
+        pos, mask = _dp_slot_map(s, bsz, n_batches, bsz_pad)
+        mb_dev = jnp.asarray(mask, dtype=self.dtype)
+        lr = ops.bpm_learn_rate(kind) if momentum \
+            else ops.bp_learn_rate(kind)
+        shard_master = (self.dtype == jnp.bfloat16
+                        and self.mesh is not None)
+        self.shapes = tuple(tuple(int(d) for d in w.shape)
+                            for w in nn.kernel.weights)
+        if self.weights is None:
+            staged = tuple(jnp.asarray(w, dtype=self.wdtype)
+                           for w in nn.kernel.weights)
+            self.weights = dp_resident_carry(staged, self.mesh,
+                                             shard_master)
+            EPOCH_METRICS["setup_h2d_bytes"] += sum(
+                int(np.prod(sh)) for sh in self.shapes) \
+                * jnp.dtype(self.wdtype).itemsize
+        self._dp_state = {"s": s, "bsz": bsz, "n_batches": n_batches,
+                          "bsz_pad": bsz_pad, "n_data": n_data,
+                          "pos": pos, "mb_dev": mb_dev, "lr": lr,
+                          "banners": banners,
+                          "shard_master": shard_master}
+        return self._dp_state
+
+    def _run_epoch_dp_sgd(self, nn, sel, kind: str, momentum: bool):
+        """One zero-restage minibatch DP epoch: host work is the int32
+        slot map only; gather, batch reshape, scan and the 1/N-sharded
+        update state all live on device (``dp_train_epoch_resident``)."""
+        import jax.numpy as jnp
+
+        from .obs import trace as obs_trace
+        from .parallel.dp import dp_train_epoch_resident
+        from .parallel.mesh import per_device_bytes
+
+        t0 = time.perf_counter()
+        if self._dp_state is None:
+            self._dp_setup(nn, kind, momentum)
+        st = self._dp_state
+        for text in st["banners"]:
+            self.pending.append(("out", text))
+        # THE per-epoch H2D: the permutation scattered into batch slots
+        flat = np.zeros(st["n_batches"] * st["bsz_pad"], np.int32)
+        flat[st["pos"]] = sel
+        sel_dev = jnp.asarray(flat)
+        self.h2d_last = flat.nbytes
+        self.stage_last = time.perf_counter() - t0
+        with obs_trace.span("device_launch", rows=int(sel.size),
+                            mode=self.mode, n_data=st["n_data"]):
+            new_w, dw, errs = dp_train_epoch_resident(
+                self.weights, self.x_dev, self.t_dev, sel_dev,
+                st["mb_dev"], kind, momentum, st["lr"], alpha=0.2,
+                mesh=self.mesh, shard_master=st["shard_master"],
+                shapes=self.shapes, donate=True)
+        self.weights = new_w
+        # measured (not by-construction) optimizer-state footprint
+        state_arrays = [a for a in (dw,) if a is not None]
+        if st["shard_master"]:
+            state_arrays.append(new_w)
+        params = sum(int(np.prod(sh)) for sh in self.shapes)
+        itemsize = jnp.dtype(self.wdtype).itemsize
+        EPOCH_METRICS["opt_state_bytes_per_device"] = \
+            per_device_bytes(state_arrays)
+        EPOCH_METRICS["opt_state_replicated_bytes"] = \
+            params * itemsize * len(state_arrays)
+        fut = corpus_io.io_pool().submit(
+            _render_dp_lines, errs, st["s"], nn_log.get_verbosity())
+        self.pending.append(fut)
+        nn.last_epoch_stats = None        # real after join()
+        return errs
+
+    def _run_epoch_dp_tiled(self, nn, sel, kind: str, momentum: bool):
+        """One zero-restage [batch]+[tile] epoch: permutation-gather
+        from the sharded resident rows, then the batched-tile
+        convergence engine with lanes over the data axis and the
+        momentum carry pinned cross-replica (``dp_tiled_epoch``)."""
+        import jax.numpy as jnp
+
+        from .obs import trace as obs_trace
+        from .parallel.dp import dp_tiled_epoch
+
+        t0 = time.perf_counter()
+        if self._dp_state is None:
+            self._dp_tiled_setup(nn, kind, momentum)
+        st = self._dp_state
+        if st["auto_warn"]:
+            nn_warn("[tile] auto on the [batch] route: the group size IS "
+                    "the minibatch and [tile] only sets launch "
+                    "granularity (results identical for any value) -- "
+                    "the autotuner does not apply; default launch "
+                    "sizing used\n")
+        self.pending.append(("out", st["banner"]))
+        sel_dev = jnp.asarray(sel)
+        self.h2d_last = sel.nbytes
+        with obs_trace.span("corpus_gather", rows=int(sel.size)):
+            xs = jnp.take(self.x_dev, sel_dev, axis=0)
+            ts = jnp.take(self.t_dev, sel_dev, axis=0)
+        self.stage_last = time.perf_counter() - t0
+        with obs_trace.span("device_launch", rows=int(sel.size),
+                            mode=self.mode):
+            new_w, stats = dp_tiled_epoch(
+                self.weights, xs, ts, kind, momentum, st["group"],
+                alpha=0.2, mesh=self.mesh,
+                launch_groups=st["launch_groups"],
+                storage=st["storage"], donate=True)
+        self.weights = tuple(new_w)
+        fut = corpus_io.io_pool().submit(
+            _render_training_lines, self.events_last, stats, kind,
+            momentum, nn_log.get_verbosity())
+        self.pending.append(fut)
+        nn.last_epoch_stats = None
+        return stats
+
+    def _dp_tiled_setup(self, nn, kind: str, momentum: bool):
+        """Lazy [batch]+[tile] geometry + the engine banner (identical
+        strings to ``_train_kernel_dp_tiled``)."""
+        import jax.numpy as jnp
+
+        from .parallel.mesh import DATA_AXIS
+
+        conf = nn.conf
+        s = self.rc.n_rows
+        group = min(conf.batch, s) if conf.batch > 0 else s
+        req = _tile_request(conf)
+        launch_groups = req if req > 0 else 0
+        storage = _tile_storage_env()
+        n_data = self.mesh.shape[DATA_AXIS] if self.mesh is not None else 1
+        banner = _dp_tiled_banner(group, n_data,
+                                  meshed=self.mesh is not None,
+                                  storage=storage)
+        self.shapes = tuple(tuple(int(d) for d in w.shape)
+                            for w in nn.kernel.weights)
+        if self.weights is None:
+            self.weights = tuple(jnp.asarray(w, dtype=self.wdtype)
+                                 for w in nn.kernel.weights)
+            EPOCH_METRICS["setup_h2d_bytes"] += sum(
+                w.nbytes for w in self.weights)
+        self._dp_state = {"group": group, "launch_groups": launch_groups,
+                          "storage": storage, "auto_warn": req < 0,
+                          "n_data": n_data, "banner": banner}
+        return self._dp_state
 
     def _sharded_epoch(self, sel, kind: str, momentum: bool):
         """Shuffled epoch over a corpus bigger than the device budget:
@@ -531,8 +831,16 @@ class _EpochPipeline:
                 nn.last_epoch_stats = summary
         self.pending = []
         if self.weights is not None:
-            nn.kernel.weights = [np.asarray(w, dtype=np.float64)
-                                 for w in self.weights]
+            if self.dp == "sgd":
+                # the DP carry may live as the flat 1/N-sharded master
+                # vector (bf16 route); export re-materializes layers
+                from .parallel.dp import dp_export_weights
+
+                nn.kernel.weights = dp_export_weights(self.weights,
+                                                      self.shapes)
+            else:
+                nn.kernel.weights = [np.asarray(w, dtype=np.float64)
+                                     for w in self.weights]
         return sums
 
 
@@ -550,7 +858,7 @@ def _pipeline_for(nn, conf):
             and conf.train in (NN_TRAIN_BP, NN_TRAIN_BPM)
             and conf.samples is not None
             and not os.environ.get("HPNN_NO_EPOCH_PIPELINE")
-            and conf.batch <= 0 and _model_shards(conf) <= 1):
+            and _model_shards(conf) <= 1):
         from .utils.trace import trace_enabled
 
         import jax
@@ -694,9 +1002,10 @@ def train_kernel(nn: NNDef) -> bool:
     wdtype = jnp.float32 if dtype == jnp.bfloat16 else dtype
     nn.last_epoch_stats = None
 
-    # device-resident epoch pipeline (multi-epoch runs, single-device
-    # route): corpus uploaded once per run, per-epoch H2D shrinks to the
-    # int32 permutation, weights carried on device epoch to epoch
+    # device-resident epoch pipeline (multi-epoch runs): corpus uploaded
+    # once per run -- sharded over the data mesh on the [batch] DP route
+    # (ISSUE 12) -- per-epoch H2D shrinks to the int32 permutation,
+    # weights carried on device epoch to epoch
     pipe = _pipeline_for(nn, conf)
     if pipe is not None:
         kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
@@ -911,6 +1220,23 @@ def _render_training_lines(events, stats, kind: str, momentum: bool,
     return "".join(parts), summary
 
 
+def _render_dp_lines(errs, n_samples: int, verbosity: int):
+    """Deferred rendering of the minibatch DP console stream (one line
+    per batch, ``_train_kernel_dp``'s exact format) plus the epoch
+    summary the checkpoint manifest records.  Runs on io_pool workers
+    for the DP epoch pipeline -- the np.asarray is the overlapped errs
+    D2H.  Returns (stdout_text, epoch_summary)."""
+    errs = np.asarray(errs, dtype=np.float64)
+    summary = {"samples": int(n_samples),
+               "mean_final": float(np.mean(errs)) if errs.size else None,
+               "success": 0}
+    if verbosity <= 1:
+        return "", summary
+    text = "".join(f"NN: TRAINING BATCH {i:8d}\t err={e:15.10f}\n"
+                   for i, e in enumerate(errs))
+    return text, summary
+
+
 def _emit_training_lines(events, stats, kind: str, momentum: bool) -> dict:
     """Render + emit the per-sample training stream; returns the epoch
     summary (mean final error, success count) the checkpoint manifest's
@@ -973,6 +1299,44 @@ def _train_kernel_tp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     return finish()
 
 
+# pooled DP staging scratch (ISSUE 12 satellite): the per-epoch
+# pad+scatter reuses one set of host buffers per batch geometry instead
+# of allocating (and zero-filling) n_batches * bsz_pad rows every epoch
+# -- pad slots are zeroed once at allocation and only real slots are
+# overwritten (jnp.asarray copies on dispatch, so reuse is safe).
+# Bounded like the serve registry's per-bucket scratch pools.
+_dp_scratch: dict = {}
+_DP_SCRATCH_MAX = 4
+
+
+def _dp_stage_batches(xs, ts, s: int, bsz: int, n_batches: int,
+                      bsz_pad: int, np_dtype):
+    """Vectorized [batch] host staging: one fancy-index scatter of the
+    shuffled rows into pooled (n_batches, bsz_pad, n) scratch --
+    replaces the per-batch Python copy loop that ran every epoch.
+    Returns (xb, tb, mb) with pad slots zero and mask 1.0 on real
+    slots, byte-identical to the old loop's output."""
+    # the FULL batch geometry keys the pool: bsz changes the slot map
+    # (pos) and mask even when (n_batches, bsz_pad, s) collide -- e.g.
+    # 9 samples as 3 batches of 3 vs 3 batches of 4, both padded to 8
+    key = (s, bsz, n_batches, bsz_pad, xs.shape[1], ts.shape[1],
+           np.dtype(np_dtype).str)
+    got = _dp_scratch.pop(key, None)
+    if got is None:
+        xb = np.zeros((n_batches, bsz_pad, xs.shape[1]), np_dtype)
+        tb = np.zeros((n_batches, bsz_pad, ts.shape[1]), np_dtype)
+        pos, mask = _dp_slot_map(s, bsz, n_batches, bsz_pad)
+        mb = mask.astype(np_dtype)
+        got = (xb, tb, mb, pos)
+    xb, tb, mb, pos = got
+    xb.reshape(-1, xs.shape[1])[pos] = xs
+    tb.reshape(-1, ts.shape[1])[pos] = ts
+    _dp_scratch[key] = got                # re-insertion refreshes LRU age
+    while len(_dp_scratch) > _DP_SCRATCH_MAX:
+        _dp_scratch.pop(next(iter(_dp_scratch)))
+    return xb, tb, mb
+
+
 def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
                      finish, model_shards: int = 1, events=None) -> bool:
     """Data-parallel minibatch epoch ([batch] B conf extension).
@@ -1020,6 +1384,7 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
         else:
             return _train_kernel_dp_tiled(nn, weights, xs, ts, kind,
                                           momentum, finish, events)
+    t_stage = time.perf_counter()
     lr = ops.bpm_learn_rate(kind) if momentum else ops.bp_learn_rate(kind)
     s = xs.shape[0]
     # (rank-divergence is handled by train_kernel's agreement gate, which
@@ -1027,7 +1392,7 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     bsz = min(conf.batch, s)
     n_batches = -(-s // bsz)
     dtype = _dtype_of(conf)
-    ndev = jax.device_count()
+    ndev = _dp_device_count()
     n_model = 1
     if model_shards > 1 and ndev == 1:
         nn_warn(f"[model] {model_shards} > 1 visible device(s); "
@@ -1047,19 +1412,14 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
         mesh = make_mesh(n_data=ndev // n_model, n_model=n_model)
     else:
         mesh = None
-    if mesh is None:
-        nn_out("DP: one device visible; minibatch training runs "
-               "unsharded\n")
-    elif n_model > 1:
+    if mesh is not None and n_model > 1:
         nn_out(f"DP: hybrid mesh {ndev // n_model}x{n_model} "
                "(batch rows over data, weight rows over model)\n")
     n_data = mesh.shape[DATA_AXIS] if mesh is not None else 1
     bsz_pad = -(-bsz // n_data) * n_data if mesh is not None else bsz
-    padded_rows = n_batches * bsz_pad - s
-    if padded_rows:
-        nn_out(f"DP: padding {padded_rows} masked row(s) "
-               f"(S={s}, batch={bsz} -> {bsz_pad} over {n_data} "
-               "data-shard(s))\n")
+    for line in _dp_banner_lines(s, bsz, n_batches, bsz_pad, n_data,
+                                 unsharded=mesh is None):
+        nn_out(line)
 
     # bf16 stages through f32 HOST buffers only: both device paths re-cast
     # to the conf dtype (single-process jnp.asarray below; multi-process
@@ -1067,15 +1427,8 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     # independent (ADVICE r3 checked exactly this)
     np_dtype = np.dtype(str(jnp.dtype(dtype))) if dtype != jnp.bfloat16 \
         else np.float32
-    xb = np.zeros((n_batches, bsz_pad, xs.shape[1]), np_dtype)
-    tb = np.zeros((n_batches, bsz_pad, ts.shape[1]), np_dtype)
-    mb = np.zeros((n_batches, bsz_pad), np_dtype)
-    for i in range(n_batches):
-        rows = slice(i * bsz, min((i + 1) * bsz, s))
-        k = rows.stop - rows.start
-        xb[i, :k] = xs[rows]
-        tb[i, :k] = ts[rows]
-        mb[i, :k] = 1.0
+    xb, tb, mb = _dp_stage_batches(xs, ts, s, bsz, n_batches, bsz_pad,
+                                   np_dtype)
 
     def wsh(w):
         # ONE hybrid placement rule for both process layouts: rows over
@@ -1106,6 +1459,12 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
         jmb = jnp.asarray(mb, dtype=dtype)
         if mesh is not None:
             weights = tuple(jax.device_put(w, wsh(w)) for w in weights)
+    EPOCH_METRICS["stage_s"] += time.perf_counter() - t_stage
+    EPOCH_METRICS["h2d_bytes"] += (jxb.nbytes + jtb.nbytes + jmb.nbytes
+                                   + sum(w.nbytes for w in weights))
+    EPOCH_METRICS["epochs"] += 1
+    EPOCH_METRICS["mode"] = "dp-restage"
+    EPOCH_METRICS["dp_devices"] = n_data
     new_weights, errs = dp_train_epoch_batched(
         weights, jxb, jtb, jmb, kind, momentum, lr, alpha=0.2, mesh=mesh)
     if jax.process_count() > 1 and n_model > 1:
@@ -1135,7 +1494,6 @@ def _train_kernel_dp_tiled(nn: NNDef, weights, xs, ts, kind: str,
     granularity only, SampleStats identical for ANY launch tiling
     (pinned in tests/test_tile_convergence.py).  Lane rows shard over
     the data mesh when more than one device is visible."""
-    import jax
     import jax.numpy as jnp
 
     from .parallel import make_mesh
@@ -1153,17 +1511,22 @@ def _train_kernel_dp_tiled(nn: NNDef, weights, xs, ts, kind: str,
                 "does not apply; default launch sizing used\n")
     launch_groups = req if req > 0 else 0
     storage = _tile_storage_env()
-    ndev = jax.device_count()
+    ndev = _dp_device_count()
     mesh = make_mesh(n_data=ndev, n_model=1) if ndev > 1 else None
     pad_to = mesh.shape["data"] if mesh is not None else 1
-    eff = -(-group // pad_to) * pad_to
-    nn_out(f"DP: batched-tile convergence engine (group={group}"
-           + (f" -> {eff} over {pad_to} data-shard(s)" if eff != group
-              else "")
-           + (f", mesh={ndev}" if mesh is not None else "")
-           + (f", storage={storage}" if storage else "") + ")\n")
+    nn_out(_dp_tiled_banner(group, pad_to, meshed=mesh is not None,
+                            storage=storage))
+    t_stage = time.perf_counter()
+    xs_dev = jnp.asarray(xs, dtype=dtype)
+    ts_dev = jnp.asarray(ts, dtype=dtype)
+    EPOCH_METRICS["stage_s"] += time.perf_counter() - t_stage
+    EPOCH_METRICS["h2d_bytes"] += (xs_dev.nbytes + ts_dev.nbytes
+                                   + sum(w.nbytes for w in weights))
+    EPOCH_METRICS["epochs"] += 1
+    EPOCH_METRICS["mode"] = "dp-tiled-restage"
+    EPOCH_METRICS["dp_devices"] = pad_to
     new_w, stats = dp_tiled_epoch(
-        weights, jnp.asarray(xs, dtype=dtype), jnp.asarray(ts, dtype=dtype),
+        weights, xs_dev, ts_dev,
         kind, momentum, group, alpha=0.2, mesh=mesh,
         launch_groups=launch_groups, storage=storage)
     # per-sample grammar again: load order == stats order, exactly like

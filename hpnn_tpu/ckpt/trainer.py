@@ -115,7 +115,9 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
             conf.seed = int(time.time())
         nn.shuffle_rng = GlibcRandom(conf.seed)
 
-    kill_at = int(os.environ.get("HPNN_CKPT_KILL_AT_EPOCH", "0") or 0)
+    from ..utils.env import env_int
+
+    kill_at = env_int("HPNN_CKPT_KILL_AT_EPOCH", 0)
     banner = epochs > 1 or start_epoch > 0
     if stop is None:
         stop = threading.Event()

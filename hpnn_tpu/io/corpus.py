@@ -68,6 +68,7 @@ import time
 import numpy as np
 
 from ..utils import nn_log
+from ..utils.env import env_int
 from ..utils.nn_log import nn_dbg, nn_error
 from . import samples
 from .samples import read_sample_fast
@@ -137,11 +138,7 @@ def set_cache_max_mb(mb: int | None) -> None:
 def _cache_max_bytes() -> int:
     if _cache_max_mb_override is not None:
         return _cache_max_mb_override << 20
-    env = os.environ.get("HPNN_CORPUS_CACHE_MAX_MB")
-    try:
-        return (int(env) << 20) if env else 0
-    except ValueError:
-        return 0
+    return env_int("HPNN_CORPUS_CACHE_MAX_MB", 0, lo=0) << 20
 
 
 def gc_cache(protect: tuple[str, ...] = ()) -> list[str]:
@@ -232,9 +229,11 @@ def _pack_build_lock(dirpath: str):
 
 
 def io_threads() -> int:
-    env = os.environ.get("HPNN_IO_THREADS")
-    if env:
-        return max(1, int(env))
+    # a SET knob always wins, clamped to >= 1 (HPNN_IO_THREADS=0 means
+    # serial, exactly like the pre-consolidation max(1, int(env)));
+    # malformed degrades to 1 -- the safe width -- not to auto sizing
+    if os.environ.get("HPNN_IO_THREADS"):
+        return env_int("HPNN_IO_THREADS", 1, lo=1)
     if os.environ.get("HPNN_NO_PARALLEL_IO"):
         return 1
     return max(1, min(32, os.cpu_count() or 1))

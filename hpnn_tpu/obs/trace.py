@@ -96,12 +96,9 @@ def enable(capacity: int | None = None) -> None:
     capacity re-rings, dropping recorded spans)."""
     global _state
     if capacity is None:
-        try:
-            capacity = int(os.environ.get("HPNN_TRACE_BUFFER",
-                                          str(_DEFAULT_CAPACITY)))
-        except ValueError:
-            capacity = _DEFAULT_CAPACITY
-        capacity = max(16, capacity)
+        from ..utils.env import env_int
+
+        capacity = env_int("HPNN_TRACE_BUFFER", _DEFAULT_CAPACITY, lo=16)
     if _state is not None and _state.capacity == capacity:
         return
     _state = _State(capacity)

@@ -161,7 +161,7 @@ def _upd(d, h, precision):
 
 def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
                 n_layers, n_out, kind, momentum, lr, alpha, min_iter,
-                max_iter, delta, precision, acc_dtype):
+                max_iter, delta, precision, acc_dtype, dw_spec=None):
     """One group of S samples trained to convergence, lockstep with
     per-lane masking.  Two weight-state modes serve the two routes:
 
@@ -177,6 +177,12 @@ def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
     resident -> acc, add the f32+ update, quantize back to resident.
     None adds in the resident dtype (the per-sample kernels' exact
     behavior -- required for the tile=1 bitwise guarantee).
+
+    ``dw_spec`` (XLA route under a data mesh, ISSUE 12): per-layer
+    shardings pinning the momentum carry cross-replica between lockstep
+    iterations -- each replica stores its row block of ``dw`` and GSPMD
+    re-materializes it only at the ``W += dw`` use site.  Constraints
+    are value-preserving, so the trajectory is unchanged.
     """
     dtype = x.dtype
     s, npl = t.shape
@@ -244,10 +250,16 @@ def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
               false_s1,                      # per-lane first_ok
               valid,                         # per-lane liveness
               acts0, init_err]
+    def _pin_dw(vals):
+        if dw_spec is None:
+            return tuple(vals)
+        return tuple(lax.with_sharding_constraint(v, sp)
+                     for v, sp in zip(vals, dw_spec))
+
     if carry_w:
-        dw0 = (tuple(jnp.zeros(w.shape,
-                               acc_dtype if acc_dtype is not None
-                               else w.dtype) for w in w0)
+        dw0 = (_pin_dw(jnp.zeros(w.shape,
+                                 acc_dtype if acc_dtype is not None
+                                 else w.dtype) for w in w0)
                if momentum else ())
         state0.append(tuple(w0))
         state0.append(dw0)
@@ -322,7 +334,7 @@ def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
         out = [it, n_it, dep, ok_raw, first_ok, live, new_acts, new_epr]
         if carry_w:
             out.append(tuple(w_loc))
-            out.append(tuple(dw_loc))
+            out.append(_pin_dw(dw_loc) if momentum else tuple(dw_loc))
         return tuple(out)
 
     final = lax.while_loop(cond, body, state0)
@@ -336,19 +348,33 @@ def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
 
 def _tiled_epoch_xla_impl(weights, xg, tg, vg, kind: str, momentum: bool,
                           alpha, delta, lr, precision, storage,
-                          max_iter=None):
+                          max_iter=None, mesh=None):
     """Jitted XLA core: scan over groups, lockstep while_loop inside.
 
     xg (G, S, n_in), tg (G, S, n_out), vg (G, S, 1) row-validity mask.
     Weights arrive ALREADY cast to the resident dtype (the public
-    wrapper owns the cast so donation can alias them).  Returns
-    (weights, stats (G, S, 5) f32).
+    wrapper owns the cast so donation can alias them).  ``mesh`` (the
+    [batch] DP route) pins the momentum carry cross-replica over the
+    data axis where a layer's rows divide it (ISSUE 12) -- sharding
+    constraints only, trajectory unchanged.  Returns (weights, stats
+    (G, S, 5) f32).
     """
     lr, delta, min_iter, max_iter = resolve_hyper(kind, momentum, lr,
                                                   delta, max_iter)
     n_layers = len(weights)
     n_out_real = tg.shape[2]
     acc_dtype = _accum_dtype(storage)
+    dw_spec = None
+    if mesh is not None and momentum:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import DATA_AXIS
+
+        k = mesh.shape[DATA_AXIS]
+        dw_spec = tuple(
+            NamedSharding(mesh, P(DATA_AXIS, None)
+                          if w.shape[0] % k == 0 else P())
+            for w in weights)
 
     def step(carry, gxtv):
         gx, gt, gv = gxtv
@@ -357,7 +383,7 @@ def _tiled_epoch_xla_impl(weights, xg, tg, vg, kind: str, momentum: bool,
             n_layers=n_layers, n_out=n_out_real, kind=kind,
             momentum=momentum, lr=lr, alpha=alpha, min_iter=min_iter,
             max_iter=max_iter, delta=delta, precision=precision,
-            acc_dtype=acc_dtype)
+            acc_dtype=acc_dtype, dw_spec=dw_spec)
         init_err, first_ok, n_it, dep, success = cols
         # stats rows keep the error dtype's width: f32 on the
         # throughput dtypes (the Pallas LANE-row rule), f64 on the f64
@@ -376,10 +402,10 @@ def _tiled_epoch_xla_impl(weights, xg, tg, vg, kind: str, momentum: bool,
 _TILE_STATIC = ("kind", "momentum", "alpha", "delta", "lr", "precision",
                 "storage", "max_iter")
 _tiled_epoch_xla = jax.jit(_tiled_epoch_xla_impl,
-                           static_argnames=_TILE_STATIC)
+                           static_argnames=_TILE_STATIC + ("mesh",))
 # donated sibling for the epoch pipeline's device-resident weight carry
 _tiled_epoch_xla_donated = jax.jit(_tiled_epoch_xla_impl,
-                                   static_argnames=_TILE_STATIC,
+                                   static_argnames=_TILE_STATIC + ("mesh",),
                                    donate_argnames=("weights",))
 
 
@@ -652,6 +678,8 @@ def train_epoch_tiled(weights, xs, ts, kind: str, momentum: bool,
         core = (_tiled_epoch_xla_donated
                 if donate and jax.default_backend() not in ("cpu",)
                 else _tiled_epoch_xla)
+        if mesh is not None:
+            core = functools.partial(core, mesh=mesh)
 
     g = xg.shape[0]
     chunk = int(launch_groups) if launch_groups else 0

@@ -32,8 +32,17 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+import numpy as np
+
 from ..ops import steps
-from .mesh import DATA_AXIS, batch_sharding, replicated
+from .mesh import (
+    DATA_AXIS,
+    batch_sharding,
+    flat_state_sharding,
+    flatten_state,
+    replicated,
+    unflatten_state,
+)
 
 
 def batched_grads(weights, xs, ts, kind: str, mask=None):
@@ -93,6 +102,65 @@ def dp_train_step_momentum(weights, dw, xs, ts, kind: str, lr, alpha,
     return weights, dw, err
 
 
+def _dp_epoch_scan(w_carry, xb, tb, mb, kind: str, momentum: bool, lr,
+                   alpha, mesh, shard_master: bool, shapes):
+    """The ONE minibatch epoch scan, shared by the restage and resident
+    entry points -- with the update state held in the cross-replica
+    layout (ISSUE 12, Xu et al. arXiv:2004.13336).
+
+    The BPM momentum lives as ONE flat vector, padded to the data-axis
+    size and (under a mesh) sharded ``P("data")`` between scan steps --
+    each replica stores 1/N of it.  ``shard_master=True`` (the [dtype]
+    bf16 route, where the f32 master weights are update state rather
+    than the serving model) holds the weight carry the same way and
+    re-materializes the per-layer views (one all-gather of the flat
+    vector) only where the layer GEMMs consume them.  Every op in the
+    flat domain is value-preserving (concat/pad/slice/elementwise), so
+    sharded state is BITWISE-identical to the replicated layout --
+    pinned in tests/test_dp_pipeline.py.
+
+    ``w_carry`` is the per-layer tuple (``shard_master=False``) or the
+    flat master vector; returns ``((w_carry, dw_flat), errs)``.
+    """
+    n_data = mesh.shape[DATA_AXIS] if mesh is not None else 1
+    fs = flat_state_sharding(mesh) if mesh is not None else None
+
+    def cons(v):
+        return lax.with_sharding_constraint(v, fs) if fs is not None else v
+
+    if momentum:
+        total = sum(int(np.prod(sh)) for sh in shapes)
+        total += (-total) % n_data
+        wdtype = w_carry.dtype if shard_master else w_carry[0].dtype
+        dw0 = cons(jnp.zeros((total,), wdtype))
+    else:
+        dw0 = ()
+
+    def step(carry, xtm):
+        wc, dw = carry
+        ws = unflatten_state(wc, shapes) if shard_master else wc
+        x, t, m = xtm
+        grads, err = batched_grads(ws, x, t, kind, m)
+        if momentum:
+            # reference order dw+=lr*g; W+=dw; dw*=alpha
+            # (ann.c:1996-1999), in the flat domain
+            dw = cons(dw + lr * flatten_state(grads, n_data))
+            if shard_master:
+                wc = cons(wc + dw)
+            else:
+                dws = unflatten_state(dw, shapes)
+                wc = tuple(w + b for w, b in zip(wc, dws))
+            dw = cons(alpha * dw)
+        else:
+            if shard_master:
+                wc = cons(wc + lr * flatten_state(grads, n_data))
+            else:
+                wc = tuple(w + lr * g for w, g in zip(wc, grads))
+        return (wc, dw), err
+
+    return lax.scan(step, (w_carry, dw0), (xb, tb, mb))
+
+
 @functools.partial(jax.jit,
                    static_argnames=("kind", "momentum", "mesh"))
 def dp_train_epoch_batched(weights, xb, tb, mb, kind: str, momentum: bool,
@@ -105,13 +173,13 @@ def dp_train_epoch_batched(weights, xb, tb, mb, kind: str, momentum: bool,
     size -- so the SAME function serves single-controller jnp arrays and
     multi-process global arrays (jax.make_array_from_callback).  With
     ``mesh``, batch rows are constrained to the data axis so the gradient
-    contraction all-reduces over ICI/DCN.  Returns (weights, per-batch
-    mean errors over REAL rows).
+    contraction all-reduces over ICI/DCN, and the BPM momentum rides the
+    scan carry 1/N-sharded (``_dp_epoch_scan`` -- bitwise-identical to
+    the replicated layout).  Returns (weights, per-batch mean errors
+    over REAL rows).
     """
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
-
-        from .mesh import DATA_AXIS
 
         xb = lax.with_sharding_constraint(
             xb, NamedSharding(mesh, P(None, DATA_AXIS, None)))
@@ -119,20 +187,94 @@ def dp_train_epoch_batched(weights, xb, tb, mb, kind: str, momentum: bool,
             tb, NamedSharding(mesh, P(None, DATA_AXIS, None)))
         mb = lax.with_sharding_constraint(
             mb, NamedSharding(mesh, P(None, DATA_AXIS)))
-    dw0 = tuple(jnp.zeros_like(w) for w in weights) if momentum else ()
-
-    def step(carry, xtm):
-        w, dw = carry
-        x, t, m = xtm
-        if momentum:
-            w, dw, err = dp_train_step_momentum(w, dw, x, t, kind,
-                                                lr, alpha, m)
-        else:
-            w, err = dp_train_step(w, x, t, kind, lr, m)
-        return (w, dw), err
-
-    (w, _), errs = lax.scan(step, (weights, dw0), (xb, tb, mb))
+    shapes = tuple(tuple(int(d) for d in w.shape) for w in weights)
+    (w, _), errs = _dp_epoch_scan(tuple(weights), xb, tb, mb, kind,
+                                  momentum, lr, alpha, mesh, False, shapes)
     return w, errs
+
+
+def _dp_resident_impl(w_carry, x_res, t_res, sel, mb, kind: str,
+                      momentum: bool, lr, alpha, mesh, shard_master: bool,
+                      shapes):
+    """Jitted core of the zero-restage DP epoch: permutation-gather the
+    shuffled batches from the device-RESIDENT (and, under a mesh,
+    row-sharded) corpus, then run the shared epoch scan.  ``sel`` is the
+    epoch's only H2D traffic -- a flat (n_batches * bsz_pad,) int32 map
+    from batch slot to resident row (padded slots point at row 0; their
+    mask is 0, and a masked row's delta is exactly zero, so any finite
+    row is numerically inert there)."""
+    nb, bp = mb.shape
+    xb = jnp.take(x_res, sel, axis=0).reshape(nb, bp, x_res.shape[1])
+    tb = jnp.take(t_res, sel, axis=0).reshape(nb, bp, t_res.shape[1])
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        bsh = NamedSharding(mesh, P(None, DATA_AXIS, None))
+        xb = lax.with_sharding_constraint(xb, bsh)
+        tb = lax.with_sharding_constraint(tb, bsh)
+        mb = lax.with_sharding_constraint(
+            mb, NamedSharding(mesh, P(None, DATA_AXIS)))
+    (wc, dw), errs = _dp_epoch_scan(w_carry, xb, tb, mb, kind, momentum,
+                                    lr, alpha, mesh, shard_master, shapes)
+    return wc, (dw if momentum else None), errs
+
+
+_DP_RES_STATIC = ("kind", "momentum", "mesh", "shard_master", "shapes")
+_dp_resident = jax.jit(_dp_resident_impl, static_argnames=_DP_RES_STATIC)
+# donated sibling for the epoch pipeline's launch-to-launch weight carry
+_dp_resident_donated = jax.jit(_dp_resident_impl,
+                               static_argnames=_DP_RES_STATIC,
+                               donate_argnames=("w_carry",))
+
+
+def dp_train_epoch_resident(w_carry, x_res, t_res, sel, mb, kind: str,
+                            momentum: bool, lr, alpha=0.2, *, mesh=None,
+                            shard_master=False, shapes=None,
+                            donate=False):
+    """One zero-restage DP epoch over the resident corpus (ISSUE 12
+    tentpole): ``x_res``/``t_res`` live on device across the whole run
+    (sharded ``P("data", None)`` under a mesh), each epoch ships only
+    the int32 permutation ``sel`` and gathers on device.  ``w_carry``
+    comes from :func:`dp_resident_carry` and is DONATED launch to launch
+    on accelerator backends (``donate=True``); the returned carry feeds
+    the next epoch.  Returns ``(w_carry, dw_flat_or_None, errs)`` --
+    ``dw_flat`` is the epoch's final 1/N-sharded momentum, returned so
+    the caller can MEASURE its per-device bytes (mesh.per_device_bytes)
+    instead of claiming the layout by construction."""
+    if shapes is None:
+        shapes = tuple(tuple(int(d) for d in w.shape) for w in w_carry)
+    core = (_dp_resident_donated
+            if donate and jax.default_backend() != "cpu"
+            else _dp_resident)
+    return core(w_carry, x_res, t_res, sel, mb, kind, momentum, lr,
+                alpha, mesh, shard_master, shapes)
+
+
+def dp_resident_carry(weights, mesh=None, shard_master=False):
+    """The epoch-to-epoch weight carry in its resident layout: the flat
+    1/N-sharded master vector on the bf16 route under a mesh, else the
+    per-layer tuple (replicated on the mesh when one exists)."""
+    if shard_master and mesh is not None:
+        flat = flatten_state(tuple(weights), mesh.shape[DATA_AXIS])
+        return jax.device_put(flat, flat_state_sharding(mesh))
+    if mesh is not None:
+        rep = replicated(mesh)
+        return tuple(jax.device_put(w, rep) for w in weights)
+    return tuple(weights)
+
+
+def dp_export_weights(w_carry, shapes=None):
+    """Resident carry -> per-layer float64 numpy (the form snapshots and
+    ``kernel.opt`` dumps read).  Accepts both carry layouts."""
+    if isinstance(w_carry, (tuple, list)):
+        return [np.asarray(w, dtype=np.float64) for w in w_carry]
+    flat = np.asarray(w_carry, dtype=np.float64)
+    out, lo = [], 0
+    for sh in shapes:
+        n = int(np.prod(sh))
+        out.append(flat[lo:lo + n].reshape(sh))
+        lo += n
+    return out
 
 
 def dp_train_epoch(weights, xs, ts, kind: str, momentum: bool,
@@ -159,7 +301,7 @@ def dp_train_epoch(weights, xs, ts, kind: str, momentum: bool,
 
 def dp_tiled_epoch(weights, xs, ts, kind: str, momentum: bool, group: int,
                    lr=None, alpha=0.2, mesh=None, launch_groups: int = 0,
-                   storage=None, route=None):
+                   storage=None, route=None, donate=False):
     """[batch]-route convergence engine (ISSUE 6): every [batch]-sized
     group of samples trains TO CONVERGENCE in lockstep with per-lane
     masking (``ops.convergence_tile``), instead of taking one minibatch
@@ -197,7 +339,7 @@ def dp_tiled_epoch(weights, xs, ts, kind: str, momentum: bool, group: int,
     return train_epoch_tiled(weights, xs, ts, kind, momentum, alpha=alpha,
                              lr=lr, tile=tile, lane_tile=lane_tile,
                              storage=storage, route=route, mesh=mesh,
-                             launch_groups=launch_groups)
+                             launch_groups=launch_groups, donate=donate)
 
 
 @functools.partial(jax.jit, static_argnames=("kind", "mesh"))

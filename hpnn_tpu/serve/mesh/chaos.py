@@ -30,7 +30,12 @@ Spec grammar (``HPNN_FAULT`` env var, or :func:`configure`)::
     spec  := rule (';' rule)*
     rule  := kind ['@' substr] [':' key '=' val (',' key '=' val)*]
     kind  := reset | reset-after | timeout | truncate | http | latency
-    keys  := after=N    skip the first N matching calls
+    keys  := side=S     client (default: injected in mesh.transport
+                        below every outgoing RPC) or server (injected
+                        in the worker's OWN response path -- fabricated
+                        5xx, half-written responses, latency, aborted
+                        connections -- before any handler runs)
+             after=N    skip the first N matching calls
              every=N    then fire on every Nth matching call (default 1)
              times=N    fire at most N times total (default unlimited)
              gap_ms=F   never fire within F ms of this rule's previous
@@ -67,19 +72,22 @@ KINDS = ("reset", "reset-after", "timeout", "truncate", "http",
 
 _INT_KEYS = ("after", "every", "times", "seed", "code")
 _FLOAT_KEYS = ("p", "ms", "gap_ms")
+_STR_KEYS = ("side",)
+SIDES = ("client", "server")
 
 
 class FaultRule:
     """One parsed rule + its live schedule state."""
 
     __slots__ = ("kind", "match", "after", "every", "times", "p",
-                 "seed", "ms", "code", "gap_ms", "calls", "fired",
-                 "_rng", "_t_last_fire")
+                 "seed", "ms", "code", "gap_ms", "side", "calls",
+                 "fired", "_rng", "_t_last_fire")
 
     def __init__(self, kind: str, match: str | None = None,
                  after: int = 0, every: int = 1, times: int = 0,
                  p: float = 1.0, seed: int = 0, ms: float = 100.0,
-                 code: int = 503, gap_ms: float = 0.0):
+                 code: int = 503, gap_ms: float = 0.0,
+                 side: str = "client"):
         if kind not in KINDS:
             raise ValueError(f"unknown fault kind {kind!r} "
                              f"(one of {', '.join(KINDS)})")
@@ -87,6 +95,9 @@ class FaultRule:
             raise ValueError("every must be >= 1")
         if not 0.0 <= p <= 1.0:
             raise ValueError("p must be in [0, 1]")
+        if side not in SIDES:
+            raise ValueError(f"side must be one of {', '.join(SIDES)}: "
+                             f"{side!r}")
         self.kind = kind
         self.match = match or None
         self.after = int(after)
@@ -97,6 +108,7 @@ class FaultRule:
         self.ms = float(ms)
         self.code = int(code)
         self.gap_ms = float(gap_ms)
+        self.side = side
         self.calls = 0               # matching calls seen
         self.fired = 0               # injections performed
         self._rng = random.Random(self.seed)
@@ -132,7 +144,7 @@ class FaultRule:
         return {"kind": self.kind, "match": self.match,
                 "after": self.after, "every": self.every,
                 "times": self.times, "gap_ms": self.gap_ms,
-                "p": self.p, "seed": self.seed,
+                "p": self.p, "seed": self.seed, "side": self.side,
                 "calls": self.calls, "fired": self.fired}
 
 
@@ -157,6 +169,8 @@ def parse_spec(spec: str) -> list[FaultRule]:
                     kw[key] = int(val)
                 elif key in _FLOAT_KEYS:
                     kw[key] = float(val)
+                elif key in _STR_KEYS:
+                    kw[key] = val.strip()
                 else:
                     raise ValueError(f"unknown fault option {key!r}")
         rules.append(FaultRule(kind.strip(), match.strip() or None,
@@ -206,9 +220,14 @@ def _configure_from_env() -> None:
         configure(None)
 
 
-def pick(path: str) -> FaultRule | None:
-    """The transport layer's hook: the first rule whose schedule fires
-    for this request path, or None.  At most one rule fires per call."""
+def pick(path: str, side: str = "client") -> FaultRule | None:
+    """The injection hook: the first rule of the given ``side`` whose
+    schedule fires for this request path, or None.  At most one rule
+    fires per call.  ``side="client"`` is the transport layer
+    (mesh.transport.request, below every mesh RPC); ``side="server"``
+    is the worker's OWN response path (serve.server, ISSUE 12
+    satellite) -- a rule only sees, and only advances its schedule on,
+    calls from its own side."""
     if _rules is None:
         # first use: consult the env (racing parsers are idempotent)
         _configure_from_env()
@@ -216,9 +235,11 @@ def pick(path: str) -> FaultRule | None:
         return None
     with _lock:
         for rule in _rules or ():
+            if rule.side != side:
+                continue
             if rule.should_fire(path):
                 nn_dbg(f"chaos: injecting {rule.kind} on {path} "
-                       f"(fired {rule.fired})\n")
+                       f"({side}-side, fired {rule.fired})\n")
                 return rule
     return None
 

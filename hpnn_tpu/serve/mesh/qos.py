@@ -32,6 +32,8 @@ import os
 import threading
 import time
 
+from ...utils.env import env_float, env_int
+
 # lane numbering: dequeue order, lowest first.  "normal" is the default
 # for requests that carry no X-HPNN-Priority header.
 LANE_HIGH, LANE_NORMAL, LANE_LOW = 0, 1, 2
@@ -186,17 +188,9 @@ def desired_workers(queued_rows: int, drain_rows_per_s: float,
       to [1, HPNN_MESH_MAX_WORKERS].
     """
     if target_drain_s is None:
-        try:
-            target_drain_s = float(
-                os.environ.get("HPNN_MESH_TARGET_DRAIN_S", "") or 1.0)
-        except ValueError:
-            target_drain_s = 1.0
+        target_drain_s = env_float("HPNN_MESH_TARGET_DRAIN_S", 1.0)
     if max_workers is None:
-        try:
-            max_workers = int(
-                os.environ.get("HPNN_MESH_MAX_WORKERS", "") or 64)
-        except ValueError:
-            max_workers = 64
+        max_workers = env_int("HPNN_MESH_MAX_WORKERS", 64)
     live = max(1, int(live_workers))
     if queued_rows <= 0:
         return 1

@@ -49,11 +49,7 @@ from .events import mesh_event
 
 
 def _heartbeat_s(default: float = 2.0) -> float:
-    try:
-        return float(os.environ.get("HPNN_MESH_HEARTBEAT_S", "")
-                     or default)
-    except ValueError:
-        return default
+    return env_float("HPNN_MESH_HEARTBEAT_S", default)
 
 
 def _path_matches_blob(path: str, blob: dict) -> bool:

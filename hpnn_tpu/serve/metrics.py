@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Callable
 
+from ..utils.env import env_float
+
 # log-spaced latency bounds: 100 us .. ~107 s, factor 1.26 (log10 step
 # 0.1) -- 61 buckets, ~12% relative quantile error, good enough to tell
 # a 2 ms batch hit from a 50 ms queue stall
@@ -306,15 +308,12 @@ class ServeMetrics:
 
     def slow_threshold_s(self, hist: LatencyHistogram) -> float | None:
         """``HPNN_SLOW_SPAN_MULT`` x the given bucket histogram's p99,
-        or None while the flag cannot fire (too few observations, knob
-        set to 0, or a malformed knob value).  Takes the histogram, not
+        or None while the flag cannot fire (too few observations, or
+        the knob set to 0; a malformed value falls back to the default
+        mult, the shared utils.env contract).  Takes the histogram, not
         the bucket id, so the caller pays the registry lock once for
         both the threshold check and its own observe."""
-        env = os.environ.get("HPNN_SLOW_SPAN_MULT", "")
-        try:
-            mult = float(env) if env else 4.0
-        except ValueError:
-            return None
+        mult = env_float("HPNN_SLOW_SPAN_MULT", 4.0)
         if mult <= 0.0:
             return None
         if hist.count < self.SLOW_SPAN_MIN_COUNT:

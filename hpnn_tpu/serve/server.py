@@ -151,6 +151,7 @@ import numpy as np
 
 from ..utils.nn_log import nn_dbg, nn_out
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull, ServeClosed
+from .mesh import chaos
 from .mesh import qos as mesh_qos
 from .mesh.backend import NoLiveWorker, RemoteHTTPError
 from .metrics import ServeMetrics
@@ -1041,6 +1042,55 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # route through nn_log, not stderr
         nn_dbg("serve: " + (fmt % args) + "\n")
 
+    def _chaos_server(self) -> bool:
+        """Server-side HPNN_FAULT injection (ISSUE 12 satellite): the
+        worker's OWN response path produces the failure, so the
+        client's recovery machinery (router retry-once-elsewhere,
+        transport stale-retry, blob re-fetch) is exercised against real
+        half-written bytes instead of only transport-layer stand-ins.
+        Consulted at the top of every request, before any handler:
+
+        * ``http``     -- fabricated ``code`` reply, handler never runs;
+        * ``latency``  -- ``ms`` delay, then the request proceeds;
+        * ``truncate`` -- headers claim a full JSON body, HALF of it is
+          written, the connection closes (the client sees
+          ``IncompleteRead`` mid-body);
+        * ``reset``/``reset-after``/``timeout`` -- the connection is
+          severed without a response (the in-process analog of the
+          handler dying mid-request).
+
+        Returns True when the request was consumed by the fault."""
+        rule = chaos.pick(self.path, side="server")
+        if rule is None:
+            return False
+        if rule.kind == "latency":
+            time.sleep(rule.ms / 1e3)
+            return False
+        if rule.kind == "http":
+            self._reply(rule.code, {"error": "injected fault",
+                                    "reason": "chaos"})
+            return True
+        if rule.kind == "truncate":
+            body = (json.dumps({"ok": True, "note": "chaos-truncate"})
+                    + "\n").encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body[:len(body) // 2])
+            self.wfile.flush()
+            self.close_connection = True
+            return True
+        # reset / reset-after / timeout: sever without a response
+        import socket as _socket
+
+        try:
+            self.connection.shutdown(_socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self.close_connection = True
+        return True
+
     def _reply(self, status: int, payload: dict,
                content_type: str = "application/json",
                extra_headers: dict | None = None) -> None:
@@ -1055,6 +1105,8 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:
+        if self._chaos_server():
+            return
         path, _, query = self.path.partition("?")
         if path == "/healthz":
             warming = self.app.warming()
@@ -1288,6 +1340,8 @@ class _Handler(BaseHTTPRequestHandler):
             self.app.metrics.count_request("bad_request")
             self._reply(400, {"error": "bad Content-Length",
                               "reason": "bad_request"})
+            return
+        if self._chaos_server():
             return
         path = self.path.partition("?")[0]
         r = _RELOAD_RE.match(path)

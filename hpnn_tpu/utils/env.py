@@ -1,22 +1,37 @@
 """Tolerant environment-knob parsing, shared by every subsystem that
 reads an ``HPNN_*`` tuning value: a malformed value falls back to the
 default instead of raising -- a typo'd knob must degrade a tunable,
-never kill a server."""
+never kill a server.  ``lo``/``hi`` clamp the RETURNED value (parsed or
+default) into the knob's sane range, replacing the ad-hoc ``max(1, ...)``
+wrappers each call site used to carry.  The fallback/clamp contract is
+tested once, in tests/test_env.py, for every consumer."""
 
 from __future__ import annotations
 
 import os
 
 
-def env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+def _clamp(v, lo, hi):
+    if lo is not None and v < lo:
+        v = lo
+    if hi is not None and v > hi:
+        v = hi
+    return v
 
 
-def env_float(name: str, default: float) -> float:
+def env_int(name: str, default: int, lo: int | None = None,
+            hi: int | None = None) -> int:
     try:
-        return float(os.environ.get(name, "") or default)
+        v = int(os.environ.get(name, "") or default)
     except ValueError:
-        return default
+        v = default
+    return _clamp(v, lo, hi)
+
+
+def env_float(name: str, default: float, lo: float | None = None,
+              hi: float | None = None) -> float:
+    try:
+        v = float(os.environ.get(name, "") or default)
+    except ValueError:
+        v = default
+    return _clamp(v, lo, hi)

@@ -42,6 +42,14 @@ import sys
 import time
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# the DP rows measure the mesh-sharded resident pipeline (ISSUE 12):
+# force the virtual 8-device CPU mesh before any jax import unless the
+# operator already pinned a topology (chip rounds)
+if any(a.startswith("--dp") for a in sys.argv):
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
 
 import numpy as np  # noqa: E402
 
@@ -99,7 +107,8 @@ def _stub_select_train_epoch(dtype=None, donate=False, defer_stats=False):
     return stub_epoch, "stub"
 
 
-def run_mode(conf_path: str, epochs: int, pipelined: bool) -> dict:
+def run_mode(conf_path: str, epochs: int, pipelined: bool,
+             dp: bool = False) -> dict:
     env = {} if pipelined else {"HPNN_NO_EPOCH_PIPELINE": "1"}
     saved = {}
     for k, v in env.items():
@@ -122,10 +131,11 @@ def run_mode(conf_path: str, epochs: int, pipelined: bool) -> dict:
                 os.environ[k] = v
     m = dict(api.EPOCH_METRICS)
     assert m["epochs"] == epochs, m
-    expect = "restage" if not pipelined else None
+    expect = ("dp-restage" if dp else "restage") if not pipelined \
+        else ("dp-resident" if dp else None)
     if expect and m["mode"] != expect:
         raise AssertionError(f"mode {m['mode']!r}, expected {expect!r}")
-    return {
+    row = {
         "mode": m["mode"],
         "epochs": epochs,
         "wall_s": round(wall, 3),
@@ -136,6 +146,13 @@ def run_mode(conf_path: str, epochs: int, pipelined: bool) -> dict:
         "host_stall_ms_per_epoch": round(m["stage_s"] / epochs * 1e3, 2),
         "shuffle_ms_per_epoch": round(m["shuffle_s"] / epochs * 1e3, 2),
     }
+    if dp:
+        row["dp_devices"] = int(m["dp_devices"])
+        row["opt_state_bytes_per_device"] = \
+            int(m["opt_state_bytes_per_device"])
+        row["opt_state_replicated_bytes"] = \
+            int(m["opt_state_replicated_bytes"])
+    return row
 
 
 def main() -> int:
@@ -150,10 +167,21 @@ def main() -> int:
     ap.add_argument("--real", action="store_true",
                     help="run the real convergence epoch instead of the "
                     "staging stub (use on chip rounds)")
+    ap.add_argument("--dp", type=int, default=0, metavar="BATCH",
+                    help="measure the [batch] DP route instead (ISSUE "
+                    "12): mesh-sharded resident corpus, permutation-"
+                    "only H2D, 1/N-sharded update state; merges a "
+                    "'dp' section into --out, preserving the single-"
+                    "device rows")
+    ap.add_argument("--train", default=None,
+                    help="trainer (default BP; the DP rows default to "
+                    "BPM so there is momentum state to measure)")
     ap.add_argument("--out", default="EPOCH_BENCH.json")
     args = ap.parse_args()
 
     runtime.init_all(0)
+    if args.dp:
+        return main_dp(args)
     if not args.real:
         from hpnn_tpu import ops
 
@@ -164,12 +192,13 @@ def main() -> int:
     for rows in [int(r) for r in args.rows.split(",") if r]:
         d = os.path.join(args.dir, f"c{rows}")
         gen_corpus(d, rows, args.n_in, args.n_out)
+        train = args.train or "BP"
         conf = os.path.join(args.dir, f"nn_{rows}.conf")
         with open(conf, "w") as fp:
             fp.write(f"[name] bench\n[type] ANN\n[init] generate\n"
                      f"[seed] 1234\n[input] {args.n_in}\n"
                      f"[hidden] {args.hidden}\n[output] {args.n_out}\n"
-                     f"[train] BP\n[sample_dir] {d}\n")
+                     f"[train] {train}\n[sample_dir] {d}\n")
         # prime: one untimed pass builds the pack, warms compile caches
         # and the OS page cache, so both timed modes start warm
         print(f"[{rows}] priming pack + caches ...", flush=True)
@@ -208,11 +237,99 @@ def main() -> int:
                        if not args.real else
                        "real convergence epochs"),
               "floors": floors, "ok": ok, "configs": configs}
-    with open(args.out, "w") as fp:
-        json.dump(result, fp, indent=1)
-        fp.write("\n")
+    _write_merged(args.out, result, keep=("dp",))
     print(json.dumps({"metric": "epoch_pipeline", "ok": ok,
                       **configs[-1]["ratios"]}))
+    return 0 if ok else 1
+
+
+def _write_merged(out_path: str, result: dict, keep=()) -> None:
+    """Write ``result`` to ``out_path``, carrying over the named
+    top-level keys from an existing artifact -- the single-device and
+    DP captures live in ONE file but are regenerated independently."""
+    try:
+        with open(out_path) as fp:
+            old = json.load(fp)
+    except (OSError, ValueError):
+        old = {}
+    for k in keep:
+        if k in old and k not in result:
+            result[k] = old[k]
+    with open(out_path, "w") as fp:
+        json.dump(result, fp, indent=1)
+        fp.write("\n")
+
+
+def main_dp(args) -> int:
+    """`make dp-epoch-bench`: the [batch] DP route, restage vs the
+    mesh-sharded resident pipeline (ISSUE 12).  Real minibatch epochs
+    (one SGD step per batch -- cheap enough unstubbed), BPM by default
+    so the 1/N-sharded momentum is actually there to measure.  Floors,
+    checked on the largest config: permutation-only H2D (<= 1% of the
+    restage bytes) and MEASURED per-device update-state bytes <=
+    replicated/n_data + the flat-padding remainder."""
+    train = args.train or "BPM"
+    floors = {"h2d_fraction_max": 0.01,
+              "opt_state_shard_slack_bytes": 64 * 8,
+              "min_dp_devices": 2}
+    configs = []
+    for rows in [int(r) for r in args.rows.split(",") if r]:
+        d = os.path.join(args.dir, f"c{rows}")
+        gen_corpus(d, rows, args.n_in, args.n_out)
+        conf = os.path.join(args.dir, f"nn_dp_{rows}.conf")
+        with open(conf, "w") as fp:
+            fp.write(f"[name] bench\n[type] ANN\n[init] generate\n"
+                     f"[seed] 1234\n[input] {args.n_in}\n"
+                     f"[hidden] {args.hidden}\n[output] {args.n_out}\n"
+                     f"[train] {train}\n[batch] {args.dp}\n"
+                     f"[sample_dir] {d}\n")
+        print(f"[dp {rows}] priming pack + caches ...", flush=True)
+        run_mode(conf, 1, pipelined=False, dp=True)
+        print(f"[dp {rows}] restage (HPNN_NO_EPOCH_PIPELINE=1) ...",
+              flush=True)
+        off = run_mode(conf, args.epochs, pipelined=False, dp=True)
+        print(f"[dp {rows}] mesh-sharded resident ...", flush=True)
+        on = run_mode(conf, args.epochs, pipelined=True, dp=True)
+        n_data = max(1, on["dp_devices"])
+        ratios = {
+            "h2d_per_epoch_fraction": round(
+                on["h2d_bytes_per_epoch"]
+                / max(off["h2d_bytes_per_epoch"], 1), 6),
+            "host_stall_speedup": round(
+                off["host_stall_ms_per_epoch"]
+                / max(on["host_stall_ms_per_epoch"], 1e-3), 2),
+            "epochs_per_s_speedup": round(
+                on["epochs_per_s"] / max(off["epochs_per_s"], 1e-9), 2),
+            "opt_state_shard_fraction": round(
+                on["opt_state_bytes_per_device"]
+                / max(on["opt_state_replicated_bytes"], 1), 4),
+        }
+        configs.append({"rows": rows, "batch": args.dp, "train": train,
+                        "topology": [args.n_in, args.hidden, args.n_out],
+                        "epochs": args.epochs, "devices": n_data,
+                        "restage": off, "resident": on,
+                        "ratios": ratios})
+        print(f"[dp {rows}] {json.dumps(ratios)}", flush=True)
+    big = configs[-1]
+    on = big["resident"]
+    n_data = max(1, on["dp_devices"])
+    opt_ok = (on["opt_state_replicated_bytes"] == 0
+              or on["opt_state_bytes_per_device"]
+              <= on["opt_state_replicated_bytes"] // n_data
+              + floors["opt_state_shard_slack_bytes"])
+    ok = (big["ratios"]["h2d_per_epoch_fraction"]
+          <= floors["h2d_fraction_max"]
+          and on["dp_devices"] >= floors["min_dp_devices"]
+          and opt_ok)
+    dp_result = {"note": ("real minibatch DP epochs over the virtual "
+                          "8-device CPU mesh; chip rounds re-run with "
+                          "the ambient topology"),
+                 "floors": floors, "ok": ok, "configs": configs}
+    _write_merged(args.out, {"dp": dp_result},
+                  keep=("metric", "train_stub", "note", "floors", "ok",
+                        "configs"))
+    print(json.dumps({"metric": "dp_epoch_pipeline", "ok": ok,
+                      **big["ratios"]}))
     return 0 if ok else 1
 
 

@@ -168,3 +168,30 @@ def test_watchdog_dispatches_tiny_shape_to_plain_kernel(monkeypatch):
     ts = np.zeros((4, 2), np.float32)
     _, st = cp.train_epoch_pallas_watchdog(w, xs, ts, "SNN", False)
     assert calls == ["plain"] and st == "stats"
+
+
+def test_committed_dp_epoch_bench_rows_hold_floors():
+    """The committed EPOCH_BENCH.json DP section (make dp-epoch-bench,
+    ISSUE 12) stays pinned in tier 1: permutation-only per-epoch H2D
+    and MEASURED 1/N-per-device update-state bytes.  Regenerating the
+    artifact with a regression fails here, not just at bench time."""
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "EPOCH_BENCH.json")
+    with open(path) as fp:
+        art = json.load(fp)
+    dp = art.get("dp")
+    assert dp and dp.get("ok") is True, "dp section missing or red"
+    floors = dp["floors"]
+    big = dp["configs"][-1]
+    on = big["resident"]
+    assert big["ratios"]["h2d_per_epoch_fraction"] \
+        <= floors["h2d_fraction_max"]
+    n = max(1, on["dp_devices"])
+    assert n >= floors["min_dp_devices"]
+    assert on["opt_state_bytes_per_device"] \
+        <= on["opt_state_replicated_bytes"] // n \
+        + floors["opt_state_shard_slack_bytes"]
+    assert on["mode"] == "dp-resident"

@@ -493,3 +493,118 @@ def test_transport_errors_tuple_covers_the_edge_classes():
 
     assert not isinstance(RemoteHTTPError(404, "x", "y"),
                           TRANSPORT_ERRORS)
+
+
+# --- server-side injection (ISSUE 12 satellite) -----------------------------
+
+def _raw_get(port, path, timeout=5.0):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def test_server_side_rules_are_side_scoped():
+    """A side=server rule neither fires for nor advances on client-side
+    picks (and vice versa) -- the schedules stay exact per side."""
+    chaos.configure("http@/x:side=server,times=1")
+    (rule,) = chaos._rules
+    for _ in range(3):
+        assert chaos.pick("/x") is None          # client side: invisible
+    assert rule.calls == 0                       # schedule untouched
+    assert chaos.pick("/x", side="server") is rule
+    assert chaos.pick("/x", side="server") is None   # times=1 spent
+    assert chaos.stats()["rules"][0]["side"] == "server"
+    with pytest.raises(ValueError):
+        chaos.parse_spec("http:side=sideways")
+
+
+def test_server_side_http_and_latency(tmp_path):
+    """Fabricated 5xx and injected latency in the WORKER'S OWN response
+    path: the handler never runs for the 5xx, and recovery is instant
+    once the schedule is spent."""
+    conf = _write_kernel_conf(tmp_path)
+    app, httpd, port = _mk_worker(conf)
+    try:
+        chaos.configure("http@/healthz:side=server,times=1,code=507")
+        status, body = _raw_get(port, "/healthz")
+        assert status == 507
+        assert json.loads(body)["reason"] == "chaos"
+        status, body = _raw_get(port, "/healthz")     # recovered
+        assert status == 200
+        assert json.loads(body)["status"] == "ok"
+        chaos.configure("latency@/healthz:side=server,times=1,ms=120")
+        t0 = time.monotonic()
+        status, _ = _raw_get(port, "/healthz")
+        assert status == 200 and time.monotonic() - t0 >= 0.12
+    finally:
+        app.close(drain=False)
+        httpd.shutdown()
+
+
+def test_server_side_truncate_half_written_response(tmp_path):
+    """The half-written-response case the ROADMAP named: headers claim
+    a full body, half of it arrives, the connection dies mid-read --
+    the client sees IncompleteRead, not a clean reply."""
+    conf = _write_kernel_conf(tmp_path)
+    app, httpd, port = _mk_worker(conf)
+    try:
+        chaos.configure("truncate@/healthz:side=server,times=1")
+        with pytest.raises((http.client.IncompleteRead,
+                            ConnectionError)):
+            _raw_get(port, "/healthz")
+        status, _ = _raw_get(port, "/healthz")        # server survived
+        assert status == 200
+    finally:
+        app.close(drain=False)
+        httpd.shutdown()
+
+
+def test_server_side_reset_severs_connection(tmp_path):
+    conf = _write_kernel_conf(tmp_path)
+    app, httpd, port = _mk_worker(conf)
+    try:
+        chaos.configure("reset@/healthz:side=server,times=1")
+        with pytest.raises((http.client.BadStatusLine,
+                            http.client.RemoteDisconnected,
+                            ConnectionError, socket.timeout)):
+            _raw_get(port, "/healthz", timeout=3.0)
+        status, _ = _raw_get(port, "/healthz")
+        assert status == 200
+    finally:
+        app.close(drain=False)
+        httpd.shutdown()
+
+
+def test_server_side_faults_exercise_router_retry(tmp_path):
+    """A worker whose OWN handler truncates an infer response: the
+    router's idempotent retry-once-elsewhere still yields exactly one
+    200 to the client -- the server-side analog of the transport-layer
+    pin (the bytes really were half-written by the victim's handler,
+    not faked in the client's transport)."""
+    conf = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(conf, required=2)
+    w1app, w1httpd, _ = _mk_worker(conf, router_port=rport)
+    w2app, w2httpd, _ = _mk_worker(conf, router_port=rport)
+    try:
+        _wait_quorum(rport)
+        # the router's own handler consults the server-side table too
+        # (it IS a server): after=1 skips the client->router hop so the
+        # fault lands on the router->worker hop -- the worker's handler
+        chaos.configure("truncate@/infer:side=server,after=1,times=1")
+        xs = np.zeros((2, N_IN))
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/infer",
+            {"inputs": xs.tolist(), "timeout_ms": 20000})
+        assert st == 200
+        assert chaos.stats()["injected_total"] == 1
+        assert rapp.mesh_router.pool.failovers_total == 1
+    finally:
+        chaos.reset()
+        for httpd, app in ((w1httpd, w1app), (w2httpd, w2app),
+                           (rhttpd, rapp)):
+            httpd.shutdown()
+            app.close(drain=True)

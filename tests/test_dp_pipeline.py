@@ -1,0 +1,446 @@
+"""Mesh-scale zero-restage DP training (ISSUE 12): parity pins + units.
+
+The contract stack, strongest first:
+
+* Resident == restage, BYTE for byte: a multi-epoch ``[batch]`` run's
+  console stream (-vv, stdout AND stderr) and ``kernel.opt`` are
+  identical with the DP epoch pipeline on vs
+  ``HPNN_NO_EPOCH_PIPELINE=1`` -- on the forced 8-device CPU mesh, for
+  BP and BPM, for the minibatch AND the [tile] convergence engines, and
+  across a kill-at-epoch-k ``--resume`` (the sharded carry restores
+  exactly: the wdtype round-trips through the snapshot's f64
+  losslessly).
+* Sharded optimizer state is a value-preserving RELAYOUT: the flat
+  1/N-sharded momentum/master carry produces BITWISE-identical weights
+  and errors to the replicated per-layer layout on the same mesh, and
+  its per-device bytes are MEASURED at <= replicated/n_data + the flat
+  padding remainder.
+* Sharded vs single-device runs of the same engine agree to the repo's
+  established DP envelope (1e-12): bitwise equality across DEVICE
+  COUNTS is not available on this backend -- the XLA CPU GEMM is
+  batch-row-blocking dependent at the ULP level, the same documented
+  property that scopes the serve fast tier (and the [tile] mesh pin,
+  test_tile_convergence) to a tolerance.  bf16 stays inside a bf16-ULP
+  envelope.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import hpnn_tpu.api as api
+from hpnn_tpu import cli
+from hpnn_tpu.io import samples
+from hpnn_tpu.models.kernel import generate_kernel
+from hpnn_tpu.parallel import make_mesh, per_device_bytes
+from hpnn_tpu.parallel.dp import (
+    dp_export_weights,
+    dp_resident_carry,
+    dp_train_epoch_batched,
+    dp_train_epoch_resident,
+)
+from hpnn_tpu.parallel.mesh import (
+    batch_sharding,
+    flat_state_sharding,
+    flatten_state,
+    unflatten_state,
+)
+from hpnn_tpu.utils import nn_log
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+# --- unit tier: the resident engine against the restage engine -------------
+
+def _problem(seed, s=37, dtype=jnp.float64):
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    ws = tuple(jnp.asarray(w, dtype) for w in kern.weights)
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(-1, 1, (s, N_IN))
+    ts = -np.ones((s, N_OUT))
+    ts[np.arange(s), rng.integers(0, N_OUT, s)] = 1.0
+    return ws, xs, ts
+
+
+def _geometry(s, bsz, n_data):
+    n_batches = -(-s // bsz)
+    bsz_pad = -(-bsz // n_data) * n_data
+    pos = (np.arange(s) // bsz) * bsz_pad + np.arange(s) % bsz
+    sel = np.zeros(n_batches * bsz_pad, np.int32)
+    sel[pos] = np.arange(s, dtype=np.int32)
+    mask = np.zeros((n_batches, bsz_pad))
+    mask.reshape(-1)[pos] = 1.0
+    return n_batches, bsz_pad, sel, mask
+
+
+def _staged(xs, ts, s, bsz, n_batches, bsz_pad, dtype):
+    xb = np.zeros((n_batches, bsz_pad, xs.shape[1]))
+    tb = np.zeros((n_batches, bsz_pad, ts.shape[1]))
+    for i in range(n_batches):
+        rows = slice(i * bsz, min((i + 1) * bsz, s))
+        k = rows.stop - rows.start
+        xb[i, :k] = xs[rows]
+        tb[i, :k] = ts[rows]
+    return jnp.asarray(xb, dtype), jnp.asarray(tb, dtype)
+
+
+def _resident(xs, ts, mesh, dtype):
+    n_data = mesh.shape["data"] if mesh is not None else 1
+    pad = (-xs.shape[0]) % n_data
+    if pad:
+        xs = np.concatenate([xs, np.zeros((pad, xs.shape[1]))])
+        ts = np.concatenate([ts, np.zeros((pad, ts.shape[1]))])
+    x = jnp.asarray(xs, dtype)
+    t = jnp.asarray(ts, dtype)
+    if mesh is not None:
+        bs = batch_sharding(mesh)
+        x, t = jax.device_put(x, bs), jax.device_put(t, bs)
+    return x, t
+
+
+@pytest.mark.parametrize("kind,momentum", [("ANN", False), ("ANN", True),
+                                           ("SNN", True)])
+def test_resident_matches_restage_engine_bitwise(kind, momentum):
+    """Zero-restage gather + 1/N-sharded update state == the staged
+    restage engine with replicated state, BITWISE, on the same mesh --
+    the relayout changes nothing."""
+    ws, xs, ts = _problem(3)
+    s, bsz = xs.shape[0], 5
+    mesh = make_mesh(n_data=jax.device_count(), n_model=1)
+    nb, bp, sel, mask = _geometry(s, bsz, mesh.shape["data"])
+    xb, tb = _staged(xs, ts, s, bsz, nb, bp, jnp.float64)
+    mb = jnp.asarray(mask)
+    w_ref, errs_ref = dp_train_epoch_batched(ws, xb, tb, mb, kind,
+                                             momentum, 0.01, alpha=0.2,
+                                             mesh=mesh)
+    x_res, t_res = _resident(xs, ts, mesh, jnp.float64)
+    carry = dp_resident_carry(ws, mesh, False)
+    new_w, dw, errs = dp_train_epoch_resident(
+        carry, x_res, t_res, jnp.asarray(sel), mb, kind, momentum, 0.01,
+        alpha=0.2, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(errs), np.asarray(errs_ref))
+    for a, b in zip(new_w, w_ref):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    if momentum:
+        assert dw is not None
+        assert dw.sharding == flat_state_sharding(mesh)
+
+
+def test_sharded_vs_single_device_envelope():
+    """8-way sharded vs unsharded resident epoch: the repo's
+    established DP envelope (1e-12), not bitwise -- the XLA CPU GEMM's
+    row blocking depends on the local batch shape (see module doc)."""
+    ws, xs, ts = _problem(4)
+    s, bsz = xs.shape[0], 5
+    mesh = make_mesh(n_data=jax.device_count(), n_model=1)
+    nb, bp, sel, mask = _geometry(s, bsz, mesh.shape["data"])
+    mb = jnp.asarray(mask)
+    x8, t8 = _resident(xs, ts, mesh, jnp.float64)
+    w8, _, e8 = dp_train_epoch_resident(
+        dp_resident_carry(ws, mesh, False), x8, t8, jnp.asarray(sel),
+        mb, "ANN", True, 0.01, alpha=0.2, mesh=mesh)
+    x1, t1 = _resident(xs, ts, None, jnp.float64)
+    w1, _, e1 = dp_train_epoch_resident(
+        dp_resident_carry(ws, None, False), x1, t1, jnp.asarray(sel),
+        mb, "ANN", True, 0.01, alpha=0.2, mesh=None)
+    np.testing.assert_allclose(np.asarray(e8), np.asarray(e1),
+                               atol=1e-12)
+    for a, b in zip(w8, w1):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-12)
+
+
+def test_opt_state_bytes_measured_one_over_n():
+    """The returned momentum really lives 1/N per device: measured
+    bytes <= replicated/n_data + the flat padding remainder."""
+    ws, xs, ts = _problem(5)
+    s, bsz = xs.shape[0], 5
+    mesh = make_mesh(n_data=jax.device_count(), n_model=1)
+    n_data = mesh.shape["data"]
+    nb, bp, sel, mask = _geometry(s, bsz, n_data)
+    x_res, t_res = _resident(xs, ts, mesh, jnp.float64)
+    _, dw, _ = dp_train_epoch_resident(
+        dp_resident_carry(ws, mesh, False), x_res, t_res,
+        jnp.asarray(sel), jnp.asarray(mask), "ANN", True, 0.01,
+        alpha=0.2, mesh=mesh)
+    params = sum(int(np.prod(w.shape)) for w in ws)
+    replicated = params * 8
+    got = per_device_bytes([dw])
+    assert 0 < got <= replicated // n_data + n_data * 8
+    # and the helper is honest about both layouts: sharded corpus rows
+    # count one shard per device, an unsharded array counts fully
+    assert per_device_bytes([x_res]) < x_res.nbytes
+    assert per_device_bytes([jnp.zeros(16)]) == 16 * 8
+
+
+def test_flat_state_roundtrip_bitwise():
+    ws, _, _ = _problem(6)
+    shapes = tuple(tuple(int(d) for d in w.shape) for w in ws)
+    flat = flatten_state(ws, 8)
+    assert flat.shape[0] % 8 == 0
+    back = unflatten_state(flat, shapes)
+    for a, b in zip(ws, back):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_shard_master_bf16_envelope_and_export():
+    """[dtype] bf16: the flat 1/N-sharded f32 master carry tracks the
+    replicated single-device run inside a bf16-activation envelope, and
+    exports back to per-layer f64 exactly."""
+    ws, xs, ts = _problem(7)
+    ws32 = tuple(w.astype(jnp.float32) for w in ws)
+    s, bsz = xs.shape[0], 5
+    mesh = make_mesh(n_data=jax.device_count(), n_model=1)
+    nb, bp, sel, mask = _geometry(s, bsz, mesh.shape["data"])
+    mb16 = jnp.asarray(mask, jnp.bfloat16)
+    x8, t8 = _resident(xs, ts, mesh, jnp.bfloat16)
+    shapes = tuple(tuple(int(d) for d in w.shape) for w in ws32)
+    carry = dp_resident_carry(ws32, mesh, True)
+    assert carry.ndim == 1 and carry.sharding == flat_state_sharding(mesh)
+    new_c, dw, _ = dp_train_epoch_resident(
+        carry, x8, t8, jnp.asarray(sel), mb16, "ANN", True, 0.01,
+        alpha=0.2, mesh=mesh, shard_master=True, shapes=shapes)
+    w8 = dp_export_weights(new_c, shapes)
+    x1, t1 = _resident(xs, ts, None, jnp.bfloat16)
+    w1, _, _ = dp_train_epoch_resident(
+        dp_resident_carry(ws32, None, False), x1, t1, jnp.asarray(sel),
+        jnp.asarray(mask, jnp.bfloat16), "ANN", True, 0.01, alpha=0.2)
+    for a, b in zip(w8, w1):
+        # bf16 activations bound the gradient resolution; the masters
+        # differ only through GEMM row blocking, far inside it
+        np.testing.assert_allclose(np.asarray(a),
+                                   np.asarray(b, dtype=np.float64),
+                                   atol=2 ** -8)
+    # masters + momentum both measured 1/N-sharded
+    params = sum(int(np.prod(sh)) for sh in shapes)
+    n_data = mesh.shape["data"]
+    assert per_device_bytes([new_c, dw]) \
+        <= 2 * (params * 4 // n_data) + n_data * 8
+
+
+def test_export_matches_carry_layouts():
+    ws, _, _ = _problem(8)
+    shapes = tuple(tuple(int(d) for d in w.shape) for w in ws)
+    mesh = make_mesh(n_data=jax.device_count(), n_model=1)
+    flat = dp_resident_carry(tuple(w.astype(jnp.float32) for w in ws),
+                             mesh, True)
+    out = dp_export_weights(flat, shapes)
+    ref = dp_export_weights(tuple(w.astype(jnp.float32) for w in ws))
+    for a, b in zip(out, ref):
+        np.testing.assert_array_equal(a, b)
+        assert a.dtype == np.float64
+
+
+def test_dp_stage_scratch_keys_on_full_geometry():
+    """Pooled staging scratch must key on bsz too: 9 rows as 3 batches
+    of 3 and 3 batches of 4 share (n_batches, bsz_pad, s) but have
+    different slot maps -- reusing the first pool entry for the second
+    silently corrupted the trajectory (caught in-suite)."""
+    s = 9
+    xs = np.arange(s * 2, dtype=np.float64).reshape(s, 2)
+    ts = np.arange(s * 1, dtype=np.float64).reshape(s, 1)
+
+    def oracle(bsz, nb, bp):
+        xb = np.zeros((nb, bp, 2))
+        tb = np.zeros((nb, bp, 1))
+        mb = np.zeros((nb, bp))
+        for i in range(nb):
+            rows = slice(i * bsz, min((i + 1) * bsz, s))
+            k = rows.stop - rows.start
+            xb[i, :k] = xs[rows]
+            tb[i, :k] = ts[rows]
+            mb[i, :k] = 1.0
+        return xb, tb, mb
+
+    for bsz in (3, 4, 3):               # revisit 3 after 4: pool reuse
+        nb, bp = -(-s // bsz), 8
+        got = api._dp_stage_batches(xs, ts, s, bsz, nb, bp, np.float64)
+        want = oracle(bsz, nb, bp)
+        for g, w in zip(got, want):
+            np.testing.assert_array_equal(g, w)
+
+
+# --- CLI tier: byte parity through the real driver -------------------------
+
+def _write(path, text):
+    with open(path, "w") as fp:
+        fp.write(text)
+
+
+def _write_corpus(dirpath, rng, n, with_skips=True):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        _write(os.path.join(dirpath, f"s{i:03d}"),
+               f"[input] {N_IN}\n"
+               + " ".join(f"{v:7.5f}" for v in x) + "\n"
+               + f"[output] {N_OUT}\n"
+               + " ".join(f"{v:.1f}" for v in t) + "\n")
+    if with_skips:
+        _write(os.path.join(dirpath, "bad_zero"),
+               "[input] 0\n\n[output] 3\n1 0 0\n")
+        _write(os.path.join(dirpath, "short_dim"),
+               "[input] 2\n1 2\n[output] 3\n1 0 0\n")
+
+
+@pytest.fixture()
+def corpus_dir(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    _write_corpus(str(tmp_path / "samples"), rng, N_SAMP)
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(samples, "_native_warned", True)
+    yield tmp_path
+    nn_log.set_verbosity(0)
+
+
+def _conf(tmp_path, train="BP", extra="[batch] 4\n", name="nn"):
+    path = tmp_path / f"{name}_{train}.conf"
+    path.write_text(
+        f"[name] tiny\n[type] ANN\n[init] generate\n[seed] 1234\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        f"[train] {train}\n{extra}"
+        f"[sample_dir] {tmp_path}/samples\n")
+    return str(path)
+
+
+def _train(args, capsys, env=None):
+    nn_log.set_verbosity(0)
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        rc = cli.train_nn_main(["-vv", *args])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cap = capsys.readouterr()
+    opt = b""
+    if os.path.exists("kernel.opt"):
+        with open("kernel.opt", "rb") as fp:
+            opt = fp.read()
+    return rc, cap.out, cap.err, opt
+
+
+@pytest.mark.parametrize("train", ["BP", "BPM"])
+def test_dp_multi_epoch_byte_parity_on_off(corpus_dir, capsys, train):
+    """The acceptance pin: [batch] resident epochs on the 8-device mesh
+    == the restaging route, byte for byte (stream AND kernel.opt)."""
+    conf = _conf(corpus_dir, train=train)
+    args = ["--epochs=3", conf]
+    base = _train(args, capsys, env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert base[0] == 0
+    cold = _train(args, capsys)   # builds the pack + resident corpus
+    warm = _train(args, capsys)   # warm pack -> sharded resident
+    for tag, got in (("cold", cold), ("warm", warm)):
+        assert got[0] == 0, tag
+        assert got[1] == base[1], f"stdout diverges ({tag})"
+        assert got[2] == base[2], f"stderr diverges ({tag})"
+        assert got[3] == base[3], f"kernel.opt diverges ({tag})"
+    # the streams actually carried the DP grammar + skip diagnostics
+    assert base[1].count("TRAINING BATCH") == 3 * 3  # ceil(9/4) * epochs
+    assert "input read failed" in base[2]
+    assert "dimension mismatch" in base[2]
+
+
+def test_dp_tiled_byte_parity_on_off(corpus_dir, capsys):
+    """[batch] + [tile]: the convergence engine rides the same resident
+    pipeline, per-sample grammar and all."""
+    conf = _conf(corpus_dir, train="BPM", extra="[batch] 4\n[tile] 2\n")
+    args = ["--epochs=2", conf]
+    base = _train(args, capsys, env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert base[0] == 0
+    on = _train(args, capsys)
+    assert on[0] == 0
+    assert on[1] == base[1] and on[2] == base[2] and on[3] == base[3]
+    assert "batched-tile convergence engine" in base[1]
+    assert base[1].count("TRAINING FILE:") == 2 * (N_SAMP + 2)
+
+
+def test_dp_pipeline_engages_permutation_only_h2d(corpus_dir, capsys):
+    conf = _conf(corpus_dir)
+    api.reset_epoch_metrics()
+    rc, *_ = _train(["--epochs=3", conf], capsys,
+                    env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert rc == 0
+    off = dict(api.EPOCH_METRICS)
+    assert off["mode"] == "dp-restage" and off["epochs"] == 3
+
+    api.reset_epoch_metrics()
+    rc, *_ = _train(["--epochs=3", conf], capsys)
+    assert rc == 0
+    on = dict(api.EPOCH_METRICS)
+    assert on["mode"] == "dp-resident" and on["epochs"] == 3
+    # per-epoch H2D = the int32 slot map only: ceil(9/4)=3 batches of
+    # ceil(4/8)*8=8 padded slots, 4 bytes each
+    assert on["h2d_bytes"] == 3 * 4 * 3 * 8
+    assert on["h2d_bytes"] < off["h2d_bytes"]
+    assert on["setup_h2d_bytes"] > 0
+    assert on["dp_devices"] == jax.device_count()
+
+
+def test_dp_bpm_opt_state_measured_sharded(corpus_dir, capsys):
+    conf = _conf(corpus_dir, train="BPM")
+    api.reset_epoch_metrics()
+    rc, *_ = _train(["--epochs=2", conf], capsys)
+    assert rc == 0
+    m = dict(api.EPOCH_METRICS)
+    n = jax.device_count()
+    assert m["opt_state_replicated_bytes"] > 0
+    assert 0 < m["opt_state_bytes_per_device"] \
+        <= m["opt_state_replicated_bytes"] // n + n * 8
+
+
+def test_dp_kill_resume_restores_sharded_carry(corpus_dir, capsys):
+    """DP pipeline killed-and-resumed == DP restage uninterrupted, byte
+    for byte: the snapshot's f64 weights rebuild the sharded carry
+    exactly on resume."""
+    conf = _conf(corpus_dir, train="BPM")
+    os.makedirs("off")
+    os.chdir("off")
+    rc, o_off, _, k_off = _train(
+        ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf], capsys,
+        env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert rc == 0
+    os.chdir("..")
+    os.makedirs("part")
+    os.chdir("part")
+    rc, o_kill, _, _ = _train(
+        ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf], capsys,
+        env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0
+    assert "CKPT: interrupted at epoch 1/3" in o_kill
+    rc, o_res, _, k_res = _train(
+        ["--epochs=3", "--resume", "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    os.chdir("..")
+    assert k_res == k_off
+    mark = "NN: EPOCH        2/       3\n"
+    assert o_res[o_res.index(mark):] == o_off[o_off.index(mark):]
+
+
+def test_dp_devices_env_caps_mesh(corpus_dir, capsys):
+    """HPNN_DP_DEVICES=1 pins the DP route to one device -- resident
+    mode still engages, unsharded, with the single-device banner (the
+    knob tests and operators use to compare against a mesh slice)."""
+    conf = _conf(corpus_dir)
+    api.reset_epoch_metrics()
+    rc, out, *_ = _train(["--epochs=2", conf], capsys,
+                         env={"HPNN_DP_DEVICES": "1"})
+    assert rc == 0
+    m = dict(api.EPOCH_METRICS)
+    assert m["mode"] == "dp-resident"
+    assert m["dp_devices"] == 1
+    assert "one device visible" in out

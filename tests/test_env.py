@@ -1,0 +1,118 @@
+"""The ONE tolerant env-knob parsing contract (ISSUE 12 satellite).
+
+Every ``HPNN_*`` integer/float tuning knob goes through
+``utils.env.env_int``/``env_float``: a malformed value falls back to the
+default (a typo'd knob degrades a tunable, never kills a run), and the
+``lo``/``hi`` clamps replace the ad-hoc ``max(1, ...)`` wrappers the
+call sites used to carry.  Tested ONCE here; consumer modules are held
+to using the helpers by a source scan.
+"""
+
+import os
+import re
+
+import pytest
+
+from hpnn_tpu.utils.env import env_float, env_int
+
+
+@pytest.fixture()
+def knob(monkeypatch):
+    def set_(value):
+        monkeypatch.setenv("HPNN_TEST_KNOB", value)
+    monkeypatch.delenv("HPNN_TEST_KNOB", raising=False)
+    return set_
+
+
+def test_env_int_parses_and_defaults(knob):
+    assert env_int("HPNN_TEST_KNOB", 7) == 7          # unset
+    knob("")
+    assert env_int("HPNN_TEST_KNOB", 7) == 7          # empty
+    knob("42")
+    assert env_int("HPNN_TEST_KNOB", 7) == 42
+    knob("-3")
+    assert env_int("HPNN_TEST_KNOB", 7) == -3
+
+
+def test_env_int_malformed_falls_back(knob):
+    for bad in ("nope", "4.5", "1e3", "0x10", " "):
+        knob(bad)
+        assert env_int("HPNN_TEST_KNOB", 7) == 7, bad
+
+
+def test_env_int_clamps(knob):
+    knob("0")
+    assert env_int("HPNN_TEST_KNOB", 8, lo=16) == 16
+    knob("9999")
+    assert env_int("HPNN_TEST_KNOB", 8, hi=64) == 64
+    knob("32")
+    assert env_int("HPNN_TEST_KNOB", 8, lo=16, hi=64) == 32
+
+
+def test_env_float_parses_defaults_clamps(knob):
+    assert env_float("HPNN_TEST_KNOB", 1.5) == 1.5
+    knob("2.25")
+    assert env_float("HPNN_TEST_KNOB", 1.5) == 2.25
+    knob("bogus")
+    assert env_float("HPNN_TEST_KNOB", 1.5) == 1.5
+    knob("-1")
+    assert env_float("HPNN_TEST_KNOB", 1.5, lo=0.0) == 0.0
+
+
+def test_consumers_use_the_shared_helpers():
+    """Source scan: the knobs this PR consolidated must not regress to
+    ad-hoc ``int(os.environ...)`` parsing (each copy had its own -- or
+    no -- malformed-value behavior)."""
+    consolidated = {
+        "hpnn_tpu/api.py": ("HPNN_EPOCH_DEVICE_BUDGET_MB",
+                            "HPNN_EPOCH_SHARD_ROWS", "HPNN_DP_DEVICES"),
+        "hpnn_tpu/ckpt/trainer.py": ("HPNN_CKPT_KILL_AT_EPOCH",),
+        "hpnn_tpu/io/corpus.py": ("HPNN_CORPUS_CACHE_MAX_MB",
+                                  "HPNN_IO_THREADS"),
+        "hpnn_tpu/obs/trace.py": ("HPNN_TRACE_BUFFER",),
+        "hpnn_tpu/serve/metrics.py": ("HPNN_SLOW_SPAN_MULT",),
+        "hpnn_tpu/serve/mesh/qos.py": ("HPNN_MESH_TARGET_DRAIN_S",
+                                       "HPNN_MESH_MAX_WORKERS"),
+        "hpnn_tpu/serve/mesh/worker.py": ("HPNN_MESH_HEARTBEAT_S",
+                                          "HPNN_MESH_HEARTBEAT_CAP_S"),
+    }
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    bad = []
+    for rel, knobs in consolidated.items():
+        src = open(os.path.join(repo, rel)).read()
+        for k in knobs:
+            assert k in src, f"{rel} no longer reads {k}"
+            # the knob name must not appear inside an int()/float() of
+            # a raw environ read
+            if re.search(r"(?:int|float)\s*\(\s*os\.environ[^)]*"
+                         + re.escape(k), src):
+                bad.append(f"{rel}: {k}")
+    assert not bad, f"ad-hoc env parsing regressed: {bad}"
+
+
+def test_malformed_knobs_degrade_live_consumers(monkeypatch):
+    """End-to-end spot checks: a garbage value behaves like the
+    default at the real call sites."""
+    from hpnn_tpu.io import corpus
+
+    monkeypatch.setenv("HPNN_CORPUS_CACHE_MAX_MB", "not-a-number")
+    assert corpus._cache_max_bytes() == 0
+    monkeypatch.setenv("HPNN_CORPUS_CACHE_MAX_MB", "3")
+    assert corpus._cache_max_bytes() == 3 << 20
+    monkeypatch.setenv("HPNN_IO_THREADS", "banana")
+    assert corpus.io_threads() == 1                    # safe width
+    monkeypatch.setenv("HPNN_IO_THREADS", "2")
+    assert corpus.io_threads() == 2
+    # a SET knob of 0/negative means SERIAL (the pre-consolidation
+    # max(1, int(env)) contract), never silent auto-parallel
+    monkeypatch.setenv("HPNN_IO_THREADS", "0")
+    assert corpus.io_threads() == 1
+    monkeypatch.setenv("HPNN_IO_THREADS", "-4")
+    assert corpus.io_threads() == 1
+
+    import hpnn_tpu.api as api
+
+    monkeypatch.setenv("HPNN_DP_DEVICES", "many")
+    assert api._dp_device_count() >= 1                 # default: all
+    monkeypatch.setenv("HPNN_DP_DEVICES", "1")
+    assert api._dp_device_count() == 1

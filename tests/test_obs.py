@@ -515,7 +515,10 @@ def test_slow_span_threshold_gating(monkeypatch):
     monkeypatch.setenv("HPNN_SLOW_SPAN_MULT", "0")
     assert m.slow_threshold_s(h) is None  # knob off
     monkeypatch.setenv("HPNN_SLOW_SPAN_MULT", "nonsense")
-    assert m.slow_threshold_s(h) is None  # malformed knob degrades
+    # malformed knob falls back to the DEFAULT mult (the shared
+    # utils.env contract, ISSUE 12): a typo must not silently disable
+    # the slow-span flag
+    assert m.slow_threshold_s(h) == pytest.approx(thr)
 
 
 def test_slow_request_flag_fires_through_batcher(tmp_path, monkeypatch,
