@@ -27,11 +27,12 @@ CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py \
              tests/test_dp_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
 OBS_TESTS = tests/test_obs.py tests/test_fleet_obs.py
+AUTOSCALE_TESTS = tests/test_autoscale.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
 	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(CKPT_TESTS) \
-	    $(JOBS_TESTS) $(OBS_TESTS) -q
+	    $(JOBS_TESTS) $(OBS_TESTS) $(AUTOSCALE_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -82,6 +83,15 @@ ckpt-check:
 # after a SIGKILL)
 obs-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(OBS_TESTS) -q
+
+# elastic-lifecycle tier (ISSUE 13): the RETIRING pool state (never
+# picked, never health-promoted, heartbeat cannot resurrect), the
+# worker agent's goodbye, the supervisor's control loop (spawn toward
+# desired, min/max clamps, cooldown, retire-youngest, dead-subprocess
+# reap, exec hook), and the slow acceptance e2e: backlog spawns a real
+# second worker, quiet retires it drain-then-SIGTERM, zero non-200
+autoscale-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(AUTOSCALE_TESTS) -q
 
 # online-training tier: job store/queue/auth/A-B units + the full e2e
 # acceptance (submit over HTTP -> per-epoch hot swaps under concurrent
@@ -164,19 +174,22 @@ mfu-bench:
 # multi-host serve mesh: router overhead vs the single-process fast
 # tier, 2-worker scaling (+ keep-alive reuse ratio), retry-under-chaos
 # (paced injected resets, zero non-200 floor), kill -9 worker failover
-# (zero non-200 floor + ejection latency), and router-pair takeover
+# (zero non-200 floor + ejection latency), router-pair takeover
 # (kill -9 the PRIMARY; zero non-200 after the documented single
-# retry + takeover-latency floor); emits MESH_BENCH.json, rc!=0 when
-# a floor misses.
+# retry + takeover-latency floor), SLO-driven shed engage/recover
+# under a server-side chaos 5xx burst (high lane untouched), and the
+# autoscale spawn/retire episode (zero non-200 through the drain);
+# emits MESH_BENCH.json, rc!=0 when a floor misses.
 # Default forces CPU everywhere; `make mesh-bench REAL=1` keeps the
 # ambient platform so the workers run on chips
 mesh-bench:
 	python scripts/mesh_bench.py --out MESH_BENCH.json \
 	    $(if $(REAL),--real)
 
-# fleet observability overhead (ISSUE 10): the same 2-worker mesh load
-# with tracing + metrics federation OFF vs ON (collector draining +
-# federated scrapes under fire), overhead ceiling asserted, merged
+# fleet observability overhead (ISSUE 10 + 13): the same 2-worker mesh
+# load with tracing + metrics federation OFF vs ON vs SAMPLED
+# (--trace-sample 0.01, the fleet-QPS configuration; forced capture
+# still yields the merged tree), overhead ceilings asserted, merged
 # cross-host tree verified live; emits OBS_BENCH.json, rc!=0 when a
 # floor misses.  `make obs-bench REAL=1` keeps the ambient platform
 obs-bench:
@@ -185,4 +198,5 @@ obs-bench:
 
 .PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
     ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
-    serve-bench io-bench epoch-bench dp-epoch-bench mfu-bench mesh-bench
+    serve-bench io-bench epoch-bench dp-epoch-bench mfu-bench \
+    mesh-bench autoscale-check

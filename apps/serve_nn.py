@@ -9,9 +9,12 @@ Usage: serve_nn [-v]... [-a addr] [-p port] [-b max-batch] [-q queue-rows]
                 [--watch-ckpt [NAME=]DIR] [--watch-interval S]
                 [--jobs N] [--job-dir DIR] [--ab-fraction F]
                 [--auth-token TOKEN]
-                [--mesh-role router|worker] [--router HOST:PORT]
+                [--mesh-role router|worker|standby] [--router HOST:PORT]
                 [--advertise HOST:PORT] [--workers N]
                 [--quota-rows F] [--quota-burst N]
+                [--trace] [--trace-sample P] [--span-dir DIR]
+                [--slo-p99-ms F] [--slo-availability F] [--shed-low]
+                [--autoscale MIN:MAX] [--auto-promote]
                 [conf (default ./nn.conf)]...
 
 Takes the same nn.conf files as run_nn; see hpnn_tpu/serve/ and the

@@ -552,6 +552,27 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     "(GET /v1/debug/trace; every infer request gets a "
                     "trace id, X-HPNN-Trace-Id honored/echoed).  "
                     "Default: $HPNN_TRACE; off costs nothing")
+    ap.add_argument("--trace-sample", type=float, default=None,
+                    metavar="P",
+                    help="head-based trace sampling: keep each new "
+                    "trace with probability P (decided once at trace "
+                    "birth; an explicit X-HPNN-Trace-Id or a high-QoS "
+                    "request always captures; dropped requests take "
+                    "the zero-allocation no-trace path).  Default: "
+                    "$HPNN_TRACE_SAMPLE, else keep everything")
+    ap.add_argument("--span-dir", default=None, metavar="DIR",
+                    help="durable span export: stream recorded spans "
+                    "into rotating NDJSON segments under DIR "
+                    "(fsync-on-rotate, size/age retention via "
+                    "HPNN_SPAN_* knobs), so traces survive SIGKILL; "
+                    "GET /v1/debug/trace?spool=1 reads them back.  "
+                    "Default: $HPNN_SPAN_DIR, else ring-only")
+    ap.add_argument("--shed-low", action="store_true", default=False,
+                    help="SLO-driven load shedding: while an "
+                    "--slo-* error budget is burning, reject LOW-lane "
+                    "(X-HPNN-Priority: low) traffic at admission with "
+                    "429 + Retry-After; clears after HPNN_SHED_CLEAR_S "
+                    "of quiet (hysteresis).  Default: $HPNN_SHED=1")
     ap.add_argument("--profile-dir", default=None, metavar="DIR",
                     help="destination for POST /v1/debug/profile "
                     "jax.profiler captures (default: a fresh temp dir "
@@ -609,6 +630,26 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     help="router worker health-check poll period "
                     "(default 1.0s; ejection after "
                     "HPNN_MESH_EJECT_AFTER consecutive misses)")
+    ap.add_argument("--autoscale", default=None, metavar="MIN:MAX",
+                    help="(router) elastic worker lifecycle: a "
+                    "supervisor drives the hpnn_serve_desired_workers "
+                    "gauge by spawning/retiring local serve_nn worker "
+                    "subprocesses within [MIN, MAX] (drain-then-"
+                    "SIGTERM on retire; HPNN_AUTOSCALE_EXEC replaces "
+                    "the subprocess actions for real fleets)")
+    ap.add_argument("--autoscale-cooldown", type=float, default=None,
+                    metavar="S",
+                    help="minimum seconds between autoscale actions "
+                    "(default $HPNN_AUTOSCALE_COOLDOWN_S or 30)")
+    ap.add_argument("--auto-promote", action="store_true",
+                    default=False,
+                    help="(with --jobs) eval-driven promotion: when a "
+                    "training job finishes, evaluate its candidate "
+                    "generation vs the pre-job baseline on a held-out "
+                    "test dir (the submit's 'test_samples' or the "
+                    "conf's [test_dir]) and promote-if-better / roll "
+                    "back on regression, recording the A/B generation "
+                    "counters as canary evidence")
     ap.add_argument("--quota-rows", type=float, default=0.0, metavar="F",
                     help="per-client token-bucket quota in rows/sec "
                     "(keyed by X-HPNN-Client, the auth token, or the "
@@ -674,6 +715,28 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                          f"{args.slo_p99_ms} (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    if args.trace_sample is not None \
+            and not 0.0 <= args.trace_sample <= 1.0:
+        sys.stderr.write(f"--trace-sample must be in [0, 1]: "
+                         f"{args.trace_sample} (ABORTING)\n")
+        runtime.deinit_all()
+        return -1
+    autoscale_bounds = None
+    if args.autoscale is not None:
+        if args.mesh_role != "router":
+            sys.stderr.write("--autoscale requires --mesh-role router "
+                             "(ABORTING)\n")
+            runtime.deinit_all()
+            return -1
+        lo, sep, hi = args.autoscale.partition(":")
+        if not (sep and lo.isdigit() and hi.isdigit()
+                and int(lo) <= int(hi) and int(hi) >= 1):
+            sys.stderr.write(f"--autoscale must be MIN:MAX with "
+                             f"0 <= MIN <= MAX, MAX >= 1: "
+                             f"{args.autoscale!r} (ABORTING)\n")
+            runtime.deinit_all()
+            return -1
+        autoscale_bounds = (int(lo), int(hi))
     auth_token = args.auth_token or os.environ.get("HPNN_SERVE_TOKEN") \
         or None
     router_token = args.router_token \
@@ -701,7 +764,10 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                    quota_burst=args.quota_burst,
                    slo_p99_ms=args.slo_p99_ms,
                    slo_availability=args.slo_availability,
-                   require_router=require_router)
+                   require_router=require_router,
+                   trace_sample=args.trace_sample,
+                   span_dir=args.span_dir,
+                   shed_low=args.shed_low or None)
     if args.mesh_role == "router":
         # before add_model: batchers are wired to the worker pool at
         # creation.  (A router never computes locally -- add_model
@@ -764,14 +830,40 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
             return -1
         app.watch_manifest(wname, wdir, interval_s=args.watch_interval)
     if args.jobs > 0:
-        app.enable_jobs(args.job_dir, capacity=args.jobs)
+        app.enable_jobs(args.job_dir, capacity=args.jobs,
+                        auto_promote=args.auto_promote)
         tok = "on" if auth_token else "OFF (pass --auth-token)"
+        promo = ", auto-promote" if args.auto_promote else ""
         sys.stdout.write(f"SERVE: online training enabled "
                          f"(queue={args.jobs}, job-dir={args.job_dir}, "
                          f"ab-fraction={args.ab_fraction:g}, "
-                         f"auth={tok})\n")
+                         f"auth={tok}{promo})\n")
+    elif args.auto_promote:
+        sys.stderr.write("serve: --auto-promote is inert without "
+                         "--jobs N (ignored)\n")
     httpd = make_server(args.addr, args.port, app)
     host, port = httpd.server_address[:2]
+    if autoscale_bounds is not None:
+        # after the bind: spawned workers register against THIS
+        # router's real port
+        worker_args = ["--parity", args.parity,
+                       "--fast-threshold", str(args.fast_threshold),
+                       "-b", str(args.max_batch),
+                       "-q", str(args.queue_rows)]
+        if args.trace:
+            worker_args.append("--trace")
+        if args.trace_sample is not None:
+            worker_args += ["--trace-sample", str(args.trace_sample)]
+        app.enable_autoscale(
+            f"127.0.0.1:{port}", [c for c in args.confs],
+            min_workers=autoscale_bounds[0],
+            max_workers=autoscale_bounds[1],
+            cooldown_s=args.autoscale_cooldown,
+            worker_args=tuple(worker_args))
+        sys.stdout.write(
+            f"SERVE: autoscale supervisor on "
+            f"[{autoscale_bounds[0]}, {autoscale_bounds[1]}] workers "
+            f"(cooldown {app.autoscaler.cooldown_s:g}s)\n")
     if args.mesh_role == "worker":
         # register AFTER the socket is bound (the advertised default
         # needs the real port) but before serve_forever: the heartbeat
@@ -851,9 +943,19 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         if not dumped:
             from .obs import trace as obs_trace
 
-            path = obs_trace.dump_to_dir(
-                dump_dir, reason="shutdown",
-                extra_spans=_collected_worker_spans())
+            if app.span_exporter is not None:
+                # the durable spool IS the post-mortem: app.close()
+                # already flushed + rotated every span (drain-phase
+                # ones included) into finalized segments -- a second
+                # ad-hoc dump file would just duplicate them
+                from .obs.export import list_segments
+
+                segs = list_segments(app.span_exporter.span_dir)
+                path = segs[-1] if segs else None
+            else:
+                path = obs_trace.dump_to_dir(
+                    dump_dir, reason="shutdown",
+                    extra_spans=_collected_worker_spans())
             if path:
                 sys.stdout.write(f"SERVE: flight recorder dumped to "
                                  f"{path}\n")

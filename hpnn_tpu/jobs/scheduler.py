@@ -72,8 +72,14 @@ def _as_int(params: dict, key: str, default: int, floor: int = 0) -> int:
 
 class JobScheduler:
     def __init__(self, app, job_dir: str, capacity: int = 8,
-                 preempt_wait_s: float = 2.0):
+                 preempt_wait_s: float = 2.0,
+                 auto_promote: bool = False):
         self.app = app
+        # eval-driven auto-promotion (ISSUE 13 / ROADMAP 2c): after a
+        # job lands "done", evaluate the candidate generation against
+        # the pre-job baseline on a held-out test dir and promote /
+        # roll back automatically (operator endpoints still override)
+        self.auto_promote = bool(auto_promote)
         self.store = JobStore(job_dir)
         recovered = self.store.recover()
         if recovered:
@@ -171,6 +177,15 @@ class JobScheduler:
         if not hidden or any(h < 1 for h in hidden):
             raise JobError(f"'hidden' layers must be >= 1: {hidden}")
         clean["hidden"] = hidden
+        tests = params.get("test_samples")
+        if tests:
+            # held-out eval corpus for --auto-promote: server-side dir,
+            # validated at submit like 'samples'
+            tests = os.path.abspath(str(tests))
+            if not os.path.isdir(tests):
+                raise JobError(
+                    f"'test_samples' is not a directory: {tests}")
+            clean["test_samples"] = tests
         resume_id = params.get("resume_job")
         if resume_id:
             prev = self.store.get(str(resume_id))
@@ -287,6 +302,18 @@ class JobScheduler:
                         stop: threading.Event) -> None:
         from ..api import train_job
 
+        model = self.app.registry.get(job.kernel)
+        if self.auto_promote and model is not None:
+            # pin the pre-job serving generation NOW: per-epoch swaps
+            # bump + prune generations, and "promote if better" means
+            # better than what was serving BEFORE this job.  Touch the
+            # device weights first: retention snapshots the holder, and
+            # on a server that has taken no traffic yet the holder does
+            # not exist -- the swap would then rebuild containers and
+            # retain NOTHING, silently losing the baseline
+            model.weights()
+            self.store.update(job,
+                              baseline_generation=model.generation)
         self.store.update(job, status="running", started=time.time())
         ckpt_dir = job.ckpt_dir
         watch_state = {"gen": 0}
@@ -334,6 +361,158 @@ class JobScheduler:
                           finished=time.time())
         nn_out(f"jobs: {job.job_id} {status} at epoch "
                f"{result['epoch']}/{job.epochs}\n")
+        if status == "done" and self.auto_promote:
+            try:
+                self._auto_promote(job)
+            except Exception as exc:  # noqa: BLE001 -- the decision is
+                # an optimization on a DONE job: a broken eval must not
+                # re-fail it (the operator endpoints still work)
+                nn_warn(f"jobs: {job.job_id} auto-promote failed: "
+                        f"{type(exc).__name__}: {exc}\n")
+                self.store.update(job, auto_promote={
+                    "action": "skipped",
+                    "reason": f"{type(exc).__name__}: {exc}"})
+
+    # --- eval-driven auto-promotion (ISSUE 13 / ROADMAP 2c) ---------------
+    def _skip_promote(self, job: JobState, reason: str) -> None:
+        nn_out(f"jobs: {job.job_id} auto-promote skipped: {reason}\n")
+        self.store.update(job, auto_promote={"action": "skipped",
+                                             "reason": reason})
+
+    def _eval_generation(self, kernel: str, xs, ts, gen: int):
+        """Classification error of one pinned generation over the test
+        rows, THROUGH the serving path (batcher pinned submits): the
+        eval traffic is real traffic -- it rides the same A/B
+        generation counters a canary fraction rides, which is exactly
+        the evidence the decision records.  Returns (error fraction,
+        generation that actually served, requests)."""
+        import numpy as np
+
+        b = self.app.batchers.get(kernel)
+        if b is None:
+            raise JobError(f"kernel '{kernel}' has no batcher")
+        wrong = requests = 0
+        served_all: set[int] = set()
+        for i in range(0, xs.shape[0], b.max_batch):
+            chunk = np.asarray(xs[i:i + b.max_batch], dtype=np.float64)
+            outs, served = b.submit(chunk, 30.0, gen=gen,
+                                    return_gen=True)
+            served = int(served if served is not None else gen)
+            served_all.add(served)
+            self.app.metrics.count_generation(kernel, served)
+            want = np.argmax(ts[i:i + chunk.shape[0]], axis=1)
+            wrong += int(np.sum(np.argmax(outs, axis=1) != want))
+            requests += 1
+        err = wrong / float(xs.shape[0])
+        return err, served_all, requests
+
+    def _auto_promote(self, job: JobState) -> None:
+        """Promote-if-better: evaluate the finished job's candidate
+        generation against the pre-job baseline on a held-out test dir
+        (the job's ``test_samples`` param, falling back to the conf's
+        ``[test_dir]``) and finalize -- promote on no-regression, roll
+        back on regression.  The decision record (errors, generations,
+        the A/B canary counters as served-traffic evidence) lands in
+        the job's persistent state and a structured ``auto_promote``
+        event."""
+        from ..api import list_sample_dir
+        from ..io import corpus as corpus_io
+
+        model = self.app.registry.get(job.kernel)
+        if model is None:
+            return self._skip_promote(job, "kernel no longer registered")
+        if not job.generations:
+            return self._skip_promote(job, "job landed no generation")
+        table = model.generation_table()
+        candidate = table["current"]
+        ab = table["ab_window"]
+        job_gens = set(int(g) for g in job.generations)
+        # baseline preference: the generation serving at job START
+        # (pinned above) while still retained; else the A/B window's
+        # prev; else the newest retained non-job generation.  A job
+        # whose per-epoch swaps pruned every pre-job generation
+        # (ckpt_every=1, small gen_keep) falls through to skip --
+        # submit with ckpt_every=0 (final-swap-only) for a clean
+        # before/after comparison
+        baseline = None
+        if (job.baseline_generation is not None
+                and job.baseline_generation in table["retained"]):
+            baseline = int(job.baseline_generation)
+        elif ab and ab.get("prev") is not None:
+            baseline = int(ab["prev"])
+        else:
+            prior = [g for g in table["retained"] if g not in job_gens]
+            if prior:
+                baseline = max(prior)
+        if baseline is None:
+            return self._skip_promote(
+                job, "no retained pre-job baseline generation "
+                "(submit with ckpt_every=0, or raise gen_keep)")
+        test_dir = job.params.get("test_samples") or model.nn.conf.tests
+        if not test_dir or not os.path.isdir(str(test_dir)):
+            return self._skip_promote(
+                job, "no test dir (pass 'test_samples' in the submit "
+                "or a [test_dir] in the serving conf)")
+        test_dir = str(test_dir)
+        names = list_sample_dir(test_dir)
+        if not names:
+            return self._skip_promote(job,
+                                      f"test dir {test_dir} is empty")
+        with obs_trace.span("jobs.auto_promote", job=job.job_id,
+                            kernel=job.kernel, candidate=candidate,
+                            baseline=baseline):
+            _events, xs, ts = corpus_io.load_ordered(
+                test_dir, names, list(range(len(names))), "TESTING",
+                model.n_inputs, model.n_outputs)
+            if xs is None or xs.shape[0] == 0:
+                return self._skip_promote(
+                    job, f"no loadable test rows under {test_dir}")
+            base_err, base_served, base_req = self._eval_generation(
+                job.kernel, xs, ts, baseline)
+            if base_served != {baseline}:
+                # the baseline was pruned between the table read and
+                # the eval (weights_for fell back): a decision against
+                # the wrong weights would be worse than no decision
+                return self._skip_promote(
+                    job, f"baseline generation {baseline} no longer "
+                    f"servable (got {sorted(base_served)})")
+            cand_err, _cand_served, cand_req = self._eval_generation(
+                job.kernel, xs, ts, candidate)
+            canary = self.app.metrics.generation_requests(job.kernel)
+            record = {
+                "test_dir": test_dir,
+                "test_rows": int(xs.shape[0]),
+                "candidate": candidate,
+                "baseline": baseline,
+                "candidate_err": round(cand_err, 6),
+                "baseline_err": round(base_err, 6),
+                "eval_requests": base_req + cand_req,
+                # the existing A/B generation counters ARE the canary
+                # evidence: how much traffic (canary fraction, pins,
+                # and this eval) each generation actually served
+                "canary_requests": {
+                    str(candidate): canary.get(str(candidate), 0),
+                    str(baseline): canary.get(str(baseline), 0)},
+            }
+            if cand_err <= base_err:
+                model.promote()
+                record["action"] = action = "auto_promoted"
+            else:
+                model.rollback(gen=baseline)
+                # a rollback is a weights swap: lifecycle metrics stay
+                # truthful, exactly like the operator endpoint
+                self.app.metrics.count_reload(True)
+                self.app.metrics.set_model_info(
+                    model.name, model.generation, model.loaded_at)
+                record["action"] = action = "auto_rolled_back"
+            self.store.update(job, finalized=action,
+                              auto_promote=record)
+        nn_log.nn_event("auto_promote", job=job.job_id,
+                        kernel=job.kernel, **record)
+        nn_out(f"jobs: {job.job_id} {action}: candidate gen "
+               f"{candidate} err {cand_err:.4f} vs baseline gen "
+               f"{baseline} err {base_err:.4f} "
+               f"({xs.shape[0]} test rows)\n")
 
     def _reload_into_serving(self, job: JobState, ckpt_dir: str,
                              watch_state: dict) -> None:

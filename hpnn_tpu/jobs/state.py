@@ -71,7 +71,13 @@ class JobState:
     errors: list = dataclasses.field(default_factory=list)
     generations: list = dataclasses.field(default_factory=list)
     error: str | None = None         # failure diagnostic
-    finalized: str | None = None     # "promoted" | "rolled_back"
+    finalized: str | None = None     # "promoted" | "rolled_back" (also
+    #                                  "auto_promoted"/"auto_rolled_back"
+    #                                  from --auto-promote)
+    auto_promote: dict | None = None  # the eval-driven decision record
+    baseline_generation: int | None = None  # serving gen at job start
+    #                                  (what --auto-promote compares
+    #                                  the candidate against)
     resumed_from: str | None = None  # prior job id (resume submits)
     created: float = 0.0
     started: float = 0.0

@@ -32,6 +32,13 @@ Three layers, stacked so each can be used without the next:
 carry a monotone ``seq`` for incremental cross-host collection
 (``/v1/debug/trace?since_seq=N``), and :func:`set_role` names the
 process's mesh role in auto-dump filenames.
+
+Production hardening (ISSUE 13): ``HPNN_TRACE_SAMPLE=p`` /
+``--trace-sample`` keeps tracing on at fleet QPS by deciding keep/drop
+ONCE at trace birth (explicit trace ids and high-QoS requests force
+capture), and :mod:`.export` ships recorded spans through a bounded
+background spool into rotating NDJSON segments (``--span-dir``) so
+post-hoc analysis survives SIGKILL.
 """
 
 from .trace import (  # noqa: F401
@@ -42,6 +49,7 @@ from .trace import (  # noqa: F401
     enable,
     enable_from_env,
     enabled,
+    get_exporter,
     get_role,
     last_seq,
     new_span_id,
@@ -49,15 +57,19 @@ from .trace import (  # noqa: F401
     record,
     render_ndjson,
     ring_id,
+    sample_stats,
+    sample_trace,
+    set_exporter,
     set_role,
+    set_sample_rate,
     snapshot,
     span,
 )
 
 __all__ = [
     "current_ctx", "disable", "dump_ndjson", "dump_to_dir", "enable",
-    "enable_from_env", "enabled", "get_role", "last_seq",
-    "new_span_id", "new_trace_id", "record", "render_ndjson",
-    "ring_id",
-    "set_role", "snapshot", "span",
+    "enable_from_env", "enabled", "get_exporter", "get_role",
+    "last_seq", "new_span_id", "new_trace_id", "record",
+    "render_ndjson", "ring_id", "sample_stats", "sample_trace",
+    "set_exporter", "set_role", "set_sample_rate", "snapshot", "span",
 ]

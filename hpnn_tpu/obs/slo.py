@@ -31,6 +31,12 @@ Zero-cost when off: serving constructs no tracker unless an SLO knob is
 set (``--slo-p99-ms`` / ``--slo-availability``), and every call site
 guards on ``tracker is not None`` -- the off path is one attribute
 read.
+
+The burn signal is also an ACTUATOR input (ISSUE 13): the
+transition-maintained ``burning_count`` / :meth:`SloTracker.any_burning`
+is what ``serve.mesh.qos.LoadShedder`` polls per request to shed the
+low QoS lane at admission while a budget burns -- one int read on the
+healthy path, never a bucket scan.
 """
 
 from __future__ import annotations
@@ -132,6 +138,10 @@ class SloTracker:
         # (kernel, kind) -> _Objective, created on first record
         self._objectives: dict[tuple[str, str], _Objective] = {}
         self.alerts_total = 0
+        # count of currently-burning objectives, maintained at the
+        # burn/clear transitions: what an actuator (the load shedder)
+        # polls per request -- one int read, no lock, no bucket scan
+        self.burning_count = 0
 
     # objectives are per-kernel forever; a registry serves a handful of
     # kernels, so anything past this cap is junk input (defense in
@@ -199,6 +209,7 @@ class SloTracker:
         if burning and not o.burning:
             o.burning = True
             self.alerts_total += 1
+            self.burning_count += 1
             # fire OUTSIDE the hot path's lock?  The event is one
             # formatted line; holding the lock keeps the transition
             # atomic (no double-fire from racing requests)
@@ -208,9 +219,27 @@ class SloTracker:
                      budget=o.budget)
         elif not burning and o.burning:
             o.burning = False
+            self.burning_count = max(0, self.burning_count - 1)
             nn_event("slo_burn_cleared", kernel=kernel,
                      objective=o.kind, fast_burn=round(fast, 2),
                      slow_burn=round(slow, 2))
+
+    def any_burning(self) -> bool:
+        """True while at least one objective is burning -- the signal
+        an actuator polls per request.  Deliberately reads the
+        transition-maintained counter (one int read); freshness is
+        bounded by the eval throttle + the /metrics scrape, both of
+        which re-evaluate idle objectives."""
+        return self.burning_count > 0
+
+    def evaluate_now(self) -> bool:
+        """Force a full re-evaluation of every objective (windows may
+        have slid past the bad events with no new traffic to trigger
+        the throttled hot-path eval).  Returns :meth:`any_burning`."""
+        with self._lock:
+            for (kernel, _kind), o in list(self._objectives.items()):
+                self._evaluate_locked(kernel, o)
+        return self.any_burning()
 
     # --- read side ------------------------------------------------------
     def snapshot(self) -> dict:

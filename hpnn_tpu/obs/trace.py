@@ -42,12 +42,32 @@ than their cursor and rewind to 0.  :func:`set_role` names this
 process's mesh role (router/worker/local): the SIGTERM/fault auto-dump
 filename includes it (``trace-<reason>-<role>-<pid>.ndjson``) so a
 killed fleet's post-mortems are attributable at a glance.
+
+Head-based sampling (ISSUE 13): full capture cannot survive fleet QPS,
+so the keep/drop decision is made ONCE at trace birth --
+:func:`sample_trace` -- and everything under a dropped trace takes the
+PR-8 zero-allocation no-op path (the HTTP layer simply never mints a
+trace context).  ``HPNN_TRACE_SAMPLE=p`` / ``serve_nn --trace-sample``
+set the probability; an explicit ``X-HPNN-Trace-Id`` or a high-QoS
+request FORCES capture (``force=True``), so a debugging client or the
+traffic you page on always records; ``HPNN_TRACE_SAMPLE_SEED`` makes
+the coin deterministic for tests.  Sampled/dropped/forced counters are
+exported in /metrics.  With no sampler configured every trace is kept
+-- byte-identical to the pre-sampling behavior.
+
+Durable export (ISSUE 13): :func:`set_exporter` attaches an
+:class:`~.export.SpanExporter`; every completed span is then ALSO
+offered to its bounded background spool (rotating NDJSON segment files
+under ``--span-dir``), so post-hoc analysis survives SIGKILL of this
+process -- and :func:`dump_to_dir` reuses that spool (one writer, not
+two) whenever an exporter is active.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import random
 import threading
 import time
 import uuid
@@ -61,6 +81,12 @@ _tls = threading.local()
 # this process's mesh role ("router"/"worker"/"local"); None outside a
 # serving context -- names the auto-dump file, never the hot path
 _role: str | None = None
+# head-based sampling: None = keep every trace (the pre-ISSUE-13
+# behavior); a _Sampler when HPNN_TRACE_SAMPLE / --trace-sample set one
+_sampler: "_Sampler | None" = None
+# durable span spool: None = ring only; an export.SpanExporter when a
+# --span-dir is configured (set_exporter)
+_exporter = None
 
 
 class _State:
@@ -114,7 +140,106 @@ def enable_from_env() -> bool:
     startup hook); returns the resulting enabled state."""
     if os.environ.get("HPNN_TRACE", "") not in ("", "0"):
         enable()
+    set_sample_rate_from_env()
     return enabled()
+
+
+class _Sampler:
+    """The head-sampling coin: one decision per trace at birth.  A
+    dedicated ``random.Random`` (seedable via ``HPNN_TRACE_SAMPLE_SEED``
+    for deterministic tests) so the decision stream is independent of
+    every other RNG in the process; counters are the honest ledger of
+    what the recorder did NOT see."""
+
+    __slots__ = ("rate", "rng", "lock", "sampled_total",
+                 "dropped_total", "forced_total")
+
+    def __init__(self, rate: float, seed: int | None = None):
+        self.rate = min(max(float(rate), 0.0), 1.0)
+        self.rng = random.Random(seed)
+        self.lock = threading.Lock()
+        self.sampled_total = 0
+        self.dropped_total = 0
+        self.forced_total = 0
+
+    def decide(self, force: bool = False) -> bool:
+        with self.lock:
+            if force:
+                self.forced_total += 1
+                self.sampled_total += 1
+                return True
+            if self.rng.random() < self.rate:
+                self.sampled_total += 1
+                return True
+            self.dropped_total += 1
+            return False
+
+
+def set_sample_rate(rate: float | None,
+                    seed: int | None = None) -> None:
+    """Configure head sampling: traces are kept with probability
+    ``rate`` (forced captures always win).  ``None`` (or a rate >= 1
+    with no seed) removes the sampler -- every trace is kept and the
+    counters disappear from /metrics."""
+    global _sampler
+    if rate is None:
+        _sampler = None
+        return
+    if seed is None:
+        env_seed = os.environ.get("HPNN_TRACE_SAMPLE_SEED", "")
+        if env_seed:
+            try:
+                seed = int(env_seed)
+            except ValueError:
+                seed = None
+    _sampler = _Sampler(rate, seed=seed)
+
+
+def set_sample_rate_from_env() -> None:
+    """Install a sampler when ``HPNN_TRACE_SAMPLE`` is set (idempotent
+    no-op otherwise) -- the init_all / server-startup hook."""
+    raw = os.environ.get("HPNN_TRACE_SAMPLE", "")
+    if not raw:
+        return
+    try:
+        set_sample_rate(float(raw))
+    except ValueError:
+        pass  # a malformed rate must not kill startup; keep-all default
+
+
+def sample_trace(force: bool = False) -> bool:
+    """The birth decision: should this trace be captured?  ``force``
+    (an explicit ``X-HPNN-Trace-Id``, a high-QoS request) always keeps
+    and is counted separately.  Without a sampler every trace is kept
+    -- no lock, no counter, the pre-sampling fast path."""
+    s = _sampler
+    if s is None:
+        return True
+    return s.decide(force)
+
+
+def sample_stats() -> dict | None:
+    """Sampling counters for /metrics (None when no sampler is
+    configured -- the series must not exist for a keep-all recorder)."""
+    s = _sampler
+    if s is None:
+        return None
+    with s.lock:
+        return {"rate": s.rate, "sampled_total": s.sampled_total,
+                "dropped_total": s.dropped_total,
+                "forced_total": s.forced_total}
+
+
+def set_exporter(exporter) -> None:
+    """Attach (or, with None, detach) the durable span spool: every
+    span recorded from here on is ALSO offered to
+    ``exporter.offer(span)`` (an :class:`~.export.SpanExporter`)."""
+    global _exporter
+    _exporter = exporter
+
+
+def get_exporter():
+    return _exporter
 
 
 def set_role(role: str | None) -> None:
@@ -263,6 +388,11 @@ def _append(st: _State, name: str, trace_id: str, span_id: str,
         st.seq += 1
         rec["seq"] = st.seq
         st.ring.append(rec)
+    exp = _exporter
+    if exp is not None:
+        # the spool's bounded queue never blocks the traced path: a
+        # full queue drops (counted), the ring is unaffected
+        exp.offer(rec)
 
 
 def record(name: str, t0: float, t1: float,
@@ -343,7 +473,22 @@ def dump_to_dir(dirpath: str, reason: str = "dump",
     halves of in-flight traces survive the process.  Best-effort:
     returns the path, or None when tracing is off / nothing is recorded
     / the write fails -- a dying process must not die harder because
-    its post-mortem failed."""
+    its post-mortem failed.
+
+    With a durable exporter attached (``--span-dir``), the dump REUSES
+    the spool instead of writing a second ad-hoc file (ISSUE 13
+    satellite): the ring's spans are already streaming there, so the
+    post-mortem is one flush + rotate -- extra spans ride into the
+    same segment, and the returned path is the rotated segment."""
+    exp = _exporter
+    if exp is not None:
+        try:
+            if extra_spans:
+                for s in extra_spans:
+                    exp.offer(s)
+            return exp.flush(reason=reason)
+        except Exception:
+            return None
     spans = snapshot()
     if extra_spans:
         spans = sorted(spans + list(extra_spans),

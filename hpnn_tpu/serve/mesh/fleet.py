@@ -80,6 +80,10 @@ class FleetObserver:
         self._store: dict[str, deque] = {}   # addr -> tagged span deque
         self._cursors: dict[str, int] = {}   # addr -> last seq consumed
         self._rings: dict[str, str] = {}     # addr -> last seen ring id
+        # spans EVICTED from a per-worker store deque (capacity hit):
+        # the merged view reports these as an explicit truncation
+        # marker instead of silently narrowing the window (ISSUE 13)
+        self._evicted: dict[str, int] = {}   # addr -> dropped spans
         self._lock = threading.Lock()
         # serializes whole drains (background loop vs query-time drain):
         # cursors must advance under exactly one drain at a time or two
@@ -161,6 +165,20 @@ class FleetObserver:
 
         collected = 0
         with self._drain_lock:
+            # REMOVED workers (autoscale retire/reap -- gone from the
+            # pool table on purpose, unlike merely-dead ones) take
+            # their store/cursor/ring state with them: autoscale churn
+            # mints a fresh ephemeral addr per spawn, and without this
+            # prune a long-lived tracing router would accumulate a
+            # full span ring per corpse forever
+            known = {w.addr for w in self.pool.workers()}
+            with self._lock:
+                for addr in [a for a in self._store if a not in known]:
+                    del self._store[addr]
+                    self._evicted.pop(addr, None)
+            for d in (self._cursors, self._rings):
+                for addr in [a for a in d if a not in known]:
+                    del d[addr]
             for w in self.pool.workers():
                 if w.state == STATE_DEAD:
                     continue
@@ -193,9 +211,22 @@ class FleetObserver:
                         for s in spans:
                             s["host"] = addr
                             s["role"] = "worker"
+                            if len(ring) == ring.maxlen:
+                                # the append below evicts the oldest:
+                                # count it, the merged view reports it
+                                self._evicted[addr] = \
+                                    self._evicted.get(addr, 0) + 1
                             ring.append(s)
                         self.spans_collected_total += len(spans)
                     collected += len(spans)
+                    exp = obs_trace.get_exporter()
+                    if exp is not None:
+                        # durable export (ISSUE 13): collected worker
+                        # spans ride the router's spool too, so the
+                        # remote halves of traces survive a SIGKILL of
+                        # BOTH the worker and this router
+                        for s in spans:
+                            exp.offer(s)
                 self._cursors[addr] = max(last, cursor)
             self.drains_total += 1
         return collected
@@ -215,7 +246,13 @@ class FleetObserver:
         """The fleet-merged view: router ring (tagged role=router) +
         collected worker spans, deduplicated by span id, time-ordered
         oldest first.  ``drain=True`` pages the live workers first so a
-        query reflects spans recorded moments ago."""
+        query reflects spans recorded moments ago.
+
+        Truncation is EXPLICIT (ISSUE 13 satellite): when the bounded
+        per-worker store has evicted spans, or ``limit`` cut the
+        result, the last entry is a synthetic ``trace.truncated``
+        marker carrying the dropped counts -- a narrowed window must
+        announce itself, not masquerade as the whole history."""
         if drain:
             try:
                 self.drain_once()
@@ -246,8 +283,35 @@ class FleetObserver:
         spans = sorted(merged.values(),
                        key=lambda s: (s.get("ts", 0.0),
                                       s.get("seq", 0)))
+        dropped_limit = 0
         if limit is not None:
-            spans = spans[-limit:] if limit > 0 else []
+            kept = spans[-limit:] if limit > 0 else []
+            dropped_limit = len(spans) - len(kept)
+            spans = kept
+        with self._lock:
+            evicted = dict(self._evicted)
+        dropped_store = sum(evicted.values())
+        if dropped_store or dropped_limit:
+            marker = {
+                "name": "trace.truncated",
+                "trace": trace_id or "mesh",
+                "span": "truncation-marker",
+                "parent": None,
+                # anchored to the newest retained span: the marker
+                # must sort last, and minting a fresh wall read here
+                # would say nothing truthful about WHEN spans dropped
+                "ts": spans[-1].get("ts", 0.0) if spans else 0.0,
+                "dur_s": 0.0,
+                "role": "router",
+                "host": self.host,
+                "dropped_spans": dropped_store + dropped_limit,
+            }
+            if dropped_store:
+                marker["dropped_store"] = dropped_store
+                marker["dropped_by_host"] = evicted
+            if dropped_limit:
+                marker["dropped_limit"] = dropped_limit
+            spans = spans + [marker]
         return spans
 
     def merged_dump(self, trace_id: str | None = None,
@@ -298,8 +362,10 @@ class FleetObserver:
         with self._lock:
             retained = sum(len(r) for r in self._store.values())
             tracked = len(self._store)
+            evicted = sum(self._evicted.values())
         return {"spans_collected_total": self.spans_collected_total,
                 "spans_retained": retained,
+                "spans_evicted_total": evicted,
                 "workers_tracked": tracked,
                 "drains_total": self.drains_total,
                 "poll_interval_s": self.poll_interval_s,

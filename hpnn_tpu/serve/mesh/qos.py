@@ -22,7 +22,14 @@ desired-worker gauge from the queue state they shape.
   rows, measured drain rate, live workers) into "how many workers the
   current backlog needs to drain within HPNN_MESH_TARGET_DRAIN_S".
   It is a *signal*, not a controller: smoothing/hysteresis belong to
-  whatever autoscaler consumes the gauge.
+  whatever autoscaler consumes the gauge (``serve/mesh/autoscale.py``
+  is the in-tree one).
+* **SLO-driven shedding** -- :class:`LoadShedder` turns the SLO burn
+  signal (``obs/slo.py``) into an admission actuator: while an error
+  budget is burning, LOW-lane requests are rejected at admission (429
+  ``shed`` + honest Retry-After) so the remaining budget is spent on
+  the traffic that matters; hysteresis keeps the gate from flapping
+  (ISSUE 13).
 """
 
 from __future__ import annotations
@@ -170,6 +177,98 @@ class QuotaTable:
         with self._lock:
             return {"clients": len(self._buckets),
                     "rows_per_s": self.rate, "burst": self.burst}
+
+
+class LoadShedder:
+    """SLO-driven admission gate for the low QoS lane (ISSUE 13).
+
+    State machine, evaluated inline at admission (the off path is one
+    bool + one int read):
+
+    * **engage** the moment any SLO objective is burning (the
+      tracker's transition-maintained ``burning_count``): low-lane
+      requests get 429 ``shed`` with a Retry-After derived from the
+      clear hysteresis -- an honest "when will you take me again";
+    * **clear** only after the burn has been out for
+      ``clear_after_s`` (``HPNN_SHED_CLEAR_S``, default 15 s)
+      CONTINUOUSLY -- hysteresis, so a budget oscillating around the
+      threshold does not flap the gate per request;
+    * while active with no fresh traffic re-evaluating the windows,
+      the shedder itself re-evaluates the tracker (throttled) so the
+      gate can clear even if the shed traffic was the only traffic.
+
+    Only lanes >= ``shed_lane`` (default: the low lane) are shed --
+    high/normal traffic is exactly why the budget is being protected.
+    """
+
+    def __init__(self, tracker, clear_after_s: float | None = None,
+                 shed_lane: int = LANE_LOW):
+        self.tracker = tracker
+        self.clear_after_s = (
+            clear_after_s if clear_after_s is not None
+            else env_float("HPNN_SHED_CLEAR_S", 15.0, lo=0.0))
+        self.shed_lane = int(shed_lane)
+        self._lock = threading.Lock()
+        self.active = False
+        self.engaged_total = 0
+        self.shed_total = 0
+        self._last_burn = 0.0
+        self._last_eval = 0.0
+        self._eval_every = min(0.5, max(self.clear_after_s / 8.0, 0.01))
+
+    def should_shed(self, lane: int) -> bool:
+        """The admission decision for one request (also advances the
+        engage/clear state machine)."""
+        if not self.active and not self.tracker.any_burning():
+            return False  # steady healthy state: zero-cost
+        from .events import mesh_event
+
+        with self._lock:
+            now = time.monotonic()
+            burning = self.tracker.any_burning()
+            if self.active and burning \
+                    and now - self._last_eval >= self._eval_every:
+                # shed traffic may be the ONLY traffic: without a
+                # forced re-eval the windows never slide and the gate
+                # never clears
+                self._last_eval = now
+                burning = self.tracker.evaluate_now()
+            if burning:
+                self._last_burn = now
+                if not self.active:
+                    self.active = True
+                    self.engaged_total += 1
+                    mesh_event(
+                        "shed_engaged",
+                        "mesh: shedding low-lane traffic (SLO error "
+                        "budget burning)\n", level="warn",
+                        lane=LANE_NAMES.get(self.shed_lane, "low"))
+            elif self.active \
+                    and now - self._last_burn >= self.clear_after_s:
+                self.active = False
+                mesh_event(
+                    "shed_cleared",
+                    "mesh: low-lane shedding cleared (SLO burn out "
+                    f"for {self.clear_after_s:g}s)\n",
+                    level="out", shed_total=self.shed_total)
+            if self.active and lane >= self.shed_lane:
+                self.shed_total += 1
+                return True
+            return False
+
+    def retry_after_s(self) -> float:
+        """What the 429 tells an obedient client: the clear hysteresis
+        is the MINIMUM time until the low lane re-admits once the burn
+        stops, clamped to the same [1, 60] band as the queue's."""
+        return max(1.0, min(60.0, self.clear_after_s))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"active": self.active,
+                    "engaged_total": self.engaged_total,
+                    "shed_total": self.shed_total,
+                    "clear_after_s": self.clear_after_s,
+                    "shed_lane": LANE_NAMES.get(self.shed_lane, "low")}
 
 
 def desired_workers(queued_rows: int, drain_rows_per_s: float,

@@ -35,6 +35,7 @@ import time
 from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 
+from ...utils.env import env_float as _env_float
 from ...utils.env import env_int as _env_int
 from ...utils.nn_log import nn_warn
 from .backend import (
@@ -49,6 +50,14 @@ from .events import mesh_event
 STATE_LIVE = "live"
 STATE_WARMING = "warming"   # registered, /healthz still 503-warming
 STATE_DEAD = "dead"
+# being drained out on purpose (autoscale retire / worker goodbye):
+# never routed, never health-promoted back to live.  Exits: removal
+# (the supervisor reaped the process), a registration arriving AFTER
+# the retire grace window (the drain is long over -- this is a
+# restarted process that wants back in, not the dying one's last
+# heartbeat), or the health loop forgetting a retiring corpse whose
+# heartbeats stopped a grace window ago
+STATE_RETIRING = "retiring"
 
 
 class BlobStore:
@@ -108,7 +117,7 @@ class Worker:
 
     __slots__ = ("wid", "addr", "state", "fails", "inflight", "routed",
                  "failovers", "kernels", "created_at", "last_seen",
-                 "jobs")
+                 "jobs", "retired_at")
 
     def __init__(self, addr: str):
         self.wid = addr  # the advertised addr IS the identity
@@ -122,6 +131,7 @@ class Worker:
         self.jobs: dict | None = None  # heartbeat-advertised job state
         self.created_at = time.time()  # displayed registration timestamp
         self.last_seen = time.monotonic()
+        self.retired_at = 0.0  # monotonic; set when retiring starts
 
     def to_dict(self) -> dict:
         d = {"addr": self.addr, "state": self.state,
@@ -141,6 +151,15 @@ class WorkerPool:
                  router_token: str | None = None):
         self.eject_after = (eject_after if eject_after is not None
                             else _env_int("HPNN_MESH_EJECT_AFTER", 2))
+        # how long a retirement "owns" the addr: registrations inside
+        # the window are the DYING process's heartbeats (stay
+        # retiring); after it, a registration is a restarted process
+        # that wants back in (promote), and a retiring corpse whose
+        # heartbeats stopped this long ago is forgotten by the health
+        # loop -- without the window, one goodbye would brick the addr
+        # forever (retiring was sticky across restarts)
+        self.retire_grace_s = _env_float("HPNN_MESH_RETIRE_GRACE_S",
+                                         60.0, lo=0.1)
         self.auth_token = auth_token
         # the spill-protection token RemoteBackend stamps on every
         # dispatch RPC (X-HPNN-Router); workers learn it from the
@@ -185,7 +204,19 @@ class WorkerPool:
                            f"mesh: worker {addr} readmitted "
                            "(re-registration)\n",
                            worker=addr, via="re-registration")
-            if w.state != STATE_WARMING:
+            if w.state == STATE_RETIRING:
+                # inside the grace window this is the dying process's
+                # own heartbeat -- it must not re-enter routing; past
+                # it, the drain is long over and a registering process
+                # is a RESTART that wants back in
+                if (time.monotonic() - w.retired_at
+                        > self.retire_grace_s):
+                    w.state = STATE_LIVE
+                    mesh_event("worker_readmitted",
+                               f"mesh: worker {addr} readmitted "
+                               "(re-registration after retirement)\n",
+                               worker=addr, via="post-retire")
+            elif w.state != STATE_WARMING:
                 w.state = STATE_LIVE
             w.fails = 0
             w.last_seen = time.monotonic()
@@ -253,6 +284,61 @@ class WorkerPool:
         with self._lock:
             worker.inflight = max(0, worker.inflight - 1)
 
+    # --- elastic lifecycle (ISSUE 13) ------------------------------------
+    def retire(self, addr: str, via: str = "autoscale") -> bool:
+        """Take a worker OUT of routing on purpose (scale-down /
+        graceful goodbye): placement skips it, the health loop leaves
+        it alone, and in-flight batches finish normally -- the drain
+        half of drain-then-SIGTERM.  False for unknown workers."""
+        with self._lock:
+            w = self._workers.get(addr)
+            if w is None or w.state == STATE_RETIRING:
+                return w is not None
+            w.state = STATE_RETIRING
+            w.retired_at = time.monotonic()
+        mesh_event("worker_retiring",
+                   f"mesh: worker {addr} retiring ({via})\n",
+                   worker=addr, via=via)
+        return True
+
+    def unretire(self, addr: str) -> bool:
+        """Cancel a retirement that never happened (the exec hook
+        failed): the worker is healthy and goes straight back into
+        routing."""
+        with self._lock:
+            w = self._workers.get(addr)
+            if w is None or w.state != STATE_RETIRING:
+                return False
+            w.state = STATE_LIVE
+            w.retired_at = 0.0
+        mesh_event("worker_readmitted",
+                   f"mesh: worker {addr} readmitted "
+                   "(retirement cancelled)\n",
+                   worker=addr, via="unretire")
+        return True
+
+    def inflight_of(self, addr: str) -> int:
+        """Batches currently in flight to one worker (the drain gate:
+        SIGTERM waits for 0)."""
+        with self._lock:
+            w = self._workers.get(addr)
+            return w.inflight if w is not None else 0
+
+    def remove(self, addr: str) -> bool:
+        """Forget a worker entirely (its process is gone): the table,
+        affinity entries and quorum math stop counting it."""
+        with self._lock:
+            w = self._workers.pop(addr, None)
+            if w is None:
+                return False
+            for key in [k for k, wid in self._affinity.items()
+                        if wid == addr]:
+                del self._affinity[key]
+        mesh_event("worker_removed",
+                   f"mesh: worker {addr} removed\n",
+                   level="dbg", worker=addr)
+        return True
+
     # --- health ----------------------------------------------------------
     def report_failure(self, worker: Worker, exc: Exception) -> None:
         """A dispatch-time transport failure: decisive, eject NOW (the
@@ -278,6 +364,8 @@ class WorkerPool:
         with self._lock:
             worker.fails = 0
             worker.last_seen = time.monotonic()
+            if worker.state == STATE_RETIRING:
+                return  # healthy, but being drained out on purpose
             if worker.state == STATE_DEAD:
                 worker.state = STATE_LIVE
                 mesh_event("worker_readmitted",
@@ -288,8 +376,19 @@ class WorkerPool:
 
     def check_health_once(self) -> None:
         """One poll round over every known worker (dead ones included --
-        that is the readmission path)."""
+        that is the readmission path).  RETIRING workers are not
+        polled, but a retiring CORPSE -- heartbeats stopped a full
+        grace window ago, so the process is really gone -- is
+        forgotten here: the exec-hook retire path has no subprocess to
+        reap, and without this sweep its table entry would linger
+        forever."""
+        now = time.monotonic()
         for w in self.workers():
+            if w.state == STATE_RETIRING:
+                if (now - w.last_seen > self.retire_grace_s
+                        and now - w.retired_at > self.retire_grace_s):
+                    self.remove(w.addr)
+                continue
             try:
                 status, body = get_json(w.addr, "/healthz", timeout_s=2.0)
             except TRANSPORT_ERRORS as exc:

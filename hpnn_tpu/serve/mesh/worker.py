@@ -244,8 +244,29 @@ class WorkerAgent:
         self._thread.start()
         return self
 
-    def close(self) -> None:
+    def close(self, goodbye: bool = True) -> None:
+        """Stop the heartbeat loop and -- on a GRACEFUL exit -- say
+        goodbye to the current router (best-effort ``{"retiring":
+        true}``): the router pulls this worker out of routing
+        immediately instead of discovering the death through health
+        misses, the clean half of a drain-then-SIGTERM retirement
+        (ISSUE 13).  ``goodbye=False`` is the abrupt path (crash
+        simulation, drain=False shutdown): dying silently is the
+        point, so the router's failover machinery gets exercised."""
+        if self._closed:
+            return
         self._closed = True
+        if not goodbye:
+            return
+        headers = {}
+        if self.app.auth_token:
+            headers["Authorization"] = f"Bearer {self.app.auth_token}"
+        try:
+            post_json(self.current, "/v1/mesh/register",
+                      {"addr": self.advertise, "retiring": True},
+                      timeout_s=2.0, headers=headers)
+        except TRANSPORT_ERRORS:
+            pass  # the router is gone too: health misses clean up
 
     def info(self) -> dict:
         """What the worker's /healthz reports under ``mesh``."""

@@ -32,7 +32,7 @@ _BUCKET_MIN_S = 1e-4
 _N_BUCKETS = 61
 
 _REQUEST_OUTCOMES = ("ok", "queue_full", "quota_exceeded", "deadline",
-                     "bad_request", "not_found", "error")
+                     "bad_request", "not_found", "error", "shed")
 
 # request-path phases (ISSUE 8): per-phase latency distributions join
 # /metrics so a slow p99 can be attributed without turning tracing on.
@@ -253,6 +253,9 @@ class ServeMetrics:
         self._quota_fn: Callable[[], dict] | None = None
         # per-kernel QoS lane depth gauges (rows queued per lane)
         self._lane_fns: dict[str, Callable[[], dict]] = {}
+        # SLO-driven load shedder (ISSUE 13): live-callback like the
+        # other subsystem sources; None when shedding is off
+        self._shed_fn: Callable[[], dict] | None = None
         # SLO tracker (ISSUE 10): None unless --slo-* configured; the
         # batcher records latency against it through this reference
         # (one attribute read on the off path)
@@ -364,6 +367,12 @@ class ServeMetrics:
                 for k in sorted(numeric, key=int)[:-self.GEN_LABELS_KEPT]:
                     d["older"] = d.get("older", 0) + d.pop(k)
 
+    def generation_requests(self, kernel: str) -> dict:
+        """One kernel's per-generation request counters (the A/B canary
+        evidence the auto-promoter records into its decision)."""
+        with self._lock:
+            return dict(self._gen_requests.get(kernel, {}))
+
     def set_jobs_source(self, fn: Callable[[], dict] | None) -> None:
         """Attach the job scheduler's live metrics callback (queue
         depth, running job epoch/error, cumulative trained epochs)."""
@@ -385,6 +394,12 @@ class ServeMetrics:
         """Attach the quota table's live snapshot callback."""
         with self._lock:
             self._quota_fn = fn
+
+    def set_shed_source(self, fn: Callable[[], dict] | None) -> None:
+        """Attach the load shedder's live snapshot callback
+        (``mesh.qos.LoadShedder.snapshot``)."""
+        with self._lock:
+            self._shed_fn = fn
 
     def set_slo(self, tracker) -> None:
         """Attach the SLO tracker (obs.slo.SloTracker); its burn-rate
@@ -427,6 +442,7 @@ class ServeMetrics:
         mesh_fn = self._mesh_fn
         autoscale_fn = self._autoscale_fn
         quota_fn = self._quota_fn
+        shed_fn = self._shed_fn
         # the source callbacks take their own subsystem locks
         # (scheduler/store, worker pool, batchers): call them OUTSIDE
         # our own lock (no nested-lock ordering to get wrong)
@@ -434,7 +450,16 @@ class ServeMetrics:
         mesh = mesh_fn() if mesh_fn is not None else None
         autoscale = autoscale_fn() if autoscale_fn is not None else None
         quota = quota_fn() if quota_fn is not None else None
+        shed = shed_fn() if shed_fn is not None else None
         slo = self.slo.snapshot() if self.slo is not None else None
+        # trace sampling + durable export (ISSUE 13): module-level obs
+        # state, absent when unconfigured (the series must not exist
+        # for a keep-all / ring-only recorder)
+        from ..obs import trace as obs_trace
+
+        sampling = obs_trace.sample_stats()
+        exporter = obs_trace.get_exporter()
+        export = exporter.stats() if exporter is not None else None
         with self._lock:
             req = dict(self.requests)
             out = {
@@ -463,8 +488,14 @@ class ServeMetrics:
             out["autoscale"] = autoscale
         if quota is not None:
             out["quota"] = quota
+        if shed is not None:
+            out["shed"] = shed
         if slo is not None:
             out["slo"] = slo
+        if sampling is not None:
+            out["trace_sampling"] = sampling
+        if export is not None:
+            out["span_export"] = export
         out["latency"] = self.latency.snapshot()
         out["queue_latency"] = self.queue_latency.snapshot()
         out["device_time"] = self.device_time.snapshot()
@@ -628,6 +659,76 @@ class ServeMetrics:
                 "rows/sec across all batchers.",
                 "# TYPE hpnn_serve_drain_rows_per_sec gauge",
                 f"hpnn_serve_drain_rows_per_sec {a['drain_rows_per_s']}",
+            ]
+            sup = a.get("supervisor")
+            if sup is not None:
+                lines += [
+                    "# HELP hpnn_autoscale_managed_workers Worker "
+                    "subprocesses the router supervisor currently "
+                    "manages.",
+                    "# TYPE hpnn_autoscale_managed_workers gauge",
+                    f"hpnn_autoscale_managed_workers {sup['managed']}",
+                    "# HELP hpnn_autoscale_events_total Supervisor "
+                    "scaling actions by kind.",
+                    "# TYPE hpnn_autoscale_events_total counter",
+                    'hpnn_autoscale_events_total{kind="spawn"} '
+                    f"{sup['spawns_total']}",
+                    'hpnn_autoscale_events_total{kind="retire"} '
+                    f"{sup['retires_total']}",
+                ]
+        if snap.get("shed") is not None:
+            sh = snap["shed"]
+            lines += [
+                "# HELP hpnn_shed_active Low-lane load shedding "
+                "engaged (SLO error budget burning).",
+                "# TYPE hpnn_shed_active gauge",
+                f"hpnn_shed_active {1 if sh['active'] else 0}",
+                "# HELP hpnn_shed_requests_total Requests rejected "
+                "429 by the SLO-driven shedder.",
+                "# TYPE hpnn_shed_requests_total counter",
+                f"hpnn_shed_requests_total {sh['shed_total']}",
+                "# HELP hpnn_shed_engaged_total Shed engage "
+                "transitions (one per incident, hysteresis on clear).",
+                "# TYPE hpnn_shed_engaged_total counter",
+                f"hpnn_shed_engaged_total {sh['engaged_total']}",
+            ]
+        if snap.get("trace_sampling") is not None:
+            ts = snap["trace_sampling"]
+            lines += [
+                "# HELP hpnn_trace_sample_rate Head-sampling keep "
+                "probability at trace birth.",
+                "# TYPE hpnn_trace_sample_rate gauge",
+                f"hpnn_trace_sample_rate {ts['rate']}",
+                "# HELP hpnn_trace_decisions_total Head-sampling "
+                "decisions by outcome (forced = explicit trace id or "
+                "high-QoS, counted inside sampled).",
+                "# TYPE hpnn_trace_decisions_total counter",
+                'hpnn_trace_decisions_total{outcome="sampled"} '
+                f"{ts['sampled_total']}",
+                'hpnn_trace_decisions_total{outcome="dropped"} '
+                f"{ts['dropped_total']}",
+                'hpnn_trace_decisions_total{outcome="forced"} '
+                f"{ts['forced_total']}",
+            ]
+        if snap.get("span_export") is not None:
+            se = snap["span_export"]
+            lines += [
+                "# HELP hpnn_span_export_spans_total Spans shipped to "
+                "the durable spool (dropped = bounded queue full).",
+                "# TYPE hpnn_span_export_spans_total counter",
+                'hpnn_span_export_spans_total{outcome="exported"} '
+                f"{se['exported_total']}",
+                'hpnn_span_export_spans_total{outcome="dropped"} '
+                f"{se['dropped_total']}",
+                "# HELP hpnn_span_export_rotations_total Finalized "
+                "(fsync'd + renamed) spool segments.",
+                "# TYPE hpnn_span_export_rotations_total counter",
+                f"hpnn_span_export_rotations_total "
+                f"{se['rotations_total']}",
+                "# HELP hpnn_span_export_segments Finalized segments "
+                "currently retained in the span dir.",
+                "# TYPE hpnn_span_export_segments gauge",
+                f"hpnn_span_export_segments {se['segments']}",
             ]
         if snap.get("mesh") is not None:
             msh = snap["mesh"]

@@ -27,14 +27,20 @@ Endpoints:
   bucket-merged latency histograms, per-kernel generation min/max);
   dead workers federate as an explicit gap (``hpnn_fleet_worker_up
   0``), never stale series.
-* ``GET /v1/debug/trace[?trace=ID&limit=N&since_seq=S]`` -- the
-  observability flight recorder (hpnn_tpu.obs) as NDJSON, one
+* ``GET /v1/debug/trace[?trace=ID&limit=N&since_seq=S&spool=1]`` --
+  the observability flight recorder (hpnn_tpu.obs) as NDJSON, one
   completed span per line; 404 until tracing is enabled (``--trace`` /
   ``HPNN_TRACE=1``).  Each infer request's trace id
   (``X-HPNN-Trace-Id`` request header, or generated) is echoed in the
   response header + body, and its span tree (parse -> queue-wait ->
   batch-assembly -> pad/H2D -> device launch -> D2H -> respond) is
-  recorded here.  On a mesh router the response is the FLEET-MERGED
+  recorded here.  With ``--trace-sample P`` the keep/drop decision is
+  made once at trace birth: dropped requests take the zero-allocation
+  no-trace path (no id minted), while an explicit ``X-HPNN-Trace-Id``
+  or a high-QoS request always captures.  ``?spool=1`` reads back
+  through the DURABLE span spool (``--span-dir`` rotating NDJSON
+  segments) instead of the in-memory ring -- the view that survives
+  SIGKILL.  On a mesh router the response is the FLEET-MERGED
   tree: the router's spans (``role=router``) plus every worker's
   collected spans (``host=<addr>, role=worker``), so one query yields
   the complete route -> worker -> device tree -- including spans from
@@ -119,9 +125,11 @@ Status mapping (distinct by failure class, so clients can react):
         on a ``--require-router`` worker (spill protection)
   404   unknown kernel / job / pinned generation / blob hash
   409   reload failed / job action in a conflicting state
-  429   queue full or quota exceeded (backpressure -- the
+  429   queue full, quota exceeded, or low-lane traffic shed while
+        an SLO error budget is burning (``--shed-low``) -- the
         Retry-After header is computed from the queue's measured
-        drain rate / the quota bucket's refill rate)
+        drain rate / the quota bucket's refill rate / the shed
+        gate's clear hysteresis
   501   device profiler unavailable on this host/backend
   503   server draining (shutdown in progress) / jobs disabled /
         no live mesh worker / passive standby (``standby_passive``:
@@ -244,7 +252,10 @@ class ServeApp:
                  quota_burst: float | None = None,
                  slo_p99_ms: float | None = None,
                  slo_availability: float | None = None,
-                 require_router: bool = False):
+                 require_router: bool = False,
+                 trace_sample: float | None = None,
+                 span_dir: str | None = None,
+                 shed_low: bool | None = None):
         self.metrics = metrics or ServeMetrics()
         self.auth_token = auth_token or None
         # spill protection (worker-side): only serve infer traffic
@@ -255,16 +266,29 @@ class ServeApp:
         # SLO tracking (ISSUE 10): constructed only when an objective
         # is configured -- the off path is `self.slo is None`
         self.slo = None
+        self.shedder = None
         if slo_p99_ms is not None or slo_availability is not None:
             from ..obs.slo import SloTracker
 
             self.slo = SloTracker(availability=slo_availability,
                                   p99_ms=slo_p99_ms)
             self.metrics.set_slo(self.slo)
+            # SLO-driven load shedding (ISSUE 13): the burn signal
+            # becomes an actuator -- while an objective is burning the
+            # LOW QoS lane is rejected at admission (429 + honest
+            # Retry-After, hysteresis on clear).  Opt-in (--shed-low /
+            # HPNN_SHED=1): unannounced 429s would surprise operators
+            # who only asked for gauges
+            if shed_low is None:
+                shed_low = os.environ.get("HPNN_SHED", "") == "1"
+            if shed_low:
+                self.shedder = mesh_qos.LoadShedder(self.slo)
+                self.metrics.set_shed_source(self.shedder.snapshot)
         self.jobs = None  # JobScheduler once enable_jobs() runs
         self.mesh_router = None  # MeshRouter once enable_mesh_router()
         self.mesh_worker = None  # WorkerAgent when serving as a worker
         self.mesh_standby = None  # StandbyMonitor on a standby router
+        self.autoscaler = None  # WorkerSupervisor once enable_autoscale()
         # per-client token-bucket quotas (rows/sec; 0 = no quota)
         self.quota = (mesh_qos.QuotaTable(quota_rows, quota_burst)
                       if quota_rows and quota_rows > 0 else None)
@@ -281,6 +305,21 @@ class ServeApp:
             obs_trace.enable_from_env()
         else:
             obs_trace.disable()
+        # head-based trace sampling (ISSUE 13): the keep/drop decision
+        # is made once at trace birth in do_POST; an explicit flag wins
+        # over HPNN_TRACE_SAMPLE (applied by enable_from_env above)
+        if trace_sample is not None:
+            obs_trace.set_sample_rate(trace_sample)
+        # durable span export (ISSUE 13): spans stream off the ring
+        # into rotating NDJSON segments under span_dir, so post-hoc
+        # analysis survives SIGKILL of this process
+        self.span_exporter = None
+        span_dir = span_dir or os.environ.get("HPNN_SPAN_DIR") or None
+        if span_dir:
+            from ..obs.export import SpanExporter
+
+            self.span_exporter = SpanExporter(span_dir)
+            obs_trace.set_exporter(self.span_exporter)
         mesh = None
         if parity == "fast" and mesh_devices != 0:  # 0: explicitly off
             from ..parallel.mesh import data_mesh
@@ -383,13 +422,21 @@ class ServeApp:
 
     def close(self, drain: bool = True) -> None:
         self._closed = True
+        if self.autoscaler is not None:
+            # first: a supervisor spawning/retiring mid-shutdown would
+            # fight the drain below; managed workers get the same
+            # drain-then-SIGTERM they get at scale-down
+            self.autoscaler.close()
         if self.jobs is not None:
             # graceful job drain FIRST: the running job finishes its
             # in-flight epoch, snapshots and lands `interrupted`
             # (resumable) before the eval batchers stop
             self.jobs.drain()
         if self.mesh_worker is not None:
-            self.mesh_worker.close()
+            # goodbye only on a GRACEFUL drain: drain=False is the
+            # crash-simulation path and must look like one to the
+            # router (its failover machinery is what's under test)
+            self.mesh_worker.close(goodbye=drain)
         if self.mesh_standby is not None:
             self.mesh_standby.close()
         for b in self.batchers.values():
@@ -398,6 +445,14 @@ class ServeApp:
             # after the batchers: draining batches may still need the
             # pool's RPC executor
             self.mesh_router.close()
+        if self.span_exporter is not None:
+            # after everything that records spans: the last batch of
+            # spans lands in a final rotated segment
+            from ..obs import trace as obs_trace
+
+            if obs_trace.get_exporter() is self.span_exporter:
+                obs_trace.set_exporter(None)
+            self.span_exporter.close()
 
     # --- auth (mutating endpoints) --------------------------------------
     def authorized(self, headers) -> bool:
@@ -424,17 +479,22 @@ class ServeApp:
 
     # --- online training jobs -------------------------------------------
     def enable_jobs(self, job_dir: str, capacity: int = 8,
-                    preempt_wait_s: float = 2.0):
+                    preempt_wait_s: float = 2.0,
+                    auto_promote: bool = False):
         """Attach the train-while-serving job subsystem (``serve_nn
         --jobs N``): bounded queue + scheduler worker + persistent job
-        store under ``job_dir``, with its gauges wired into /metrics."""
+        store under ``job_dir``, with its gauges wired into /metrics.
+        ``auto_promote`` (``--auto-promote``) closes ROADMAP 2(c): a
+        finished job's candidate generation is evaluated on a held-out
+        test dir and promoted-if-better / rolled back automatically."""
         from ..jobs import JobScheduler
 
         # jobs consume retained generations (rollback, explicit pins,
         # canary counters) even when no A/B fraction is configured
         self.registry.retain_generations = True
         self.jobs = JobScheduler(self, job_dir, capacity=capacity,
-                                 preempt_wait_s=preempt_wait_s)
+                                 preempt_wait_s=preempt_wait_s,
+                                 auto_promote=auto_promote)
         self.metrics.set_jobs_source(self.jobs.metrics_snapshot)
         return self.jobs
 
@@ -522,6 +582,12 @@ class ServeApp:
         if not (_host and port.isdigit() and 0 < int(port) < 65536):
             raise _HTTPError(400, "bad_request",
                              f"'addr' must be HOST:PORT, got {addr!r}")
+        if req.get("retiring") is True:
+            # a worker saying goodbye (SIGTERM drain, autoscale
+            # retire): out of routing NOW, not after health misses --
+            # the clean half of the elastic lifecycle (ISSUE 13)
+            known = self.mesh_router.pool.retire(addr, via="goodbye")
+            return {"ok": True, "retiring": True, "known": known}
         kernels = req.get("kernels")
         if kernels is not None and not isinstance(kernels, dict):
             raise _HTTPError(400, "bad_request",
@@ -547,21 +613,52 @@ class ServeApp:
                              "missing or invalid auth token")
         return self.mesh_router.state_snapshot(bool(self.auth_token))
 
+    def enable_autoscale(self, router_addr: str, confs: list[str],
+                         min_workers: int = 1, max_workers: int = 4,
+                         cooldown_s: float | None = None,
+                         worker_args: tuple = (),
+                         poll_s: float | None = None,
+                         start: bool = True):
+        """Attach the elastic worker supervisor (``serve_nn --autoscale
+        MIN:MAX`` on a router): the desired-workers gauge becomes an
+        actuator that spawns/retires local worker subprocesses (or
+        drives the ``HPNN_AUTOSCALE_EXEC`` hook) -- see
+        ``serve/mesh/autoscale.py``."""
+        from .mesh.autoscale import WorkerSupervisor
+
+        # an auth-enabled router's spawned workers must send the token
+        # with their registration heartbeats, or they could never join
+        # the fleet they were spawned for; env, not argv (ps-safe)
+        extra_env = ({"HPNN_SERVE_TOKEN": self.auth_token}
+                     if self.auth_token else None)
+        self.autoscaler = WorkerSupervisor(
+            self, router_addr, confs, min_workers=min_workers,
+            max_workers=max_workers, cooldown_s=cooldown_s,
+            poll_s=poll_s, worker_args=worker_args,
+            extra_env=extra_env)
+        if start:
+            self.autoscaler.start()
+        return self.autoscaler
+
     def autoscale_snapshot(self) -> dict:
         """The autoscaling signal /metrics renders: queued rows, the
         measured fleet drain rate, and the desired-worker-count gauge
-        derived from them (``mesh.qos.desired_workers``)."""
+        derived from them (``mesh.qos.desired_workers``); with a
+        supervisor attached, its actuator counters ride along."""
         queued = sum(b.depth() for b in self.batchers.values())
         rate = sum(b.drain_rate() for b in self.batchers.values())
         live = (self.mesh_router.pool.live_count()
                 if self.mesh_router is not None else 1)
-        return {
+        out = {
             "queued_rows": queued,
             "drain_rows_per_s": round(rate, 2),
             "live_workers": live,
             "desired_workers": mesh_qos.desired_workers(queued, rate,
                                                         live),
         }
+        if self.autoscaler is not None:
+            out["supervisor"] = self.autoscaler.snapshot()
+        return out
 
     # --- model lifecycle (hot reload) ----------------------------------
     def reload_model(self, name: str,
@@ -769,6 +866,18 @@ class ServeApp:
                 headers.get("X-HPNN-Priority") if headers else None)
         except ValueError as exc:
             raise _HTTPError(400, "bad_request", str(exc))
+        # SLO-driven load shedding (ISSUE 13): while the availability /
+        # latency budget is burning, the LOW lane is rejected at
+        # admission -- before parsing rows or touching quota -- so the
+        # budget is spent on the traffic that matters.  The 429 is a
+        # CLIENT-visible policy outcome (4xx: spends no SLO budget
+        # itself, or shedding would hold the burn alight forever).
+        if self.shedder is not None and self.shedder.should_shed(lane):
+            raise _HTTPError(
+                429, "shed",
+                "low-priority traffic shed: the availability budget "
+                "is burning (retry later or raise X-HPNN-Priority)",
+                retry_after=self.shedder.retry_after_s())
         raw = req.get("inputs")
         if raw is None:
             one = req.get("input")
@@ -1194,11 +1303,6 @@ class _Handler(BaseHTTPRequestHandler):
         if path == "/v1/debug/trace":
             from ..obs import trace as obs_trace
 
-            if not obs_trace.enabled():
-                self._reply(404, {"error": "tracing is disabled (start "
-                                  "serve_nn with --trace or HPNN_TRACE=1)",
-                                  "reason": "tracing_disabled"})
-                return
             params = dict(
                 kv.split("=", 1) for kv in query.split("&") if "=" in kv)
             limit = since_seq = None
@@ -1212,6 +1316,35 @@ class _Handler(BaseHTTPRequestHandler):
                                   "reason": "bad_request"})
                 return
             trace_id = params.get("trace") or None
+            if params.get("spool") == "1":
+                # read back through the DURABLE spool (ISSUE 13): the
+                # rotated segments plus the open spool files, so a
+                # trace evicted from the ring -- or recorded by an
+                # earlier, killed process spooling into the same
+                # --span-dir -- is still answerable
+                exp = self.app.span_exporter
+                if exp is None:
+                    self._reply(404, {"error": "no span spool (start "
+                                      "serve_nn with --span-dir)",
+                                      "reason": "spool_disabled"})
+                    return
+                from ..obs.export import read_spool
+
+                # pending spans become readable first; drain (not
+                # flush): a polling reader must not force a rotation
+                # per query
+                exp.drain()
+                spans = read_spool(exp.span_dir, trace_id=trace_id,
+                                   limit=limit)
+                self._reply(200, obs_trace.render_ndjson(spans)
+                            .encode("utf-8"),
+                            content_type="application/x-ndjson")
+                return
+            if not obs_trace.enabled():
+                self._reply(404, {"error": "tracing is disabled (start "
+                                  "serve_nn with --trace or HPNN_TRACE=1)",
+                                  "reason": "tracing_disabled"})
+                return
             router = self.app.mesh_router
             # ?since_seq / ?local=1 page THIS process's ring (the
             # fleet collector's per-host protocol: seq numbers are
@@ -1420,11 +1553,22 @@ class _Handler(BaseHTTPRequestHandler):
         # root span context rides down through batcher + registry --
         # with tracing OFF trace_ctx stays None and this whole block is
         # one header read (the zero-cost guard).
+        #
+        # Head-based sampling (ISSUE 13): the keep/drop decision is
+        # made HERE, once, at trace birth -- a dropped trace never
+        # mints a context, so everything downstream takes the same
+        # zero-allocation path as tracing-off.  An explicit trace id
+        # (the client is debugging) or a high-QoS request forces
+        # capture; the mesh RPC carries the head's trace id, so a
+        # router's keep decision force-captures on its workers too.
         trace_hdr = (self.headers.get("X-HPNN-Trace-Id") or "").strip()
         trace_ctx = None
         if obs_trace.enabled():
-            trace_ctx = (trace_hdr or obs_trace.new_trace_id(),
-                         obs_trace.new_span_id())
+            prio = (self.headers.get("X-HPNN-Priority") or "").strip()
+            force = bool(trace_hdr) or prio.lower() in ("high", "0")
+            if obs_trace.sample_trace(force=force):
+                trace_ctx = (trace_hdr or obs_trace.new_trace_id(),
+                             obs_trace.new_span_id())
         echo = ({"X-HPNN-Trace-Id": trace_ctx[0]} if trace_ctx
                 else ({"X-HPNN-Trace-Id": trace_hdr} if trace_hdr
                       else None))

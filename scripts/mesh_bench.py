@@ -32,7 +32,19 @@ workers' ``--router`` at a remote address):
    fronting one worker; the PRIMARY is killed with SIGKILL under load.
    The row records the takeover latency (kill -> the standby's
    /healthz goes ready) and non-200s AFTER the client's single
-   documented retry against the survivor (floor: zero).
+   documented retry against the survivor (floor: zero);
+7. **shed** (ISSUE 13) -- a worker armed with ``HPNN_FAULT``
+   side=server fabricates a 5xx burst; the row records how fast the
+   router's SLO-driven shedder engages (low lane 429 at admission),
+   that the HIGH lane serves 200s straight through the shed window
+   (floor: zero high-lane non-200), and how fast the gate recovers
+   with hysteresis once the burst ends;
+8. **autoscale** (ISSUE 13) -- the router's supervisor spawns its
+   min-floor worker, a sustained 12-client backlog drives a scale-up
+   to 2 workers, and the quiet period after the load retires one via
+   drain-then-SIGTERM; the row records first-worker/scale-up/
+   scale-down latencies (floor: every client response across the
+   whole episode is a 200).
 
 Honesty rules (bench.py protocol): every latency is a client-observed
 wall time, non-200s are counted never dropped, floors are asserted and
@@ -451,6 +463,208 @@ def main() -> int:
             "takeover_s": round(takeover_s, 3) if takeover_s else None,
         }
 
+        # --- 7. SLO-driven shedding (ISSUE 13) ---------------------------
+        # a worker armed with server-side chaos fabricates a 5xx burst;
+        # the router's availability budget burns, the shed gate engages
+        # (low lane 429 at admission), and clears with hysteresis once
+        # the burst is over -- event latencies measured client-side
+        sapp = ServeApp(slo_availability=0.995, shed_low=True,
+                        **serve_kw)
+        sapp.slo.fast_s = 2.0
+        sapp.slo.slow_s = 4.0
+        sapp.slo.burn_threshold = 2.0
+        sapp.slo.eval_interval_s = 0.0
+        sapp.shedder.clear_after_s = 1.0
+        sapp.shedder._eval_every = 0.05
+        sapp.enable_mesh_router(required_workers=1,
+                                health_interval_s=0.5)
+        assert sapp.add_model(conf) is not None
+        shttpd, _ = serve_in_thread("127.0.0.1", 0, sapp)
+        sbase = "http://127.0.0.1:%d" % shttpd.server_address[1]
+        n_burst = 12
+        os.environ["HPNN_FAULT"] = (
+            "http@/v1/kernels/mesh/infer:side=server,after=8,"
+            f"every=1,times={n_burst},code=503")
+        shed_proc = None
+        try:
+            shed_proc, _sp = spawn_worker(
+                conf, "127.0.0.1:%d" % shttpd.server_address[1],
+                wargs, real=args.real)
+            del os.environ["HPNN_FAULT"]
+            wait_healthz_ok(sbase)
+            payload = {"inputs": inputs[:4].tolist()}
+            low_h = {"X-HPNN-Priority": "low"}
+            # phase A: the fault's after=8 window -- healthy serving
+            # (first requests also pay the worker's compile)
+            for _ in range(8):
+                st, _ = serve_bench.http_json(
+                    sbase + "/v1/kernels/mesh/infer", payload,
+                    timeout_s=120.0)
+                assert st == 200, f"healthy phase failed: {st}"
+            # phase B: the burst -- drive it and stamp the first 503
+            t_first_503 = None
+            saw_503 = 0
+            for _ in range(n_burst):
+                st, _ = serve_bench.http_json(
+                    sbase + "/v1/kernels/mesh/infer", payload)
+                if st == 503:
+                    saw_503 += 1
+                    if t_first_503 is None:
+                        t_first_503 = time.monotonic()
+            # engage: poll the LOW lane until the shed 429 appears
+            shed_engage_s = None
+            low_shed = 0
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                st, body = serve_bench.http_json(
+                    sbase + "/v1/kernels/mesh/infer", payload,
+                    headers=low_h)
+                if st == 429 and body.get("reason") == "shed":
+                    low_shed += 1
+                    if shed_engage_s is None and t_first_503:
+                        shed_engage_s = time.monotonic() - t_first_503
+                    break
+                time.sleep(0.1)
+            # the burst is exhausted (times=): the HIGH lane must serve
+            # 200s straight through the shed window
+            high_bad = 0
+            for _ in range(6):
+                st, _ = serve_bench.http_json(
+                    sbase + "/v1/kernels/mesh/infer", payload,
+                    headers={"X-HPNN-Priority": "high"})
+                if st != 200:
+                    high_bad += 1
+            # recover: burn clears as the windows slide; hysteresis
+            # holds clear_after_s, then the low lane re-admits
+            shed_recover_s = None
+            t_rec0 = time.monotonic()
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                st, body = serve_bench.http_json(
+                    sbase + "/v1/kernels/mesh/infer", payload,
+                    headers=low_h)
+                if st == 200:
+                    shed_recover_s = time.monotonic() - t_rec0
+                    break
+                if st == 429:
+                    low_shed += 1
+                time.sleep(0.2)
+            shed_snap = sapp.metrics.snapshot().get("shed") or {}
+            row["shed"] = {
+                "injected_503": saw_503,
+                "engage_s": (round(shed_engage_s, 3)
+                             if shed_engage_s else None),
+                "recover_s": (round(shed_recover_s, 3)
+                              if shed_recover_s else None),
+                "low_shed_429": low_shed,
+                "high_lane_non_200_during_shed": high_bad,
+                "engaged_total": shed_snap.get("engaged_total", 0),
+                "shed_total": shed_snap.get("shed_total", 0),
+            }
+        finally:
+            os.environ.pop("HPNN_FAULT", None)
+            if shed_proc is not None and shed_proc.poll() is None:
+                shed_proc.kill()
+            shttpd.shutdown()
+            sapp.close(drain=True)
+
+        # --- 8. elastic worker lifecycle (ISSUE 13) ----------------------
+        # the supervisor spawns its min-floor worker, a sustained
+        # backlog drives a scale-up to 2, and a quiet period retires
+        # one via drain-then-SIGTERM -- zero non-200 across the episode
+        prev_target = os.environ.get("HPNN_MESH_TARGET_DRAIN_S")
+        os.environ["HPNN_MESH_TARGET_DRAIN_S"] = "0.001"
+        aapp = ServeApp(**serve_kw)
+        aapp.enable_mesh_router(required_workers=1,
+                                health_interval_s=0.5)
+        assert aapp.add_model(conf) is not None
+        ahttpd, _ = serve_in_thread("127.0.0.1", 0, aapp)
+        aport = ahttpd.server_address[1]
+        abase = f"http://127.0.0.1:{aport}"
+        as_statuses: dict[str, int] = {}
+        as_lock = threading.Lock()
+        as_stop = threading.Event()
+        as_threads: list = []
+        try:
+            t0 = time.monotonic()
+            sup = aapp.enable_autoscale(
+                f"127.0.0.1:{aport}", [conf], min_workers=1,
+                max_workers=2, cooldown_s=1.0, poll_s=0.2,
+                worker_args=wargs)
+            first_worker_s = None
+            deadline = time.monotonic() + 240.0
+            while time.monotonic() < deadline:
+                if aapp.mesh_router.pool.live_count() >= 1:
+                    first_worker_s = time.monotonic() - t0
+                    break
+                time.sleep(0.1)
+            assert first_worker_s is not None, \
+                "autoscale min-floor worker never came up"
+            wait_healthz_ok(abase, timeout_s=60.0)
+
+            def as_hammer():
+                payload = {"inputs": inputs[:16].tolist(),
+                           "timeout_ms": 60000}
+                while not as_stop.is_set():
+                    try:
+                        st, _ = serve_bench.http_json(
+                            abase + "/v1/kernels/mesh/infer", payload,
+                            timeout_s=120.0)
+                    except Exception:
+                        st = -1
+                    with as_lock:
+                        as_statuses[str(st)] = \
+                            as_statuses.get(str(st), 0) + 1
+
+            as_threads = [threading.Thread(target=as_hammer,
+                                           daemon=True)
+                          for _ in range(12)]
+            t_load0 = time.monotonic()
+            for t in as_threads:
+                t.start()
+            scale_up_s = None
+            deadline = time.monotonic() + 300.0
+            while time.monotonic() < deadline:
+                if aapp.mesh_router.pool.live_count() >= 2:
+                    scale_up_s = time.monotonic() - t_load0
+                    break
+                time.sleep(0.2)
+            as_stop.set()
+            for t in as_threads:
+                t.join()
+            scale_down_s = None
+            t_quiet0 = time.monotonic()
+            deadline = time.monotonic() + 180.0
+            while time.monotonic() < deadline:
+                if (sup.retires_total >= 1
+                        and len(aapp.mesh_router.pool.table()) <= 1):
+                    scale_down_s = time.monotonic() - t_quiet0
+                    break
+                time.sleep(0.2)
+            as_non200 = sum(n for s, n in as_statuses.items()
+                            if s != "200")
+            row["autoscale"] = {
+                "first_worker_s": round(first_worker_s, 3),
+                "scale_up_s": (round(scale_up_s, 3)
+                               if scale_up_s else None),
+                "scale_down_s": (round(scale_down_s, 3)
+                                 if scale_down_s else None),
+                "statuses": as_statuses, "non_200": as_non200,
+                "spawns_total": sup.spawns_total,
+                "retires_total": sup.retires_total,
+            }
+        finally:
+            as_stop.set()
+            for t in as_threads:
+                if t.is_alive():
+                    t.join()
+            if prev_target is None:
+                os.environ.pop("HPNN_MESH_TARGET_DRAIN_S", None)
+            else:
+                os.environ["HPNN_MESH_TARGET_DRAIN_S"] = prev_target
+            ahttpd.shutdown()
+            aapp.close(drain=True)
+
         # --- floors ------------------------------------------------------
         if mesh1["statuses"] != {"200": args.requests}:
             failed.append(f"mesh_1w non-200s: {mesh1['statuses']}")
@@ -483,6 +697,30 @@ def main() -> int:
         if takeover_s is None or takeover_s > 20.0:
             failed.append(f"standby takeover took {takeover_s}s "
                           "(floor 20s)")
+        sh = row["shed"]
+        if sh["injected_503"] < n_burst:
+            failed.append(f"shed: chaos injected only "
+                          f"{sh['injected_503']}/{n_burst} 503s")
+        if sh["engage_s"] is None or sh["engage_s"] > 30.0:
+            failed.append(f"shed never engaged within 30s "
+                          f"({sh['engage_s']})")
+        if sh["high_lane_non_200_during_shed"] != 0:
+            failed.append(
+                f"shed hit the HIGH lane: "
+                f"{sh['high_lane_non_200_during_shed']} non-200s")
+        if sh["recover_s"] is None or sh["recover_s"] > 60.0:
+            failed.append(f"shed never recovered within 60s "
+                          f"({sh['recover_s']})")
+        asr = row["autoscale"]
+        if asr["scale_up_s"] is None or asr["scale_up_s"] > 300.0:
+            failed.append(f"backlog never drove a scale-up "
+                          f"({asr['scale_up_s']})")
+        if asr["scale_down_s"] is None or asr["scale_down_s"] > 180.0:
+            failed.append(f"quiet never drove a scale-down "
+                          f"({asr['scale_down_s']})")
+        if asr["non_200"] != 0:
+            failed.append(f"autoscale episode non-200s: "
+                          f"{asr['non_200']} ({asr['statuses']})")
     finally:
         for proc, _port in procs:
             if proc.poll() is None:

@@ -1,0 +1,492 @@
+"""Elastic worker lifecycle (ISSUE 13): the autoscale supervisor and
+the RETIRING pool state.
+
+Fast tier: retiring-state semantics in the worker pool (never picked,
+never health-promoted, heartbeat cannot resurrect, removal forgets),
+the worker agent's goodbye, and the supervisor's control loop driven
+deterministically through ``tick()`` with an injected spawner --
+spawn-toward-desired, min/max clamps, cooldown spacing, scale-down
+retire, dead-subprocess reaping, and the exec hook.
+
+Slow tier: the acceptance e2e -- a real router under sustained backlog
+drives the supervisor to SPAWN a second ``serve_nn`` worker
+subprocess, a quiet period RETIRES it (drain-then-SIGTERM), and every
+client response across the whole episode is a 200.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+import types
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import serve_bench  # noqa: E402
+
+from hpnn_tpu import obs  # noqa: E402
+from hpnn_tpu.serve.mesh.autoscale import (  # noqa: E402
+    WorkerSupervisor,
+    _Managed,
+)
+from hpnn_tpu.serve.mesh.router import (  # noqa: E402
+    STATE_LIVE,
+    STATE_RETIRING,
+    WorkerPool,
+)
+from hpnn_tpu.serve.server import ServeApp, serve_in_thread  # noqa: E402
+from hpnn_tpu.utils import nn_log  # noqa: E402
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+
+
+@pytest.fixture(autouse=True)
+def _quiet():
+    obs.disable()
+    nn_log.set_verbosity(0)
+    yield
+    obs.disable()
+    nn_log.set_verbosity(0)
+
+
+def _write_kernel_conf(tmp_path, name="tiny", seed=1234):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    return str(conf)
+
+
+# --- retiring state in the pool ---------------------------------------------
+
+def test_retiring_worker_is_never_picked_or_promoted():
+    pool = WorkerPool(eject_after=2)
+    try:
+        w1 = pool.register("127.0.0.1:9001")
+        pool.register("127.0.0.1:9002")
+        assert pool.retire("127.0.0.1:9001")
+        assert w1.state == STATE_RETIRING
+        # placement only ever lands on the survivor
+        for _ in range(8):
+            assert pool.pick("tiny", 4).addr == "127.0.0.1:9002"
+        # a healthy poll must NOT resurrect it (report_ok is the
+        # readmission path for dead/warming -- retiring is on purpose)
+        pool.report_ok(w1)
+        assert w1.state == STATE_RETIRING
+        # its heartbeat keeps arriving until SIGTERM: still retiring
+        pool.register("127.0.0.1:9001")
+        assert w1.state == STATE_RETIRING
+        # live_count / quorum math no longer counts it
+        assert pool.live_count() == 1
+        # removal forgets it (affinity entries included)
+        assert pool.remove("127.0.0.1:9001")
+        assert "127.0.0.1:9001" not in pool.table()
+        assert not pool.remove("127.0.0.1:9001")  # idempotent-ish
+        # a FRESH registration after removal starts over (restart)
+        w1b = pool.register("127.0.0.1:9001")
+        assert w1b.state == STATE_LIVE
+    finally:
+        pool.close()
+
+
+def test_retire_unknown_worker_is_false():
+    pool = WorkerPool(eject_after=2)
+    try:
+        assert not pool.retire("127.0.0.1:9999")
+    finally:
+        pool.close()
+
+
+def test_worker_goodbye_marks_retiring(tmp_path):
+    """POST /v1/mesh/register {"retiring": true} -- what
+    WorkerAgent.close() sends -- pulls the worker out of routing NOW."""
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.enable_mesh_router(required_workers=1, health_interval_s=3600)
+    assert app.add_model(conf) is not None
+    try:
+        app.handle_mesh_register(
+            json.dumps({"addr": "127.0.0.1:9010"}).encode())
+        out = app.handle_mesh_register(
+            json.dumps({"addr": "127.0.0.1:9010",
+                        "retiring": True}).encode())
+        assert out == {"ok": True, "retiring": True, "known": True}
+        tbl = app.mesh_router.pool.table()
+        assert tbl["127.0.0.1:9010"]["state"] == STATE_RETIRING
+        # a goodbye from a worker we never knew is acknowledged too
+        out = app.handle_mesh_register(
+            json.dumps({"addr": "127.0.0.1:9011",
+                        "retiring": True}).encode())
+        assert out["known"] is False
+    finally:
+        app.close(drain=True)
+
+
+def test_worker_agent_close_sends_goodbye(tmp_path):
+    from hpnn_tpu.serve.mesh.worker import WorkerAgent
+
+    conf = _write_kernel_conf(tmp_path)
+    rapp = ServeApp(max_batch=8)
+    rapp.enable_mesh_router(required_workers=1, health_interval_s=3600)
+    assert rapp.add_model(conf) is not None
+    rhttpd, _ = serve_in_thread("127.0.0.1", 0, rapp)
+    rport = rhttpd.server_address[1]
+    wapp = ServeApp(max_batch=8)
+    assert wapp.add_model(conf, warmup=False) is not None
+    try:
+        agent = WorkerAgent(wapp, f"127.0.0.1:{rport}",
+                            "127.0.0.1:9020", interval_s=3600)
+        assert agent.beat()
+        tbl = rapp.mesh_router.pool.table()
+        assert tbl["127.0.0.1:9020"]["state"] == STATE_LIVE
+        agent.close()
+        tbl = rapp.mesh_router.pool.table()
+        assert tbl["127.0.0.1:9020"]["state"] == STATE_RETIRING
+        agent.close()  # idempotent: one goodbye, no error
+    finally:
+        rhttpd.shutdown()
+        rapp.close(drain=True)
+        wapp.close(drain=True)
+
+
+# --- the supervisor control loop (injected spawner) -------------------------
+
+class _FakeApp:
+    """Just enough app for WorkerSupervisor: a real pool + a scripted
+    desired-workers signal."""
+
+    def __init__(self):
+        self.pool = WorkerPool(eject_after=2)
+        self.mesh_router = types.SimpleNamespace(pool=self.pool)
+        self.desired = 1
+
+    def autoscale_snapshot(self):
+        return {"queued_rows": 0, "drain_rows_per_s": 0.0,
+                "live_workers": self.pool.live_count(),
+                "desired_workers": self.desired}
+
+    def close(self):
+        self.pool.close()
+
+
+def _fake_spawner(counter=[0]):
+    """Injected spawn_fn: 'starts a worker' by registering it in the
+    pool (what the real worker's heartbeat does) -- no subprocess."""
+
+    def spawn(sup):
+        counter[0] += 1
+        port = 9100 + counter[0]
+        addr = f"127.0.0.1:{port}"
+        sup.pool.register(addr)
+        return _Managed(None, addr, port)
+
+    return spawn
+
+
+def _mk_supervisor(app, **kw):
+    kw.setdefault("min_workers", 1)
+    kw.setdefault("max_workers", 2)
+    kw.setdefault("cooldown_s", 0.0)
+    kw.setdefault("poll_s", 3600.0)
+    kw.setdefault("drain_s", 1.0)
+    kw.setdefault("spawn_fn", _fake_spawner())
+    return WorkerSupervisor(app, "127.0.0.1:1", [], **kw)
+
+
+def test_supervisor_spawns_toward_desired_and_clamps():
+    app = _FakeApp()
+    sup = _mk_supervisor(app)
+    try:
+        # min floor: nothing running -> spawn toward min=1
+        assert sup.tick() == "spawn"
+        assert sup.routable_count() == 1
+        assert sup.tick() is None  # at desired: steady state
+        # backlog: desired 5 clamps to max=2 -> ONE spawn per tick
+        app.desired = 5
+        assert sup.tick() == "spawn"
+        assert sup.routable_count() == 2
+        assert sup.tick() is None  # clamped at max, never a third
+        assert sup.spawns_total == 2
+        snap = sup.snapshot()
+        assert snap["managed"] == 2
+        assert snap["spawns_total"] == 2
+    finally:
+        sup.close(retire_managed=False)
+        app.close()
+
+
+def test_supervisor_cooldown_spaces_actions():
+    app = _FakeApp()
+    sup = _mk_supervisor(app, cooldown_s=30.0)
+    app.desired = 2
+    try:
+        assert sup.tick() == "spawn"
+        # still below desired, but inside the cooldown: no action
+        assert sup.tick() is None
+        assert sup.spawns_total == 1
+        sup._last_action = time.monotonic() - 31.0  # cooldown elapsed
+        assert sup.tick() == "spawn"
+        assert sup.spawns_total == 2
+    finally:
+        sup.close(retire_managed=False)
+        app.close()
+
+
+def test_supervisor_retires_youngest_down_to_min():
+    app = _FakeApp()
+    sup = _mk_supervisor(app)
+    app.desired = 2
+    try:
+        assert sup.tick() == "spawn"
+        assert sup.tick() == "spawn"
+        newest = sup._managed[-1].addr
+        # quiet: desired falls to 1 -> retire the youngest managed
+        app.desired = 1
+        assert sup.tick() == "retire"
+        assert sup.retires_total == 1
+        assert newest not in app.pool.table()  # drained AND removed
+        assert sup.routable_count() == 1
+        # min floor: desired 0 clamps to min=1 -> never retires the last
+        app.desired = 0
+        assert sup.tick() is None
+        assert sup.routable_count() == 1
+    finally:
+        sup.close(retire_managed=False)
+        app.close()
+
+
+def test_supervisor_reaps_dead_managed_worker():
+    app = _FakeApp()
+    sup = _mk_supervisor(app)
+    try:
+        assert sup.tick() == "spawn"
+        addr = sup._managed[0].addr
+        # the subprocess died behind our back (crash / external kill)
+        sup._managed[0].proc = types.SimpleNamespace(
+            poll=lambda: 1, returncode=1)
+        sup._reap()
+        assert sup._managed == []
+        assert addr not in app.pool.table()
+        # the next tick replaces it (still below min)
+        assert sup.tick() == "spawn"
+    finally:
+        sup.close(retire_managed=False)
+        app.close()
+
+
+def test_supervisor_exec_hook_replaces_subprocess(tmp_path,
+                                                  monkeypatch):
+    log = tmp_path / "hook.log"
+    hook = (f'echo "$HPNN_AUTOSCALE_ACTION desired='
+            f'$HPNN_AUTOSCALE_DESIRED worker=$HPNN_AUTOSCALE_WORKER"'
+            f' >> {log}')
+    app = _FakeApp()
+    sup = WorkerSupervisor(app, "127.0.0.1:1", [], min_workers=0,
+                           max_workers=4, cooldown_s=0.0,
+                           poll_s=3600.0, exec_hook=hook)
+    try:
+        app.desired = 2
+        assert sup.tick() == "spawn"
+        assert sup.spawns_total == 1
+        assert sup.snapshot()["managed"] == 0  # the hook owns procs
+        # scale-down: an externally-registered worker is the victim --
+        # the pool stops routing to it, the hook does the rest
+        app.pool.register("127.0.0.1:9201")
+        app.pool.register("127.0.0.1:9202")
+        app.desired = 1
+        assert sup.tick() == "retire"
+        lines = log.read_text().splitlines()
+        assert lines[0].startswith("spawn desired=2")
+        assert lines[1].startswith("retire desired=1 worker=127.0.0.1:")
+        victim = lines[1].split("worker=")[1]
+        assert app.pool.table()[victim]["state"] == STATE_RETIRING
+    finally:
+        sup.close(retire_managed=False)
+        app.close()
+
+
+# --- acceptance e2e (slow): real subprocesses -------------------------------
+
+@pytest.mark.slow
+def test_autoscale_e2e_backlog_spawns_quiet_retires_zero_non200(
+        tmp_path, monkeypatch):
+    """Acceptance: sustained backlog drives the supervisor to spawn a
+    second real worker; a quiet period retires one via
+    drain-then-SIGTERM; ZERO non-200 responses across the episode."""
+    import mesh_bench
+
+    # an aggressive drain target so a modest backlog asks for 2
+    # workers: the tiny CPU kernel drains tens of thousands of rows/s,
+    # so at the default 1 s target no realistic client pool could ever
+    # queue enough to need a second worker
+    monkeypatch.setenv("HPNN_MESH_TARGET_DRAIN_S", "0.001")
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=16, max_queue_rows=4096)
+    app.enable_mesh_router(required_workers=1, health_interval_s=0.3)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    rport = httpd.server_address[1]
+    base = f"http://127.0.0.1:{rport}"
+    sup = app.enable_autoscale(
+        f"127.0.0.1:{rport}", [conf], min_workers=1, max_workers=2,
+        cooldown_s=1.0, poll_s=0.2,
+        worker_args=("-b", "16", "-q", "4096"))
+    statuses: dict = {}
+    stats_mu = threading.Lock()
+    stop = threading.Event()
+    xs = np.random.default_rng(5).uniform(-1, 1, (16, N_IN)).tolist()
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                st, _ = serve_bench.http_json(
+                    base + "/v1/kernels/tiny/infer", {"inputs": xs},
+                    timeout_s=120.0)
+            except Exception:
+                st = -1
+            with stats_mu:
+                statuses[st] = statuses.get(st, 0) + 1
+
+    threads = []
+    try:
+        # min floor: the supervisor spawns worker #1 by itself
+        deadline = time.monotonic() + 240
+        while (app.mesh_router.pool.live_count() < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.1)
+        assert app.mesh_router.pool.live_count() >= 1, \
+            "min-floor worker never spawned"
+        mesh_bench.wait_healthz_ok(base, timeout_s=60.0)
+        # sustained backlog: desired climbs past 1 -> scale up
+        threads = [threading.Thread(target=hammer) for _ in range(12)]
+        for t in threads:
+            t.start()
+        deadline = time.monotonic() + 300
+        while (sup.spawns_total < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        assert sup.spawns_total >= 2, (
+            f"backlog never drove a scale-up: "
+            f"{app.autoscale_snapshot()}")
+        deadline = time.monotonic() + 120
+        while (app.mesh_router.pool.live_count() < 2
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        assert app.mesh_router.pool.live_count() == 2
+        # quiet: stop the load; desired falls back to 1 -> retire one
+        stop.set()
+        for t in threads:
+            t.join()
+        deadline = time.monotonic() + 120
+        while (sup.retires_total < 1
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        assert sup.retires_total >= 1, "quiet never drove a scale-down"
+        deadline = time.monotonic() + 60
+        while (len(app.mesh_router.pool.table()) > 1
+               and time.monotonic() < deadline):
+            time.sleep(0.2)
+        assert len(app.mesh_router.pool.table()) == 1
+        assert app.mesh_router.pool.live_count() == 1
+        # the whole episode -- spawn, rebalance, drain, SIGTERM --
+        # dropped NOTHING
+        with stats_mu:
+            assert set(statuses) == {200}, statuses
+        snap = app.metrics.snapshot()["autoscale"]["supervisor"]
+        assert snap["spawns_total"] >= 2
+        assert snap["retires_total"] >= 1
+        text = app.metrics.render_prometheus()
+        assert "hpnn_autoscale_managed_workers 1" in text
+        from test_obs import lint_prometheus
+
+        lint_prometheus(text)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- review hardening: retirement grace window ------------------------------
+
+def test_retirement_grace_reregistration_promotes():
+    """Inside the grace window a registration is the dying process's
+    heartbeat (stays retiring); after it, the process evidently
+    RESTARTED and wants back in -- without the window one goodbye
+    would brick the addr forever."""
+    pool = WorkerPool(eject_after=2)
+    pool.retire_grace_s = 0.2
+    try:
+        w = pool.register("127.0.0.1:9301")
+        pool.retire("127.0.0.1:9301", via="goodbye")
+        pool.register("127.0.0.1:9301")  # in-window heartbeat
+        assert w.state == STATE_RETIRING
+        time.sleep(0.25)
+        pool.register("127.0.0.1:9301")  # post-window: a restart
+        assert w.state == STATE_LIVE
+    finally:
+        pool.close()
+
+
+def test_health_loop_reaps_retiring_corpse():
+    """An exec-hook retire has no subprocess to reap: once the
+    worker's heartbeats have been silent a full grace window, the
+    health loop forgets the table entry."""
+    pool = WorkerPool(eject_after=2)
+    pool.retire_grace_s = 0.15
+    try:
+        pool.register("127.0.0.1:9302")
+        pool.retire("127.0.0.1:9302")
+        pool.check_health_once()  # inside the window: kept
+        assert "127.0.0.1:9302" in pool.table()
+        time.sleep(0.2)
+        pool.check_health_once()
+        assert "127.0.0.1:9302" not in pool.table()
+    finally:
+        pool.close()
+
+
+def test_exec_hook_failure_unretires_victim(tmp_path):
+    """A failed retire hook must put the healthy victim straight back
+    into routing, not strand it retiring."""
+    app = _FakeApp()
+    sup = WorkerSupervisor(app, "127.0.0.1:1", [], min_workers=0,
+                           max_workers=4, cooldown_s=0.0,
+                           poll_s=3600.0, exec_hook="exit 3")
+    try:
+        app.pool.register("127.0.0.1:9303")
+        app.pool.register("127.0.0.1:9304")
+        app.desired = 1
+        assert sup.tick() is None  # the hook failed: no action taken
+        assert sup.retires_total == 0
+        states = {a: w["state"] for a, w in app.pool.table().items()}
+        assert set(states.values()) == {STATE_LIVE}, states
+    finally:
+        sup.close(retire_managed=False)
+        app.close()
+
+
+def test_spawned_worker_env_carries_auth_token(tmp_path):
+    """An auth-enabled router's spawned workers must be able to
+    register: enable_autoscale threads the token through the
+    subprocess ENVIRONMENT (never argv)."""
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8, auth_token="sekrit")
+    app.enable_mesh_router(required_workers=1, health_interval_s=3600)
+    assert app.add_model(conf) is not None
+    try:
+        sup = app.enable_autoscale("127.0.0.1:1", [conf], start=False)
+        assert sup.extra_env == {"HPNN_SERVE_TOKEN": "sekrit"}
+    finally:
+        app.close(drain=True)

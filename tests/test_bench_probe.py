@@ -195,3 +195,49 @@ def test_committed_dp_epoch_bench_rows_hold_floors():
         <= on["opt_state_replicated_bytes"] // n \
         + floors["opt_state_shard_slack_bytes"]
     assert on["mode"] == "dp-resident"
+
+
+def _load_artifact(name):
+    import json
+    import os
+
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), name)
+    with open(path) as fp:
+        return json.load(fp)
+
+
+def test_committed_obs_bench_sampled_row_holds_floors():
+    """The committed OBS_BENCH.json sampled-tracing row (ISSUE 13)
+    stays pinned in tier 1: the --trace-sample 0.01 round held the
+    overhead ceiling, really dropped traces, and the forced trace
+    still merged."""
+    art = _load_artifact("OBS_BENCH.json")
+    assert art["floors_failed"] == []
+    s = art["sampled"]
+    assert s["trace_sample"] == 0.01
+    ceiling = (art["off"]["p50_ms"] * 1.75) + 25.0
+    assert s["p50_ms"] <= ceiling
+    assert s["merged_tree_ok"] is True
+    assert s["sampling"]["dropped_total"] > 0
+    assert set(s["statuses"]) == {"200"}
+
+
+def test_committed_mesh_bench_shed_and_autoscale_rows_hold_floors():
+    """The committed MESH_BENCH.json shed + autoscale rows (ISSUE 13)
+    stay pinned in tier 1: the chaos 5xx burst engaged and recovered
+    shedding without touching the high lane, and the scale-up /
+    scale-down episode dropped nothing."""
+    art = _load_artifact("MESH_BENCH.json")
+    assert art["floors_failed"] == []
+    sh = art["shed"]
+    assert sh["engage_s"] is not None and sh["engage_s"] <= 30.0
+    assert sh["recover_s"] is not None and sh["recover_s"] <= 60.0
+    assert sh["high_lane_non_200_during_shed"] == 0
+    assert sh["low_shed_429"] >= 1
+    asr = art["autoscale"]
+    assert asr["scale_up_s"] is not None
+    assert asr["scale_down_s"] is not None
+    assert asr["non_200"] == 0
+    assert asr["spawns_total"] >= 2
+    assert asr["retires_total"] >= 1

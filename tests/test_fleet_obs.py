@@ -52,12 +52,17 @@ N_IN, N_HID, N_OUT = 8, 6, 3
 
 @pytest.fixture(autouse=True)
 def _obs_reset():
-    """Tracing off, role cleared, verbosity 0 around every test."""
+    """Tracing off, role cleared, no sampler/exporter, verbosity 0
+    around every test."""
     obs.disable()
     obs_trace.set_role(None)
+    obs_trace.set_sample_rate(None)
+    obs_trace.set_exporter(None)
     nn_log.set_verbosity(0)
     yield
     obs.disable()
+    obs_trace.set_sample_rate(None)
+    obs_trace.set_exporter(None)
     obs_trace.set_role(None)
     nn_log.set_verbosity(0)
 
@@ -815,3 +820,366 @@ def test_merged_cross_host_trace_e2e_with_worker_kill(tmp_path,
                 proc.kill()
         rhttpd.shutdown()
         rapp.close(drain=True)
+
+
+# --- truncation markers (ISSUE 13 satellite) --------------------------------
+
+def test_store_eviction_emits_truncation_marker():
+    """A per-worker store past capacity EVICTS -- and the merged view
+    says so explicitly instead of silently narrowing the window."""
+    from hpnn_tpu.serve.mesh.fleet import FleetObserver
+
+    cfg, httpd, addr = _stub_worker(
+        spans=[_mk_span(i) for i in range(1, 101)])
+    pool = _pool_with_stub(addr)
+    fleet = FleetObserver(pool, poll_interval_s=3600, capacity=64)
+    try:
+        assert fleet.drain_once() == 100
+        merged = fleet.merged_spans(drain=False)
+        marker = merged[-1]
+        assert marker["name"] == "trace.truncated"
+        assert marker["dropped_spans"] == 36
+        assert marker["dropped_store"] == 36
+        assert marker["dropped_by_host"] == {addr: 36}
+        assert marker["role"] == "router"
+        # the marker sorts last (anchored to the newest retained ts)
+        assert marker["ts"] == merged[-2]["ts"]
+        assert fleet.stats()["spans_evicted_total"] == 36
+        # and it rides the NDJSON dump
+        assert '"trace.truncated"' in fleet.merged_dump()
+    finally:
+        httpd.shutdown()
+        pool.close()
+
+
+def test_limit_cut_emits_truncation_marker():
+    from hpnn_tpu.serve.mesh.fleet import FleetObserver
+
+    cfg, httpd, addr = _stub_worker(
+        spans=[_mk_span(i) for i in range(1, 11)])
+    pool = _pool_with_stub(addr)
+    fleet = FleetObserver(pool, poll_interval_s=3600, capacity=64)
+    try:
+        fleet.drain_once()
+        merged = fleet.merged_spans(drain=False, limit=4)
+        assert len(merged) == 5  # 4 spans + the marker
+        marker = merged[-1]
+        assert marker["name"] == "trace.truncated"
+        assert marker["dropped_limit"] == 6
+        assert marker["dropped_spans"] == 6
+        # no drops, no marker: the full view stays marker-free
+        full = fleet.merged_spans(drain=False)
+        assert all(s["name"] != "trace.truncated" for s in full)
+    finally:
+        httpd.shutdown()
+        pool.close()
+
+
+# --- SLO-driven load shedding (ISSUE 13 tentpole) ---------------------------
+
+def _shed_app(tmp_path, conf=None):
+    """An app with a fast-clearing shedder and second-scale SLO
+    windows (the production defaults are minutes)."""
+    conf = conf or _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8, slo_availability=0.9, shed_low=True)
+    app.slo.fast_s = 0.4
+    app.slo.slow_s = 0.8
+    app.slo.burn_threshold = 2.0
+    app.slo.eval_interval_s = 0.0  # per-record evaluation
+    app.shedder.clear_after_s = 0.5
+    app.shedder._eval_every = 0.01
+    assert app.add_model(conf, warmup=False) is not None
+    return app
+
+
+class _DeadBackend:
+    def pipeline_depth(self):
+        return 1
+
+    def dispatch(self, *a, **k):
+        raise RuntimeError("injected device failure")
+
+    def collect(self, handle):  # pragma: no cover
+        raise RuntimeError("unreachable")
+
+
+def test_shed_low_lane_only_with_hysteresis(tmp_path):
+    """Acceptance: a 5xx burst trips slo_burn and sheds ONLY the low
+    lane (high/normal keep serving); shedding clears with hysteresis
+    once the burn is out."""
+    app = _shed_app(tmp_path)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    xs = {"inputs": np.zeros((2, N_IN)).tolist()}
+    low = {"X-HPNN-Priority": "low"}
+    try:
+        # healthy: the low lane is served normally
+        st, _ = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 200
+        # server-caused 5xx burst (backend dies at dispatch)
+        b = app.batchers["tiny"]
+        orig = b.backend
+        b.backend = _DeadBackend()
+        for _ in range(6):
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs)
+            assert st == 500
+        b.backend = orig
+        assert app.slo.any_burning()
+        # low lane: shed with an honest Retry-After; the shed 429 is a
+        # 4xx -- it must NOT spend availability budget itself
+        st, body, hdrs = _get_json_h(
+            base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 429 and body["reason"] == "shed"
+        assert float(hdrs["Retry-After"]) >= 1.0
+        # high and normal lanes keep serving THROUGH the burn
+        st, _ = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs,
+            headers={"X-HPNN-Priority": "high"})
+        assert st == 200
+        st, _ = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs)
+        assert st == 200
+        snap = app.metrics.snapshot()
+        assert snap["shed"]["active"] is True
+        assert snap["shed"]["shed_total"] >= 1
+        assert snap["shed"]["engaged_total"] == 1
+        text = app.metrics.render_prometheus()
+        lint_prometheus(text)
+        assert "hpnn_shed_active 1" in text
+        assert 'hpnn_serve_requests_total{outcome="shed"}' in text
+        # hysteresis: the windows slide past the burst, then the gate
+        # needs clear_after_s of quiet before re-admitting
+        deadline = time.monotonic() + 15
+        st = 429
+        while st == 429 and time.monotonic() < deadline:
+            time.sleep(0.1)
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 200, "shedding never cleared"
+        assert app.metrics.snapshot()["shed"]["active"] is False
+        assert "hpnn_shed_active 0" in app.metrics.render_prometheus()
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def _get_json_h(url, payload=None, headers=None):
+    import urllib.error
+    import urllib.request
+
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(url, data=data, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return (resp.status, json.loads(resp.read().decode()),
+                    dict(resp.headers))
+    except urllib.error.HTTPError as exc:
+        return (exc.code, json.loads(exc.read().decode()),
+                dict(exc.headers))
+
+
+def test_shed_off_without_flag_even_when_burning(tmp_path):
+    """--slo-* alone keeps the PR-10 behavior: gauges + events, no
+    actuation -- shedding is an explicit opt-in."""
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=8, slo_availability=0.9)
+    app.slo.fast_s = app.slo.slow_s = 10.0
+    app.slo.burn_threshold = 1.0
+    app.slo.eval_interval_s = 0.0
+    assert app.add_model(conf, warmup=False) is not None
+    try:
+        assert app.shedder is None
+        for _ in range(4):
+            app.slo.record_outcome("tiny", False)
+        assert app.slo.any_burning()
+        out = app.handle_infer("tiny", json.dumps(
+            {"inputs": np.zeros((1, N_IN)).tolist()}).encode(),
+            headers={"X-HPNN-Priority": "low"})
+        assert out["kernel"] == "tiny"  # low lane still served
+        assert "shed" not in app.metrics.snapshot()
+        assert "hpnn_shed_active" not in app.metrics.render_prometheus()
+    finally:
+        app.close(drain=True)
+
+
+@pytest.mark.slow
+def test_shed_under_server_chaos_burst_e2e(tmp_path, monkeypatch):
+    """The chaos version (ISSUE 13): a subprocess worker armed with
+    HPNN_FAULT side=server fabricates a 5xx burst; the ROUTER's SLO
+    burns, sheds ONLY its low lane, and recovers with hysteresis."""
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=16, max_queue_rows=512,
+                   slo_availability=0.9, shed_low=True)
+    app.slo.fast_s = 1.0
+    app.slo.slow_s = 2.0
+    app.slo.burn_threshold = 2.0
+    app.slo.eval_interval_s = 0.0
+    app.shedder.clear_after_s = 1.0
+    app.shedder._eval_every = 0.05
+    app.enable_mesh_router(required_workers=1, health_interval_s=0.2)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    rport = httpd.server_address[1]
+    base = f"http://127.0.0.1:{rport}"
+    # the fault spec rides the ENVIRONMENT into the worker subprocess
+    # only -- this process never arms it (env restored before any
+    # local request runs chaos.pick)
+    monkeypatch.setenv(
+        "HPNN_FAULT",
+        "http@/v1/kernels/tiny/infer:side=server,every=1,times=8,"
+        "code=503")
+    proc = port = None
+    try:
+        proc, port = mesh_bench.spawn_worker(conf, f"127.0.0.1:{rport}")
+        monkeypatch.delenv("HPNN_FAULT")
+        mesh_bench.wait_healthz_ok(base, timeout_s=120.0)
+        xs = {"inputs": np.zeros((2, N_IN)).tolist()}
+        low = {"X-HPNN-Priority": "low"}
+        # the burst: the worker's OWN response path fabricates 503s --
+        # the router sees real server-caused failures and its SLO burns
+        saw_503 = 0
+        for _ in range(10):
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs)
+            if st == 503:
+                saw_503 += 1
+        assert saw_503 >= 6, f"chaos burst never landed ({saw_503})"
+        assert app.slo.any_burning()
+        # low lane shed at the router's admission; normal lane serves
+        # (the worker's fault schedule is exhausted: times=8)
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 429 and body["reason"] == "shed"
+        st, _ = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs)
+        assert st == 200
+        # recovery: burn clears as the windows slide, hysteresis holds
+        # the gate for clear_after_s, then the low lane re-admits
+        deadline = time.monotonic() + 30
+        st = 429
+        while st == 429 and time.monotonic() < deadline:
+            time.sleep(0.2)
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 200, "shed never recovered after the chaos burst"
+        assert app.metrics.snapshot()["shed"]["engaged_total"] >= 1
+    finally:
+        if proc is not None and proc.poll() is None:
+            proc.kill()
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- durable spool survives router SIGKILL (ISSUE 13 acceptance) ------------
+
+@pytest.mark.slow
+def test_sampled_trace_survives_router_sigkill_via_spool(tmp_path,
+                                                         monkeypatch):
+    """Acceptance: with --trace-sample 0.01, a sampled (forced) trace's
+    complete merged tree is readable from the DURABLE spool after the
+    router is SIGKILLed -- the ring died with the process, the
+    segments did not."""
+    from hpnn_tpu.obs.export import read_spool
+
+    conf = _write_kernel_conf(tmp_path)
+    spool = str(tmp_path / "spool")
+    rproc = wproc = None
+    try:
+        # the router is a SUBPROCESS (we are going to kill -9 it);
+        # fast segment age so spans become durable quickly.  The
+        # sampling coin is SEEDED (the documented test hook): seed 2's
+        # first 16 draws all exceed 0.01, so the 8 unforced requests
+        # below are deterministically dropped
+        monkeypatch.setenv("HPNN_SPAN_SEGMENT_AGE_S", "0.3")
+        monkeypatch.setenv("HPNN_FLEET_POLL_S", "0.3")
+        monkeypatch.setenv("HPNN_TRACE_SAMPLE_SEED", "2")
+        rproc, rport = mesh_bench.spawn_worker(
+            conf, None,
+            ("--mesh-role", "router", "--workers", "1", "--trace",
+             "--trace-sample", "0.01", "--span-dir", spool))
+        # the worker shares the sampling config (fleet-consistent):
+        # its unforced RPCs drop too; the head's kept trace id rides
+        # the RPC header and FORCES capture worker-side
+        wproc, _wport = mesh_bench.spawn_worker(
+            conf, f"127.0.0.1:{rport}",
+            ("--trace", "--trace-sample", "0.01"))
+        base = f"http://127.0.0.1:{rport}"
+        mesh_bench.wait_healthz_ok(base, timeout_s=120.0)
+        xs = {"inputs": np.zeros((3, N_IN)).tolist()}
+        # unforced traffic: sampled out at p=0.01 (no trace id minted)
+        for _ in range(8):
+            st, body = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs)
+            assert st == 200
+            assert "trace" not in body
+        # ONE forced capture: this is the trace that must survive
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs,
+            headers={"X-HPNN-Trace-Id": "survivor01"})
+        assert st == 200 and body["trace"] == "survivor01"
+        # wait until the spool holds BOTH halves: the router's own
+        # spans and the worker spans its collector drained (the
+        # exporter is offered both)
+        deadline = time.monotonic() + 60
+        names = set()
+        while time.monotonic() < deadline:
+            spans = read_spool(spool, trace_id="survivor01")
+            names = {(s["name"], s.get("role", "router"))
+                     for s in spans}
+            if (("serve.request", "router") in names
+                    and ("device_launch", "worker") in names):
+                break
+            time.sleep(0.25)
+        assert ("serve.request", "router") in names, names
+        assert ("mesh.route", "router") in names, names
+        assert ("device_launch", "worker") in names, names
+        rproc.send_signal(signal.SIGKILL)
+        rproc.wait(timeout=10)
+        # the process is GONE; the durable spool still answers with
+        # the complete merged tree
+        spans = read_spool(spool, trace_id="survivor01")
+        names = {(s["name"], s.get("role", "router")) for s in spans}
+        assert ("serve.request", "router") in names
+        assert ("mesh.route", "router") in names
+        assert ("device_launch", "worker") in names
+        # and the head decision really dropped the unforced traffic:
+        # no OTHER serve.request trees were spooled
+        reqs = {s["trace"] for s in read_spool(spool)
+                if s["name"] == "serve.request"}
+        assert reqs == {"survivor01"}
+    finally:
+        for p in (rproc, wproc):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+
+def test_removed_worker_prunes_collector_state():
+    """pool.remove() (autoscale churn) takes the FleetObserver's
+    per-addr store with it -- merely-DEAD workers keep their retained
+    window (that is the feature), removed ones must not leak a span
+    ring per corpse."""
+    from hpnn_tpu.serve.mesh.fleet import FleetObserver
+
+    cfg, httpd, addr = _stub_worker(spans=[_mk_span(1), _mk_span(2)])
+    pool = _pool_with_stub(addr)
+    fleet = FleetObserver(pool, poll_interval_s=3600, capacity=64)
+    try:
+        fleet.drain_once()
+        assert fleet.stats()["workers_tracked"] == 1
+        # dead (ejected): retained -- the post-mortem window
+        w = pool.workers()[0]
+        pool.report_failure(w, ConnectionRefusedError("gone"))
+        fleet.drain_once()
+        assert fleet.stats()["workers_tracked"] == 1
+        assert fleet.collected_spans()
+        # removed (scaled down on purpose): forgotten
+        pool.remove(addr)
+        fleet.drain_once()
+        assert fleet.stats()["workers_tracked"] == 0
+        assert fleet.collected_spans() == []
+        assert fleet._cursors == {} and fleet._rings == {}
+    finally:
+        httpd.shutdown()
+        pool.close()

@@ -795,3 +795,148 @@ def test_multipart_corpus_upload_trains(tmp_path):
     finally:
         httpd.shutdown()
         app.close(drain=True)
+
+
+# --- eval-driven auto-promotion (ISSUE 13 satellite / ROADMAP 2c) -----------
+
+def _wait_auto_promote(base, jid, timeout_s=60.0):
+    """The decision lands AFTER the job's terminal update: poll for the
+    record itself."""
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+        if snap.get("auto_promote") is not None:
+            return snap
+        time.sleep(0.05)
+    raise AssertionError(f"no auto_promote record on {jid}: {snap}")
+
+
+def test_auto_promote_decides_from_test_dir_error(tmp_path):
+    """--auto-promote: a finished job's candidate generation is
+    evaluated against the pre-job baseline on the held-out test dir,
+    THROUGH the serving path; the decision record carries both errors
+    and the A/B generation counters as canary evidence, and the
+    action matches the comparison."""
+    rng = np.random.default_rng(11)
+    corpus = tmp_path / "corpus"
+    tests = tmp_path / "tests"
+    _write_corpus(str(corpus), rng, N_SAMP)
+    _write_corpus(str(tests), np.random.default_rng(12), 6)
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1,
+                    auto_promote=True)
+    assert app.jobs.auto_promote is True
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        # ckpt_every=0: final-swap-only, so the pre-job baseline
+        # generation survives gen_keep for the before/after comparison
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"samples": str(corpus), "test_samples": str(tests),
+             "epochs": 6, "seed": 3, "train": "BP", "ckpt_every": 0})
+        assert st == 202, job
+        snap = _wait_terminal(base, job["job_id"])
+        assert snap["status"] == "done", snap
+        assert snap["baseline_generation"] == 1
+        snap = _wait_auto_promote(base, job["job_id"])
+        rec = snap["auto_promote"]
+        assert rec["action"] in ("auto_promoted", "auto_rolled_back")
+        assert snap["finalized"] == rec["action"]
+        assert rec["baseline"] == 1
+        assert rec["candidate"] in snap["generations"]
+        assert rec["test_rows"] == 6
+        # the decision MATCHES the measured errors
+        if rec["candidate_err"] <= rec["baseline_err"]:
+            assert rec["action"] == "auto_promoted"
+        else:
+            assert rec["action"] == "auto_rolled_back"
+        # canary evidence: both generations really served the eval
+        # traffic through the batcher (the existing A/B counters)
+        assert rec["canary_requests"][str(rec["candidate"])] >= 1
+        assert rec["canary_requests"][str(rec["baseline"])] >= 1
+        model = app.registry.get("tiny")
+        table = model.generation_table()
+        assert table["ab_window"] is None  # finalized either way
+        if rec["action"] == "auto_rolled_back":
+            # a rollback is itself a generation bump past the candidate
+            assert table["current"] > rec["candidate"]
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_auto_promote_skips_without_test_dir(tmp_path):
+    rng = np.random.default_rng(13)
+    corpus = tmp_path / "corpus"
+    _write_corpus(str(corpus), rng, N_SAMP)
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1,
+                    auto_promote=True)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"samples": str(corpus), "epochs": 1, "seed": 3,
+             "ckpt_every": 0})
+        assert st == 202
+        snap = _wait_terminal(base, job["job_id"])
+        assert snap["status"] == "done", snap
+        snap = _wait_auto_promote(base, job["job_id"])
+        rec = snap["auto_promote"]
+        assert rec["action"] == "skipped"
+        assert "test dir" in rec["reason"]
+        assert snap["finalized"] is None  # nothing was decided
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_auto_promote_off_by_default(tmp_path):
+    rng = np.random.default_rng(14)
+    corpus = tmp_path / "corpus"
+    _write_corpus(str(corpus), rng, N_SAMP)
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        assert app.jobs.auto_promote is False
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"samples": str(corpus), "epochs": 1, "seed": 3})
+        assert st == 202
+        snap = _wait_terminal(base, job["job_id"])
+        assert snap["status"] == "done"
+        time.sleep(0.3)
+        _, snap = serve_bench.http_json(
+            base + f"/v1/jobs/{job['job_id']}")
+        assert snap["auto_promote"] is None
+        assert snap["baseline_generation"] is None
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_submit_validates_test_samples_dir(tmp_path):
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=1,
+                            auto_promote=True)
+    try:
+        from hpnn_tpu.jobs.scheduler import JobError
+
+        with pytest.raises(JobError, match="test_samples"):
+            sched.submit("tiny", {"samples": str(tmp_path),
+                                  "test_samples":
+                                  str(tmp_path / "nope")})
+    finally:
+        app.close(drain=True)

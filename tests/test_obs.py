@@ -43,11 +43,16 @@ N_SAMP = 9
 
 @pytest.fixture(autouse=True)
 def _obs_reset():
-    """Every test starts and ends with tracing OFF and verbosity 0."""
+    """Every test starts and ends with tracing OFF, no sampler, no
+    exporter, and verbosity 0."""
     obs.disable()
+    obs_trace.set_sample_rate(None)
+    obs_trace.set_exporter(None)
     nn_log.set_verbosity(0)
     yield
     obs.disable()
+    obs_trace.set_sample_rate(None)
+    obs_trace.set_exporter(None)
     nn_log.set_verbosity(0)
 
 
@@ -809,3 +814,241 @@ def test_profile_capture_live_server(tmp_path):
     assert found, "profile capture produced no artifact files"
     httpd.shutdown()
     app.close()
+
+
+# --- head-based trace sampling (ISSUE 13 tentpole) --------------------------
+
+def test_sampling_seeded_deterministic_and_counted():
+    """The birth decision is a dedicated seeded RNG: the same seed
+    yields the same keep/drop stream, and the counters ledger exactly
+    what was dropped."""
+    obs_trace.set_sample_rate(0.5, seed=42)
+    first = [obs_trace.sample_trace() for _ in range(64)]
+    obs_trace.set_sample_rate(0.5, seed=42)
+    second = [obs_trace.sample_trace() for _ in range(64)]
+    assert first == second
+    assert True in first and False in first  # a real mix at p=0.5
+    st = obs_trace.sample_stats()
+    assert st["sampled_total"] == sum(second)
+    assert st["dropped_total"] == 64 - sum(second)
+    assert st["forced_total"] == 0
+    # seed via env (the test hook the CLI documents)
+    os.environ["HPNN_TRACE_SAMPLE_SEED"] = "42"
+    try:
+        obs_trace.set_sample_rate(0.5)
+        assert [obs_trace.sample_trace() for _ in range(64)] == first
+    finally:
+        del os.environ["HPNN_TRACE_SAMPLE_SEED"]
+
+
+def test_sampling_forced_capture_beats_rate_zero():
+    """Forced captures (explicit trace id / high-QoS) win at ANY rate
+    -- rate 0 drops every unforced trace but never a forced one."""
+    obs_trace.set_sample_rate(0.0)
+    assert all(not obs_trace.sample_trace() for _ in range(16))
+    assert all(obs_trace.sample_trace(force=True) for _ in range(4))
+    st = obs_trace.sample_stats()
+    assert st == {"rate": 0.0, "sampled_total": 4, "dropped_total": 16,
+                  "forced_total": 4}
+
+
+def test_no_sampler_keeps_everything_and_exports_nothing():
+    """Without a sampler the decision is a constant True with NO
+    counters -- the pre-sampling behavior, and no /metrics series."""
+    assert obs_trace.sample_stats() is None
+    assert all(obs_trace.sample_trace() for _ in range(8))
+    assert obs_trace.sample_stats() is None
+    m = ServeMetrics()
+    assert "trace_sampling" not in m.snapshot()
+    assert "hpnn_trace_sample_rate" not in m.render_prometheus()
+
+
+def test_sampling_over_http_unsampled_records_nothing(tmp_path):
+    """rate=0: an anonymous request mints NO trace (no body trace id,
+    empty recorder) -- the zero-allocation no-op path; an explicit
+    X-HPNN-Trace-Id or X-HPNN-Priority: high forces a full tree."""
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4, trace=True, trace_sample=0.0)
+    app.add_model(conf, warmup=False)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    xs = {"inputs": [[0.0] * N_IN]}
+    try:
+        st, body, hdrs = _http_json(base + "/v1/kernels/tiny/infer", xs)
+        assert st == 200
+        assert "trace" not in body
+        assert obs.snapshot() == []  # nothing recorded at all
+        # explicit trace id: forced capture, complete tree
+        st, body, hdrs = _http_json(
+            base + "/v1/kernels/tiny/infer", xs,
+            headers={"X-HPNN-Trace-Id": "forced-1"})
+        assert st == 200 and body["trace"] == "forced-1"
+        names = {s["name"] for s in obs.snapshot(trace_id="forced-1")}
+        assert {"serve.request", "queue_wait",
+                "device_launch"} <= names
+        # high-QoS lane: forced too (the traffic you page on)
+        st, body, _ = _http_json(
+            base + "/v1/kernels/tiny/infer", xs,
+            headers={"X-HPNN-Priority": "high"})
+        assert st == 200 and body.get("trace")
+        assert obs.snapshot(trace_id=body["trace"])
+        stats = obs_trace.sample_stats()
+        assert stats["dropped_total"] == 1
+        assert stats["forced_total"] == 2
+        # the counters are exported
+        snap = app.metrics.snapshot()
+        assert snap["trace_sampling"]["dropped_total"] == 1
+        text = app.metrics.render_prometheus()
+        assert 'hpnn_trace_decisions_total{outcome="dropped"} 1' in text
+        lint_prometheus(text)
+    finally:
+        httpd.shutdown()
+        app.close()
+
+
+# --- durable span export (ISSUE 13 tentpole) --------------------------------
+
+def test_exporter_rotates_by_size_and_retains(tmp_path):
+    from hpnn_tpu.obs.export import (
+        SpanExporter,
+        list_segments,
+        read_spool,
+    )
+
+    d = str(tmp_path / "spool")
+    exp = SpanExporter(d, segment_bytes=256, segment_age_s=3600.0,
+                       max_dir_bytes=1 << 20, queue_spans=1024)
+    try:
+        obs_trace.set_exporter(exp)
+        obs.enable(capacity=4096)
+        for i in range(40):
+            with obs.span("work", trace_id="t-rot", i=i):
+                pass
+        exp.flush()
+        segs = list_segments(d)
+        assert len(segs) >= 2, "size cap never rotated"
+        spans = read_spool(d, trace_id="t-rot")
+        assert len(spans) == 40  # nothing lost across rotations
+        assert [s["i"] for s in spans] == sorted(s["i"] for s in spans)
+        st = exp.stats()
+        assert st["exported_total"] == 40
+        assert st["dropped_total"] == 0
+        assert st["rotations_total"] >= 2
+    finally:
+        obs_trace.set_exporter(None)
+        exp.close()
+
+
+def test_exporter_retention_prunes_oldest(tmp_path):
+    from hpnn_tpu.obs.export import SpanExporter, list_segments
+
+    d = str(tmp_path / "spool")
+    exp = SpanExporter(d, segment_bytes=200, segment_age_s=3600.0,
+                       max_dir_bytes=600, queue_spans=1024)
+    try:
+        for i in range(120):
+            exp.offer({"name": "w", "trace": "t", "span": f"s{i}",
+                       "ts": float(i), "seq": i})
+        exp.flush()
+        segs = list_segments(d)
+        total = sum(os.path.getsize(p) for p in segs)
+        assert exp.stats()["segments_pruned_total"] > 0
+        assert total <= 600 + 200  # cap + at most one newest segment
+    finally:
+        exp.close()
+
+
+def test_spool_read_back_skips_torn_tail(tmp_path):
+    """A writer killed mid-line leaves a torn tail: read_spool serves
+    every complete line and skips the fragment."""
+    from hpnn_tpu.obs.export import read_spool
+
+    d = tmp_path / "spool"
+    d.mkdir()
+    good = {"name": "w", "trace": "t1", "span": "a", "ts": 1.0}
+    (d / "spans-1-100-000001.ndjson").write_text(
+        json.dumps(good) + "\n" + '{"name": "w", "trace": "t1", "sp')
+    spans = read_spool(str(d))
+    assert spans == [good]
+
+
+def test_dump_to_dir_reuses_spool(tmp_path):
+    """With an exporter attached, the SIGTERM/fault auto-dump is a
+    spool flush -- ONE writer; no second ad-hoc trace-*.ndjson file."""
+    from hpnn_tpu.obs.export import SpanExporter
+
+    d = str(tmp_path / "spool")
+    exp = SpanExporter(d, segment_age_s=3600.0)
+    try:
+        obs_trace.set_exporter(exp)
+        obs.enable(capacity=64)
+        with obs.span("pre-crash", trace_id="t-dump"):
+            pass
+        extra = {"name": "remote", "trace": "t-dump", "span": "r1",
+                 "ts": 2.0, "host": "10.0.0.9:8001", "role": "worker"}
+        path = obs.dump_to_dir(str(tmp_path / "elsewhere"),
+                               reason="fault", extra_spans=[extra])
+        assert path is not None and path.startswith(d)
+        assert not (tmp_path / "elsewhere").exists()
+        from hpnn_tpu.obs.export import read_spool
+
+        names = {s["name"] for s in read_spool(d, trace_id="t-dump")}
+        assert names == {"pre-crash", "remote"}
+    finally:
+        obs_trace.set_exporter(None)
+        exp.close()
+
+
+def test_debug_trace_spool_read_back_over_http(tmp_path):
+    """GET /v1/debug/trace?spool=1 reads back through the durable
+    segments -- including spans already rotated out of the ring."""
+    conf = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=4, trace=True,
+                   span_dir=str(tmp_path / "spool"))
+    app.add_model(conf, warmup=False)
+    httpd, _t = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, _, _ = _http_json(base + "/v1/kernels/tiny/infer",
+                              {"inputs": [[0.0] * N_IN]},
+                              headers={"X-HPNN-Trace-Id": "sp-1"})
+        assert st == 200
+        # shrink the ring to evict everything: the spool must still
+        # answer (that is the point of durability)
+        obs.enable(capacity=16)
+        req = urllib.request.Request(
+            base + "/v1/debug/trace?spool=1&trace=sp-1")
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            lines = resp.read().decode().splitlines()
+        names = {json.loads(ln)["name"] for ln in lines if ln.strip()}
+        assert {"serve.request", "device_launch"} <= names
+        snap = app.metrics.snapshot()
+        assert snap["span_export"]["exported_total"] > 0
+        lint_prometheus(app.metrics.render_prometheus())
+    finally:
+        httpd.shutdown()
+        app.close()
+
+
+def test_spool_drain_makes_readable_without_rotation(tmp_path):
+    """The ?spool=1 read path drains (write + flush) WITHOUT forcing a
+    rotation: a polling dashboard must not mint a segment + fsync per
+    query (flush stays the post-mortem path and does rotate)."""
+    from hpnn_tpu.obs.export import SpanExporter, list_segments, read_spool
+
+    d = str(tmp_path / "spool")
+    exp = SpanExporter(d, segment_bytes=1 << 20, segment_age_s=3600.0,
+                       queue_spans=64)
+    try:
+        for i in range(5):
+            exp.offer({"name": "w", "trace": "t", "span": f"s{i}",
+                       "ts": float(i), "seq": i})
+        for _ in range(3):
+            exp.drain()  # repeated polls
+        assert len(read_spool(d, trace_id="t")) == 5
+        assert list_segments(d) == []  # open spool only, no segments
+        assert exp.stats()["rotations_total"] == 0
+        path = exp.flush()  # the post-mortem path DOES rotate
+        assert path is not None and len(list_segments(d)) == 1
+    finally:
+        exp.close()
