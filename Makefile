@@ -23,6 +23,7 @@ MESH_TESTS = tests/test_parallel.py tests/test_pallas.py \
 SERVE_TESTS = tests/test_serve.py
 SERVE_MESH_TESTS = tests/test_mesh.py
 CHAOS_TESTS = tests/test_chaos.py
+TRAIN_CHAOS_TESTS = tests/test_train_chaos.py
 CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py \
              tests/test_dp_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
@@ -31,8 +32,8 @@ AUTOSCALE_TESTS = tests/test_autoscale.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
-	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(CKPT_TESTS) \
-	    $(JOBS_TESTS) $(OBS_TESTS) $(AUTOSCALE_TESTS) -q
+	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(TRAIN_CHAOS_TESTS) \
+	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) $(AUTOSCALE_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by

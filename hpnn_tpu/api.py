@@ -1673,7 +1673,8 @@ def run_kernel(nn: NNDef) -> None:
 def train_job(conf_path: str, *, epochs: int, ckpt_dir: str,
               ckpt_every: int = 1, ckpt_keep: int = 0,
               kernel_out: str | None = None, resume: str | None = None,
-              stop=None, on_epoch=None) -> dict:
+              stop=None, on_epoch=None, replicate_to: str | None = None,
+              auth_token: str | None = None) -> dict:
     """Reentrant in-process training entry (the jobs subsystem's driver).
 
     The exact ``train_nn`` checkpoint path -- configure, multi-epoch
@@ -1723,7 +1724,9 @@ def train_job(conf_path: str, *, epochs: int, ckpt_dir: str,
         nn.conf.seed = snap.seed
         start_epoch = snap.epoch
     mgr = CheckpointManager(ckpt_dir, every=ckpt_every,
-                            keep_last=ckpt_keep, target_epochs=epochs)
+                            keep_last=ckpt_keep, target_epochs=epochs,
+                            replicate_to=replicate_to,
+                            auth_token=auth_token)
     if snap is not None:
         mgr.seed_errors(snap.errors)
     if start_epoch >= epochs:

@@ -8,12 +8,14 @@ driver with SIGTERM/SIGINT final-snapshot handling.
 """
 
 from .manager import CheckpointManager
+from .replicate import Replicator, pack_bundle, restore_bundle, unpack_bundle
 from .snapshot import (
     MANIFEST,
     SNAPSHOT_KERNEL,
     SNAPSHOT_META,
     SNAPSHOT_STATE,
     SnapshotState,
+    candidate_bundles,
     check_kernel_fingerprint,
     fingerprint_bytes,
     fingerprint_file,
@@ -26,6 +28,7 @@ from .snapshot import (
     record_final_kernel,
     refresh_final_kernel,
     snapshot_tag,
+    verify_bundle,
     write_manifest,
     write_snapshot,
 )
@@ -33,9 +36,11 @@ from .trainer import train_loop
 
 __all__ = [
     "CheckpointManager", "MANIFEST", "SNAPSHOT_KERNEL", "SNAPSHOT_META",
-    "SNAPSHOT_STATE", "SnapshotState", "check_kernel_fingerprint",
+    "SNAPSHOT_STATE", "SnapshotState", "candidate_bundles",
+    "check_kernel_fingerprint",
     "fingerprint_bytes", "fingerprint_file", "load_bundle_kernel",
     "load_snapshot", "looks_like_checkpoint", "manifest_path", "publish_snapshot",
     "read_manifest", "record_final_kernel", "refresh_final_kernel", "snapshot_tag", "train_loop",
-    "write_manifest", "write_snapshot",
+    "verify_bundle", "write_manifest", "write_snapshot",
+    "Replicator", "pack_bundle", "unpack_bundle", "restore_bundle",
 ]

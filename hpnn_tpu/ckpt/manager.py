@@ -25,6 +25,7 @@ from :meth:`flush` (the CLI flushes before declaring the run done).
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 
@@ -39,7 +40,9 @@ from . import snapshot as snap
 
 class CheckpointManager:
     def __init__(self, ckpt_dir: str, every: int = 1, keep_last: int = 0,
-                 use_pool: bool = True, target_epochs: int = 0):
+                 use_pool: bool = True, target_epochs: int = 0,
+                 replicate_to: str | None = None,
+                 auth_token: str | None = None):
         self.ckpt_dir = ckpt_dir
         self.every = max(0, int(every))
         self.keep_last = max(0, int(keep_last))
@@ -47,6 +50,23 @@ class CheckpointManager:
         # the run's --epochs goal, recorded in every bundle so a bare
         # --resume knows how far the interrupted run meant to go
         self.target_epochs = max(0, int(target_epochs))
+        # off-host replication (ISSUE 14): each VERIFIED bundle is
+        # shipped content-addressed to --replicate-to (a directory or a
+        # mesh router) on its OWN io_pool future, deliberately outside
+        # the snapshot chain flush() joins -- an unreachable
+        # destination must never stall an epoch boundary (the jobs
+        # scheduler flushes every due epoch).  Pending ships are
+        # joined only at record_final (run end); failures warn +
+        # count, never fail the run
+        self.replicator = None
+        self._rep_futures: list = []
+        replicate_to = replicate_to \
+            or os.environ.get("HPNN_REPLICATE_TO") or None
+        if replicate_to:
+            from .replicate import Replicator
+
+            self.replicator = Replicator(replicate_to, ckpt_dir,
+                                         auth_token=auth_token)
         self.errors: list[float | None] = []
         self.last_saved_epoch = 0
         self._future = None
@@ -157,6 +177,37 @@ class CheckpointManager:
         snap.publish_snapshot(self.ckpt_dir, entry, seed=job["seed"],
                               errors=job["errors"],
                               keep_last=self.keep_last)
+        if self.replicator is not None:
+            # only a bundle that passed its verified write ever ships;
+            # replicate() swallows destination failures (warn + count).
+            # A separate future, NOT this chain: flush() must never
+            # wait on the network
+            from ..io.corpus import io_pool
+
+            with self._lock:
+                self._rep_futures = [f for f in self._rep_futures
+                                     if not f.done()]
+                self._rep_futures.append(io_pool().submit(
+                    self._replicate_silent,
+                    os.path.join(self.ckpt_dir, entry["tag"])))
+
+    def _replicate_silent(self, bundle_dir: str) -> None:
+        with nn_log.capture():  # pool thread: never prints
+            self.replicator.replicate(bundle_dir)
+
+    def drain_replication(self) -> None:
+        """Join every pending replica ship (each internally bounded by
+        HPNN_REPLICATE_ATTEMPTS x HPNN_REPLICATE_TIMEOUT_S): called at
+        run end so a finishing process does not cut its last bundles'
+        replication short.  Failures were already warned + counted."""
+        with self._lock:
+            futures, self._rep_futures = self._rep_futures, []
+        for fut in futures:
+            with nn_log.capture():
+                try:
+                    fut.result()
+                except Exception:  # noqa: BLE001 -- already surfaced
+                    pass
 
     def flush(self) -> None:
         """Block until every queued bundle is durably published;
@@ -170,6 +221,9 @@ class CheckpointManager:
     def record_final(self, kernel_path: str) -> None:
         """After train_nn's final ``kernel.opt`` dump: flush pending
         bundles, then stamp the manifest with the final kernel's path +
-        fingerprint (run_nn's staleness guard; watchers see the bump)."""
+        fingerprint (run_nn's staleness guard; watchers see the bump).
+        Pending replica ships are joined here too -- the run's end is
+        the one place waiting on the destination is correct."""
         self.flush()
         snap.record_final_kernel(self.ckpt_dir, kernel_path)
+        self.drain_replication()

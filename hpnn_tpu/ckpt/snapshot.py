@@ -32,6 +32,20 @@ fsync'd -- readers (the serve hot-reload watcher, a concurrent
 through the shared ``io.atomic`` tmp+fsync+rename writer, and its
 ``generation`` counter increments on every publish, which is what the
 serving registry's manifest watcher keys reloads on.
+
+Verified writes + verified resume (ISSUE 14): every bundle file's
+sha256 is recorded in ``snapshot.json`` (``fingerprints``), the staged
+files are READ BACK and verified before the directory rename (bounded
+retry with jittered backoff -- ``HPNN_CKPT_WRITE_RETRIES`` /
+``HPNN_CKPT_RETRY_BACKOFF_S`` -- so a transient ENOSPC/EIO or a torn
+write costs a retry, never a poisoned bundle), and the manifest is
+only ever updated AFTER its bundle verified.  On resume the same
+fingerprints are ENFORCED: :func:`load_snapshot` walks candidate
+bundles newest-first, skipping any whose bytes no longer hash to what
+``snapshot.json`` recorded (or that fail to parse at all) with a loud
+``ckpt_fallback`` structured event -- training resumes from the newest
+*intact* state instead of crashing on, or silently training from,
+garbage.
 """
 
 from __future__ import annotations
@@ -47,7 +61,7 @@ import time
 
 import numpy as np
 
-from ..io.atomic import atomic_write_text, fsync_dir
+from ..io.atomic import fsync_dir
 from ..io.kernel_io import dumps_kernel, encode_kernel_text, load_kernel
 from ..models.kernel import Kernel
 
@@ -97,7 +111,13 @@ class SnapshotState:
 
 def _durable_write(path: str, data: bytes) -> None:
     """Plain write + fsync (used INSIDE a staged tmp bundle, where the
-    directory rename provides the atomicity)."""
+    directory rename provides the atomicity).  Consults the chaos io
+    domain like every durable writer (ISSUE 14) -- injected
+    ENOSPC/EIO/torn/bitflip faults land HERE, below the bundle
+    writer's verify-and-retry loop."""
+    from ..io.atomic import io_fault_hook
+
+    data = io_fault_hook(path, data)
     with open(path, "wb") as fp:
         fp.write(data)
         fp.flush()
@@ -119,12 +139,42 @@ def _state_npz_bytes(weights, momentum, rng_state, epoch: int,
     return buf.getvalue()
 
 
+def write_retries() -> int:
+    from ..utils.env import env_int
+
+    return env_int("HPNN_CKPT_WRITE_RETRIES", 3, lo=0)
+
+
+def _retry_backoff_s(attempt: int) -> float:
+    """Jittered exponential backoff between bundle-write attempts."""
+    import random
+
+    from ..utils.env import env_float
+
+    base = env_float("HPNN_CKPT_RETRY_BACKOFF_S", 0.05, lo=0.0)
+    return base * (2.0 ** attempt) * (0.5 + random.random())
+
+
+def _verify_staged(path: str, data: bytes) -> None:
+    """Read a just-staged file back and compare against the intended
+    payload: a torn or bit-flipped write is caught HERE, before the
+    bundle rename can ever publish it (raises OSError to the retry
+    loop)."""
+    with open(path, "rb") as fp:
+        if fp.read() != data:
+            raise OSError(f"verify-after-write mismatch on {path}")
+
+
 def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
                    rng_state, seed: int, errors, name: str = "(null)",
                    train: str = "", dtype: str = "f64",
                    target_epochs: int = 0) -> dict:
     """Write one atomic bundle for ``epoch``; returns its index entry
-    (tag/epoch/mean_err/fingerprint) for the manifest.
+    (tag/epoch/mean_err/fingerprint) for the manifest.  Every staged
+    file is read back and byte-verified before the directory rename;
+    a failed or corrupted write is retried (bounded, jittered backoff)
+    and the LAST failure is raised -- a bundle either publishes
+    verified or not at all.
 
     Runs on the io_pool writer thread in production -- it must not
     print (the caller owns the console stream's byte parity).
@@ -133,46 +183,73 @@ def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
     tag = snapshot_tag(epoch)
     final = os.path.join(ckpt_dir, tag)
     tmp = os.path.join(ckpt_dir, f".tmp.{tag}.{os.getpid()}")
-    if os.path.isdir(tmp):
-        shutil.rmtree(tmp)
-    os.makedirs(tmp)
-    try:
-        kernel_text = dumps_kernel(Kernel(name=name, weights=list(weights)))
-        kernel_bytes = encode_kernel_text(kernel_text)
-        fp_kernel = fingerprint_bytes(kernel_bytes)
-        _durable_write(os.path.join(tmp, SNAPSHOT_KERNEL), kernel_bytes)
-        _durable_write(os.path.join(tmp, SNAPSHOT_STATE),
-                       _state_npz_bytes(weights, momentum, rng_state,
-                                        epoch, seed))
-        errors = [None if e is None else float(e) for e in errors]
-        meta = {
-            "tag": tag,
-            "epoch": int(epoch),
-            "seed": int(seed),
-            "fingerprint": fp_kernel,
-            "mean_err": errors[-1] if errors else None,
-            "errors": errors,
-            "topology": [int(weights[0].shape[1]),
-                         *[int(w.shape[0]) for w in weights]],
-            "train": train,
-            "dtype": dtype,
-            "momentum": momentum is not None,
-            "target_epochs": int(target_epochs),
-            "created": time.time(),
-        }
-        _durable_write(os.path.join(tmp, SNAPSHOT_META),
-                       (json.dumps(meta, indent=1) + "\n").encode())
-        fsync_dir(tmp)
-        if os.path.isdir(final):  # re-snapshot of the same epoch
-            shutil.rmtree(final)
-        os.replace(tmp, final)
-    except BaseException:
-        with contextlib.suppress(OSError):
+    kernel_text = dumps_kernel(Kernel(name=name, weights=list(weights)))
+    kernel_bytes = encode_kernel_text(kernel_text)
+    state_bytes = _state_npz_bytes(weights, momentum, rng_state, epoch,
+                                   seed)
+    fp_kernel = fingerprint_bytes(kernel_bytes)
+    errors = [None if e is None else float(e) for e in errors]
+    meta = {
+        "tag": tag,
+        "epoch": int(epoch),
+        "seed": int(seed),
+        "fingerprint": fp_kernel,
+        "fingerprints": {SNAPSHOT_KERNEL: fp_kernel,
+                         SNAPSHOT_STATE: fingerprint_bytes(state_bytes)},
+        "mean_err": errors[-1] if errors else None,
+        "errors": errors,
+        "topology": [int(weights[0].shape[1]),
+                     *[int(w.shape[0]) for w in weights]],
+        "train": train,
+        "dtype": dtype,
+        "momentum": momentum is not None,
+        "target_epochs": int(target_epochs),
+        "created": time.time(),
+    }
+    meta_bytes = (json.dumps(meta, indent=1) + "\n").encode()
+    files = ((SNAPSHOT_KERNEL, kernel_bytes),
+             (SNAPSHOT_STATE, state_bytes),
+             (SNAPSHOT_META, meta_bytes))
+    last_exc: BaseException | None = None
+    for attempt in range(write_retries() + 1):
+        if attempt:
+            time.sleep(_retry_backoff_s(attempt - 1))
+        if os.path.isdir(tmp):
             shutil.rmtree(tmp)
-        raise
-    fsync_dir(ckpt_dir)
-    return {"tag": tag, "epoch": int(epoch),
-            "mean_err": meta["mean_err"], "fingerprint": fp_kernel}
+        try:
+            os.makedirs(tmp)
+            for fname, data in files:
+                fpath = os.path.join(tmp, fname)
+                _durable_write(fpath, data)
+                _verify_staged(fpath, data)
+            fsync_dir(tmp)
+            if os.path.isdir(final):  # re-snapshot of the same epoch
+                shutil.rmtree(final)
+            os.replace(tmp, final)
+        except OSError as exc:
+            # transient disk trouble (ENOSPC burst, torn write): clean
+            # the stage and retry -- nothing was ever renamed into
+            # place, so no reader saw a partial bundle
+            last_exc = exc
+            with contextlib.suppress(OSError):
+                shutil.rmtree(tmp)
+            continue
+        except BaseException:
+            with contextlib.suppress(OSError):
+                shutil.rmtree(tmp)
+            raise
+        fsync_dir(ckpt_dir)
+        # the manifest entry carries EVERY file's fingerprint --
+        # including snapshot.json's own, which cannot self-certify --
+        # so verify_bundle has an external cross-check for each byte
+        # of the bundle
+        return {"tag": tag, "epoch": int(epoch),
+                "mean_err": meta["mean_err"], "fingerprint": fp_kernel,
+                "fingerprints": dict(
+                    meta["fingerprints"],
+                    **{SNAPSHOT_META: fingerprint_bytes(meta_bytes)})}
+    raise OSError(f"CKPT: bundle {tag} failed verified write after "
+                  f"{write_retries() + 1} attempt(s): {last_exc}")
 
 
 # --- manifest ---------------------------------------------------------------
@@ -187,17 +264,48 @@ def read_manifest(ckpt_dir: str) -> dict | None:
     try:
         with open(manifest_path(ckpt_dir), "r") as fp:
             m = json.load(fp)
-    except (OSError, json.JSONDecodeError):
+    except (OSError, ValueError, UnicodeDecodeError):
+        # ValueError covers JSONDecodeError; UnicodeDecodeError covers
+        # bit-rot that breaks the utf-8 stream itself
         return None
     return m if isinstance(m, dict) else None
 
 
 def write_manifest(ckpt_dir: str, manifest: dict) -> None:
+    """Verified manifest publish: tmp+fsync+rename via io.atomic, read
+    back and compared, retried (bounded, jittered backoff) on any
+    failure.  Because the replace is atomic and only runs after the
+    temp file fsync'd, a failed attempt leaves the PREVIOUS manifest
+    intact -- a disk fault can cost a generation bump, never a
+    poisoned manifest."""
     manifest = dict(manifest)
     manifest["version"] = MANIFEST_VERSION
     manifest["updated"] = time.time()
-    atomic_write_text(manifest_path(ckpt_dir),
-                      json.dumps(manifest, indent=1) + "\n")
+    payload = (json.dumps(manifest, indent=1) + "\n").encode("utf-8")
+    path = manifest_path(ckpt_dir)
+    stage = f"{path}.stage.{os.getpid()}"
+    last_exc: Exception | None = None
+    for attempt in range(write_retries() + 1):
+        if attempt:
+            time.sleep(_retry_backoff_s(attempt - 1))
+        try:
+            # stage + verify FIRST, replace LAST: the previous
+            # manifest must never be overwritten by bytes that have
+            # not already been read back intact (a persistently
+            # corrupting disk then exhausts the retries with the OLD
+            # manifest still published)
+            _durable_write(stage, payload)
+            _verify_staged(stage, payload)
+            os.replace(stage, path)
+        except OSError as exc:
+            last_exc = exc
+            with contextlib.suppress(OSError):
+                os.unlink(stage)
+            continue
+        fsync_dir(os.path.dirname(os.path.abspath(path)))
+        return
+    raise OSError(f"CKPT: manifest write failed after "
+                  f"{write_retries() + 1} attempt(s): {last_exc}")
 
 
 def publish_snapshot(ckpt_dir: str, entry: dict, *, seed: int, errors,
@@ -279,41 +387,103 @@ def _apply_retention(ckpt_dir: str, snaps: list[dict],
 
 # --- resume ----------------------------------------------------------------
 
-def _resolve_bundle(path: str) -> str | None:
-    """Map a user-supplied ``--resume`` path to a bundle directory:
-    accepts the checkpoint dir (-> manifest's latest), a bundle dir, or
-    any file inside either."""
+def _bundle_tags(path: str) -> list[str]:
+    """Bundle directory names under a checkpoint dir, newest epoch
+    first (tags sort lexically == numerically by construction)."""
+    try:
+        return sorted((t for t in os.listdir(path)
+                       if t.startswith("ep") and os.path.isfile(
+                           os.path.join(path, t, SNAPSHOT_STATE))),
+                      reverse=True)
+    except OSError:
+        return []
+
+
+def candidate_bundles(path: str) -> list[str]:
+    """Every bundle a ``--resume``/recovery of ``path`` could load,
+    newest-first: an explicit bundle dir leads, then the manifest's
+    latest, then every remaining on-disk bundle by descending epoch --
+    the walk-back order for verified resume."""
     path = os.path.abspath(path)
     if os.path.isfile(path):
         path = os.path.dirname(path)
     if not os.path.isdir(path):
-        return None
+        return []
+    out: list[str] = []
     if os.path.isfile(os.path.join(path, SNAPSHOT_STATE)):
-        return path
+        # an explicit bundle dir: it leads, its siblings are fallback
+        out.append(path)
+        path = os.path.dirname(path)
     manifest = read_manifest(path)
     if manifest and manifest.get("latest"):
         bundle = os.path.join(path, manifest["latest"])
         if os.path.isfile(os.path.join(bundle, SNAPSHOT_STATE)):
-            return bundle
-    # no manifest (crashed before first publish?): newest complete bundle
-    tags = sorted(t for t in os.listdir(path)
-                  if t.startswith("ep") and os.path.isfile(
-                      os.path.join(path, t, SNAPSHOT_STATE)))
-    return os.path.join(path, tags[-1]) if tags else None
+            out.append(bundle)
+    out.extend(os.path.join(path, t) for t in _bundle_tags(path))
+    seen: set[str] = set()
+    return [b for b in out if not (b in seen or seen.add(b))]
 
 
-def load_snapshot(path: str) -> SnapshotState | None:
-    """Load a bundle (or a checkpoint dir's latest bundle) back into
-    host state.  Weights come from ``state.npz`` -- bit-exact float64,
-    NOT the quantized text -- which is what makes resume byte-identical.
-    Returns None (with an NN(ERR) diagnostic) when nothing loadable is
-    found."""
-    from ..utils.nn_log import nn_error, nn_warn
+def _manifest_fingerprints(bundle: str) -> dict:
+    """The manifest's recorded per-file fingerprints for this bundle
+    (empty when the manifest is absent/corrupt/legacy).  This is the
+    EXTERNAL cross-check: ``snapshot.json`` cannot certify its own
+    bytes, so its sha256 lives in the manifest entry."""
+    manifest = read_manifest(os.path.dirname(os.path.abspath(bundle)))
+    if not manifest:
+        return {}
+    tag = os.path.basename(bundle.rstrip(os.sep))
+    for entry in manifest.get("snapshots", []):
+        if isinstance(entry, dict) and entry.get("tag") == tag:
+            prints = entry.get("fingerprints")
+            return prints if isinstance(prints, dict) else {}
+    return {}
 
-    bundle = _resolve_bundle(path)
-    if bundle is None:
-        nn_error(f"CKPT: no resumable snapshot at {path}\n")
-        return None
+
+def verify_bundle(bundle: str) -> tuple[bool, str]:
+    """ENFORCE a bundle's recorded fingerprints (ISSUE 14): every file
+    named in ``snapshot.json``'s ``fingerprints`` map -- plus the
+    manifest entry's cross-check, which covers ``snapshot.json``
+    itself -- must hash to its recorded sha256, and ``state.npz`` must
+    structurally parse.  An unparseable ``snapshot.json`` is corrupt
+    (bundles publish atomically; a half file cannot exist).  Legacy
+    bundles (no ``fingerprints``) fall back to the kernel-only
+    ``fingerprint`` field plus the parse check.  Returns
+    ``(ok, reason)`` -- reason names the first failing file."""
+    meta = None
+    with contextlib.suppress(OSError, ValueError, UnicodeDecodeError):
+        with open(os.path.join(bundle, SNAPSHOT_META)) as fp:
+            meta = json.load(fp)
+    if not isinstance(meta, dict):
+        return False, f"{SNAPSHOT_META}: missing or unparseable"
+    prints = dict(_manifest_fingerprints(bundle))
+    own = meta.get("fingerprints")
+    if isinstance(own, dict):
+        # the bundle's own map fills anything the manifest lacks; on
+        # conflict the manifest wins (it is the external witness)
+        for k, v in own.items():
+            prints.setdefault(k, v)
+    elif not prints and meta.get("fingerprint"):
+        prints[SNAPSHOT_KERNEL] = meta["fingerprint"]
+    for fname, recorded in sorted(prints.items()):
+        actual = fingerprint_file(os.path.join(bundle, fname))
+        if actual is None:
+            return False, f"{fname}: unreadable"
+        if actual != recorded:
+            return False, f"{fname}: sha256 mismatch"
+    try:
+        with np.load(os.path.join(bundle, SNAPSHOT_STATE),
+                     allow_pickle=False) as z:
+            if "meta" not in z.files:
+                return False, f"{SNAPSHOT_STATE}: missing meta"
+    except (OSError, KeyError, ValueError) as exc:
+        return False, f"{SNAPSHOT_STATE}: {type(exc).__name__}: {exc}"
+    return True, "ok"
+
+
+def _load_bundle_state(bundle: str) -> SnapshotState | None:
+    from ..utils.nn_log import nn_error
+
     try:
         with np.load(os.path.join(bundle, SNAPSHOT_STATE),
                      allow_pickle=False) as z:
@@ -329,22 +499,52 @@ def load_snapshot(path: str) -> SnapshotState | None:
         nn_error(f"CKPT: unreadable snapshot state in {bundle}: {exc}\n")
         return None
     meta = {}
-    with contextlib.suppress(OSError, json.JSONDecodeError):
+    with contextlib.suppress(OSError, ValueError, UnicodeDecodeError):
         with open(os.path.join(bundle, SNAPSHOT_META)) as fp:
             meta = json.load(fp)
     errors = [e for e in meta.get("errors", [])]
-    fp_recorded = meta.get("fingerprint")
     fp_actual = fingerprint_file(os.path.join(bundle, SNAPSHOT_KERNEL))
-    if fp_recorded and fp_actual and fp_recorded != fp_actual:
-        nn_warn(f"CKPT: {os.path.join(bundle, SNAPSHOT_KERNEL)} does not "
-                f"match its recorded fingerprint in "
-                f"{os.path.join(bundle, SNAPSHOT_META)} -- resuming from "
-                "state.npz anyway\n")
     return SnapshotState(weights=weights, momentum=momentum,
                          rng_state=rng, epoch=epoch, seed=seed,
                          errors=errors, tag=os.path.basename(bundle),
                          path=bundle, fingerprint=fp_actual,
                          target_epochs=int(meta.get("target_epochs", 0)))
+
+
+def load_snapshot(path: str, verify: bool = True) -> SnapshotState | None:
+    """Load a bundle (or a checkpoint dir's latest bundle) back into
+    host state.  Weights come from ``state.npz`` -- bit-exact float64,
+    NOT the quantized text -- which is what makes resume byte-identical.
+
+    Verified resume with last-good fallback (ISSUE 14): candidates are
+    tried newest-first; a bundle whose bytes no longer match its
+    recorded fingerprints (or fail to parse) is SKIPPED with a loud
+    ``ckpt_fallback`` structured event + NN(WARN), and the walk
+    continues to the newest intact bundle -- resume never crashes on,
+    or silently trains from, a corrupted snapshot.  Returns None (with
+    an NN(ERR) diagnostic) when nothing intact is found."""
+    from ..utils.nn_log import nn_error, nn_event, nn_warn
+
+    candidates = candidate_bundles(path)
+    if not candidates:
+        nn_error(f"CKPT: no resumable snapshot at {path}\n")
+        return None
+    for bundle in candidates:
+        if verify:
+            ok, reason = verify_bundle(bundle)
+            if not ok:
+                nn_warn(f"CKPT: snapshot {bundle} failed verification "
+                        f"({reason}); falling back to the previous "
+                        "intact bundle\n")
+                nn_event("ckpt_fallback", bundle=bundle, reason=reason)
+                continue
+        snap = _load_bundle_state(bundle)
+        if snap is not None:
+            return snap
+    nn_error(f"CKPT: no INTACT snapshot at {path} "
+             f"({len(candidates)} candidate(s) all failed "
+             "verification)\n")
+    return None
 
 
 def looks_like_checkpoint(path: str) -> bool:

@@ -78,7 +78,14 @@ def _help_text(name: str, train: bool) -> str:
             "--resume [PATH] \tcontinue bit-exactly from the latest",
             "\tsnapshot in PATH (a ckpt dir or bundle; default",
             "\t--ckpt-dir): weights, BPM momentum, shuffle-RNG state",
-            "\tand epoch counter are restored.",
+            "\tand epoch counter are restored.  Bundles are VERIFIED",
+            "\tagainst their recorded sha256 fingerprints; a corrupt",
+            "\tbundle falls back to the newest intact one.",
+            "--replicate-to DEST \tship each verified snapshot bundle,",
+            "\tcontent-addressed, to DEST (a directory, or",
+            "\thttp://HOST:PORT of a mesh router); --resume restores",
+            "\tfrom DEST when no local bundle survives.  Default:",
+            "\t$HPNN_REPLICATE_TO.",
         ]
     lines += [
         "***********************************",
@@ -94,7 +101,8 @@ def _help_text(name: str, train: bool) -> str:
 _LONG_OPTS = {"--compile-cache": "compile_cache",
               "--corpus-cache": "corpus_cache",
               "--ckpt-dir": "ckpt_dir",
-              "--profile-dir": "profile_dir"}
+              "--profile-dir": "profile_dir",
+              "--replicate-to": "replicate_to"}
 # integer-valued long options (value validated like the reference's
 # numeric switches); min value enforced at parse time.  Most are
 # train_nn-only; _SHARED_INT_OPTS also parse for run_nn.
@@ -310,13 +318,35 @@ def _train_nn_body(filename: str, extras: dict) -> int:
     if extras.get("tile") is not None:
         # the CLI flag wins over a [tile] conf keyword
         neural.conf.tile = extras["tile"]
+    replicate_to = extras.get("replicate_to") \
+        or os.environ.get("HPNN_REPLICATE_TO") or None
     snap = None
     start_epoch = 0
     if resume:
         from .ckpt import load_snapshot
 
-        snap = load_snapshot(resume if isinstance(resume, str)
-                             else ckpt_dir)
+        resume_path = resume if isinstance(resume, str) else ckpt_dir
+        snap = load_snapshot(resume_path)
+        if snap is None and replicate_to:
+            # the local checkpoint history is gone or wholly corrupt:
+            # restore the newest intact REPLICATED bundle (ISSUE 14)
+            # into the CHECKPOINT DIR and walk again.  Bundles ship
+            # under scope_for(<ckpt dir>), so a --resume naming a
+            # bundle dir (or a file inside one) must resolve to its
+            # enclosing checkpoint dir both for the scope lookup and
+            # as the restore target -- restoring INTO a bundle dir
+            # would nest it where the candidate walk never looks
+            from .ckpt import SNAPSHOT_STATE
+            from .ckpt.replicate import restore_bundle, resolve_scope
+
+            rdir = resume_path
+            if os.path.isfile(rdir):
+                rdir = os.path.dirname(rdir) or "."
+            if os.path.isfile(os.path.join(rdir, SNAPSHOT_STATE)):
+                rdir = os.path.dirname(os.path.abspath(rdir))
+            if restore_bundle(replicate_to, resolve_scope(rdir),
+                              rdir) is not None:
+                snap = load_snapshot(rdir)
         if snap is None:
             sys.stderr.write("FAILED to resume: no loadable snapshot! "
                              "(ABORTING)\n")
@@ -367,7 +397,8 @@ def _train_nn_body(filename: str, extras: dict) -> int:
         mgr = None
         if ckpt_on:
             mgr = CheckpointManager(ckpt_dir, every=every, keep_last=keep,
-                                    target_epochs=epochs)
+                                    target_epochs=epochs,
+                                    replicate_to=replicate_to)
             if snap is not None:
                 mgr.seed_errors(snap.errors)
         with phase("train_kernel"):
@@ -534,6 +565,23 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
                     help="persistent job state/corpus/checkpoint root "
                     "(default ./jobs); a restarted server reports the "
                     "directory's job history")
+    ap.add_argument("--job-auto-resume", action="store_true",
+                    default=False,
+                    help="(with --jobs) lease-based auto-resume: on "
+                    "start and on a supervisor tick, interrupted and "
+                    "expired-lease jobs are re-queued from their "
+                    "newest VERIFIED local-or-replicated bundle, "
+                    "bounded by HPNN_JOB_MAX_RETRIES with jittered "
+                    "backoff, then failed with a reason.  Default: "
+                    "$HPNN_JOB_AUTO_RESUME=1")
+    ap.add_argument("--replicate-to", default=None, metavar="DEST",
+                    help="(with --jobs) off-host checkpoint "
+                    "replication: every verified snapshot bundle is "
+                    "shipped content-addressed to DEST (a directory, "
+                    "or http://HOST:PORT of a mesh router storing it "
+                    "in its blob store); auto-resume restores from "
+                    "DEST when the local dir is lost.  Default: "
+                    "$HPNN_REPLICATE_TO")
     ap.add_argument("--ab-fraction", type=float, default=0.0,
                     metavar="F",
                     help="A/B generation pinning: during a hot swap this "
@@ -831,18 +879,29 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         app.watch_manifest(wname, wdir, interval_s=args.watch_interval)
     if args.jobs > 0:
         app.enable_jobs(args.job_dir, capacity=args.jobs,
-                        auto_promote=args.auto_promote)
+                        auto_promote=args.auto_promote,
+                        auto_resume=args.job_auto_resume or None,
+                        replicate_to=args.replicate_to)
         tok = "on" if auth_token else "OFF (pass --auth-token)"
         promo = ", auto-promote" if args.auto_promote else ""
+        res = ", auto-resume" if app.jobs.auto_resume else ""
+        rep = (f", replicate-to={app.jobs.replicate_to}"
+               if app.jobs.replicate_to else "")
         sys.stdout.write(f"SERVE: online training enabled "
                          f"(queue={args.jobs}, job-dir={args.job_dir}, "
                          f"ab-fraction={args.ab_fraction:g}, "
-                         f"auth={tok}{promo})\n")
+                         f"auth={tok}{promo}{res}{rep})\n")
     elif args.auto_promote:
         sys.stderr.write("serve: --auto-promote is inert without "
                          "--jobs N (ignored)\n")
     httpd = make_server(args.addr, args.port, app)
     host, port = httpd.server_address[:2]
+    if app.mesh_standby is not None:
+        # runtime re-pairing (ISSUE 14): the mirror polls advertise
+        # this standby's own address, so a surviving ACTIVE router
+        # adopts it and re-advertises the pair to workers
+        app.mesh_standby.advertise = args.advertise \
+            or f"127.0.0.1:{port}"
     if autoscale_bounds is not None:
         # after the bind: spawned workers register against THIS
         # router's real port

@@ -20,13 +20,42 @@ the POSIX durable-replace sequence instead:
 Used by ``io.kernel_io.dump_kernel_to_path`` (every ``kernel.opt`` /
 ``kernel.tmp`` write) and the checkpoint subsystem's snapshot/manifest
 writers (``hpnn_tpu/ckpt``).
+
+Fault injection (ISSUE 14): every write consults the chaos layer's io
+domain (``HPNN_FAULT`` rules like ``enospc@manifest:times=1`` or
+``bitflip@state.npz``) through :func:`io_fault_hook`, so the snapshot
+retry / verified-resume machinery is testable without a failing disk.
+The hook is zero-cost when chaos is unarmed -- and the serve package
+(where the chaos module lives) is never even imported unless
+``HPNN_FAULT`` is set or a test armed it programmatically.
 """
 
 from __future__ import annotations
 
 import contextlib
 import os
+import sys
 import tempfile
+
+_CHAOS_MOD = __name__.rsplit(".", 2)[0] + ".serve.mesh.chaos"
+
+
+def io_fault_hook(path: str, data: bytes) -> bytes:
+    """Consult the chaos io domain for one pending durable write:
+    raises (enospc/eio), delays (latency), or returns the payload --
+    possibly corrupted (torn/bitflip) -- that should hit the disk.
+    A no-import no-op while chaos is unarmed."""
+    chaos = sys.modules.get(_CHAOS_MOD)
+    if chaos is None:
+        if not os.environ.get("HPNN_FAULT"):
+            return data  # unarmed: never pull in the serve stack
+        import importlib
+
+        chaos = importlib.import_module(_CHAOS_MOD)
+    rule = chaos.pick_io(path)
+    if rule is None:
+        return data
+    return chaos.apply_io_fault(rule, path, data)
 
 
 def fsync_dir(path: str) -> None:
@@ -42,6 +71,7 @@ def fsync_dir(path: str) -> None:
 
 def atomic_write_bytes(path: str, data: bytes) -> None:
     """Durably replace ``path`` with ``data`` (tmp + fsync + rename)."""
+    data = io_fault_hook(path, data)
     dirpath = os.path.dirname(os.path.abspath(path))
     fd, tmp = tempfile.mkstemp(prefix="." + os.path.basename(path) + ".",
                                suffix=".tmp", dir=dirpath)

@@ -69,13 +69,23 @@ import numpy as np
 
 from ..utils import nn_log
 from ..utils.env import env_int
-from ..utils.nn_log import nn_dbg, nn_error
+from ..utils.nn_log import nn_dbg, nn_error, nn_warn
 from . import samples
 from .samples import read_sample_fast
 
 _PACK_MAGIC = b"HPNNPK01"
 _PACK_VERSION = 1
 _ALIGN = 64
+# content-integrity trailer (ISSUE 14): sha256 over header blob + data
+# region, appended AFTER the data so pre-trailer readers (which check
+# size with >=) keep working.  Warm mmap loads verify it once per
+# process; a corrupt pack rebuilds with a warning instead of serving
+# garbage rows.
+_PACK_TRAILER_MAGIC = b"HPNNSH01"
+# packs this process already content-verified, keyed by (path, size,
+# mtime_ns) so an invalidated/rebuilt pack re-verifies
+_verified_packs: dict[tuple, None] = {}
+_VERIFIED_PACKS_MAX = 64
 
 # per-file status codes stored in the pack (listing order); >= 0 is the
 # row index into the packed x/t arrays
@@ -330,6 +340,41 @@ def _aligned(n: int) -> int:
     return (n + _ALIGN - 1) // _ALIGN * _ALIGN
 
 
+def _pack_content_ok(path: str, data_end: int) -> bool:
+    """Content-integrity check for a warm pack load: hash the header +
+    data region and compare against the trailer sha256, ONCE per
+    process per (path, trailer) -- the trailer digest itself keys the
+    memo, so the LRU mtime bumps never force a re-hash but a rebuilt
+    pack always gets one.  Packs without a trailer (pre-ISSUE-14)
+    pass: their stat fingerprint is the only guard they ever had."""
+    try:
+        with open(path, "rb") as fp:
+            fp.seek(data_end)
+            trailer = fp.read(8 + 32)
+            if trailer[:8] != _PACK_TRAILER_MAGIC or len(trailer) != 40:
+                return True  # legacy pack: no trailer to enforce
+            key = (os.path.abspath(path), trailer)
+            if key in _verified_packs:
+                return True
+            fp.seek(0)
+            h = hashlib.sha256()
+            remaining = data_end
+            while remaining > 0:
+                chunk = fp.read(min(1 << 20, remaining))
+                if not chunk:
+                    return False  # shrank under us
+                h.update(chunk)
+                remaining -= len(chunk)
+            if h.digest() != trailer[8:]:
+                return False
+    except OSError:
+        return False
+    _verified_packs[key] = None
+    while len(_verified_packs) > _VERIFIED_PACKS_MAX:
+        _verified_packs.pop(next(iter(_verified_packs)))
+    return True
+
+
 def _try_load_pack(dirpath: str, names: list[str], n_in: int, n_out: int,
                    probe_only: bool = False):
     """Validate the pack against the CURRENT dir state; returns
@@ -363,6 +408,14 @@ def _try_load_pack(dirpath: str, names: list[str], n_in: int, n_out: int,
         return None
     if probe_only:
         return True
+    if not _pack_content_ok(path, need):
+        # bit-rot/torn bytes under a valid header: rebuild from the
+        # source files instead of serving garbage rows (ISSUE 14)
+        nn_warn(f"corpus cache: {path} failed its content sha256; "
+                "rebuilding the pack from source files\n")
+        with contextlib.suppress(OSError):
+            os.unlink(path)
+        return None
     # LRU bookkeeping for the cache GC: a served pack is a recently-used
     # pack (content is fingerprinted by the header, not the mtime, so
     # the bump cannot stale-serve anything); registration protects the
@@ -552,6 +605,17 @@ def _save_pack(dirpath, names, n_in, n_out, results, stats) -> bool:
             if rows_x:
                 np.stack(rows_x).tofile(fp)
                 np.stack(rows_t).tofile(fp)
+        # content trailer (ISSUE 14): sha256 over the whole header +
+        # data region, appended AFTER the data so older readers are
+        # unaffected (streamed re-read -- never a second in-memory copy
+        # of a multi-hundred-MB corpus)
+        digest = hashlib.sha256()
+        with open(tmp, "rb") as fp:
+            for chunk in iter(lambda: fp.read(1 << 20), b""):
+                digest.update(chunk)
+        with open(tmp, "ab") as fp:
+            fp.write(_PACK_TRAILER_MAGIC)
+            fp.write(digest.digest())
         os.replace(tmp, path)
     except OSError as exc:
         nn_dbg(f"corpus cache: pack write failed ({exc})\n")

@@ -73,13 +73,34 @@ def _as_int(params: dict, key: str, default: int, floor: int = 0) -> int:
 class JobScheduler:
     def __init__(self, app, job_dir: str, capacity: int = 8,
                  preempt_wait_s: float = 2.0,
-                 auto_promote: bool = False):
+                 auto_promote: bool = False,
+                 auto_resume: bool | None = None,
+                 replicate_to: str | None = None):
+        from ..utils.env import env_float, env_int
+
         self.app = app
         # eval-driven auto-promotion (ISSUE 13 / ROADMAP 2c): after a
         # job lands "done", evaluate the candidate generation against
         # the pre-job baseline on a held-out test dir and promote /
         # roll back automatically (operator endpoints still override)
         self.auto_promote = bool(auto_promote)
+        # lease-based auto-resume (ISSUE 14): interrupted jobs (crash
+        # recovery, expired leases) are re-queued from their newest
+        # VERIFIED local-or-replicated bundle, bounded by a retry
+        # budget with jittered backoff, then failed with a reason
+        if auto_resume is None:
+            auto_resume = os.environ.get("HPNN_JOB_AUTO_RESUME") == "1"
+        self.auto_resume = bool(auto_resume)
+        # off-host bundle replication destination (--replicate-to):
+        # each job's CheckpointManager ships verified bundles there,
+        # and auto-resume restores from it when the local dir is gone
+        self.replicate_to = replicate_to \
+            or os.environ.get("HPNN_REPLICATE_TO") or None
+        self.lease_s = env_float("HPNN_JOB_LEASE_S", 60.0, lo=1.0)
+        self.max_retries = env_int("HPNN_JOB_MAX_RETRIES", 3, lo=0)
+        self.retry_backoff_s = env_float("HPNN_JOB_RETRY_BACKOFF_S",
+                                         1.0, lo=0.0)
+        self.auto_resumes_total = 0
         self.store = JobStore(job_dir)
         recovered = self.store.recover()
         if recovered:
@@ -87,6 +108,10 @@ class JobScheduler:
                    f"job(s) from {job_dir}: {', '.join(recovered)}\n")
         self.queue = JobQueue(capacity)
         self.preempt_wait_s = float(preempt_wait_s)
+        # auto-resume schedule: job_id -> monotonic due time (jittered
+        # exponential in the job's persisted retry count)
+        self._resume_due: dict[str, float] = {}
+        self._resume_last_scan = 0.0
         self._mu = threading.Lock()
         self._current: JobState | None = None
         self._current_stop: threading.Event | None = None
@@ -244,6 +269,14 @@ class JobScheduler:
     # --- worker -----------------------------------------------------------
     def _loop(self) -> None:
         while not self._closed:
+            if self.auto_resume:
+                try:
+                    self._auto_resume_tick()
+                except Exception as exc:  # noqa: BLE001 -- the tick is
+                    # recovery machinery; it must never kill the worker
+                    nn_warn(f"jobs: auto-resume tick error (loop "
+                            f"continues): {type(exc).__name__}: "
+                            f"{exc}\n")
             job = self.queue.take(timeout_s=0.1)
             if job is None:
                 continue
@@ -288,6 +321,135 @@ class JobScheduler:
                     # latch -- the job is terminal, drop it
                     self._pending_cancel.discard(job.job_id)
 
+    # --- lease-based auto-resume (ISSUE 14) -------------------------------
+    def _auto_resume_tick(self) -> None:
+        """One recovery scan (throttled; runs on the worker thread
+        between queue polls): expired-lease actives are recovered to
+        ``interrupted``, interrupted jobs are scheduled for re-queue
+        under the retry budget, and due schedules fire."""
+        now = time.monotonic()
+        if now - self._resume_last_scan < 0.25:
+            return
+        self._resume_last_scan = now
+        if self._draining or self._closed or self._paused:
+            return
+        lease_now = time.time()  # leases are persisted wall-clock
+        with self._mu:
+            current = self._current.job_id if self._current else None
+        candidates = self.store.scan_recovery()
+        if not candidates:
+            self._resume_due.clear()  # nothing interrupted remains
+            return
+        for job in candidates:
+            job_id = job.job_id
+            if job_id == current:
+                continue
+            if (job.status in ("running", "snapshotting")
+                    and job.lease_expires
+                    and lease_now > job.lease_expires):
+                # an active record nobody is driving: the owner died
+                # without even the restart-recovery path running (e.g.
+                # a shared job dir whose other host is gone)
+                nn_warn(f"jobs: {job_id} lease expired "
+                        f"{lease_now - job.lease_expires:.1f}s ago; "
+                        "recovering to interrupted\n")
+                self.store.update(job, status="interrupted",
+                                  error="lease expired")
+                nn_log.nn_event("job_lease_expired", job=job_id,
+                                kernel=job.kernel)
+            if job.status != "interrupted":
+                self._resume_due.pop(job_id, None)
+                continue
+            if job.job_id in self._resume_due:
+                if now >= self._resume_due[job_id]:
+                    self._resume_due.pop(job_id, None)
+                    self._try_auto_resume(job)
+                continue
+            if job.retries >= self.max_retries:
+                self.store.update(
+                    job, status="failed",
+                    error=f"auto-resume retry budget exhausted "
+                          f"({job.retries}/{self.max_retries})",
+                    finished=time.time())
+                nn_log.nn_event("job_auto_resume_failed", job=job_id,
+                                kernel=job.kernel, retries=job.retries)
+                nn_warn(f"jobs: {job_id} failed: auto-resume retry "
+                        f"budget exhausted "
+                        f"({job.retries}/{self.max_retries})\n")
+                continue
+            import random
+
+            delay = (self.retry_backoff_s * (2.0 ** job.retries)
+                     * (0.5 + random.random()))
+            self._resume_due[job_id] = now + delay
+
+    def _newest_intact_bundle(self, ckpt_dir: str):
+        """(bundle path, epoch) of the newest VERIFIED bundle, without
+        materializing the weight arrays -- the actual state load
+        happens once, inside train_job's resume path."""
+        import json as _json
+
+        from .. import ckpt
+
+        for bundle in ckpt.candidate_bundles(ckpt_dir):
+            ok, reason = ckpt.verify_bundle(bundle)
+            if not ok:
+                nn_log.nn_event("ckpt_fallback", bundle=bundle,
+                                reason=reason)
+                continue
+            try:
+                with open(os.path.join(bundle,
+                                       "snapshot.json")) as fp:
+                    meta = _json.load(fp)
+                return bundle, int(meta.get("epoch", 0))
+            except (OSError, ValueError, UnicodeDecodeError):
+                continue
+        return None, 0
+
+    def _try_auto_resume(self, job: JobState) -> None:
+        """Re-queue one interrupted job from its newest VERIFIED
+        bundle: the local checkpoint dir's last-good-fallback walk
+        first, the replica destination when nothing local is intact.
+        A job with no intact bundle anywhere restarts from scratch --
+        the trajectory is deterministic, so the final kernel is
+        byte-identical either way."""
+        ckpt_dir = job.ckpt_dir
+        bundle, epoch = (None, 0)
+        if os.path.isdir(ckpt_dir):
+            bundle, epoch = self._newest_intact_bundle(ckpt_dir)
+        if bundle is None and self.replicate_to:
+            from ..ckpt.replicate import restore_bundle, resolve_scope
+
+            with nn_log.capture():  # restore warnings belong to the
+                # event stream, not the serve console
+                restored = restore_bundle(
+                    self.replicate_to, resolve_scope(ckpt_dir),
+                    ckpt_dir, auth_token=self.app.auth_token)
+            if restored is not None:
+                bundle, epoch = self._newest_intact_bundle(ckpt_dir)
+        resume_from = ckpt_dir if bundle is not None else None
+        self.store.update(job, status="queued", retries=job.retries + 1,
+                          epoch=epoch, auto_resume_from=resume_from,
+                          error=None, lease_expires=0.0)
+        try:
+            self.queue.submit(job)
+        except JobQueueFull:
+            # the queue is busy: back off and try again on a later
+            # scan WITHOUT burning retry budget (nothing was attempted)
+            self.store.update(job, status="interrupted",
+                              retries=job.retries - 1,
+                              error="auto-resume deferred (queue full)")
+            return
+        self.auto_resumes_total += 1
+        nn_log.nn_event("job_auto_resume", job=job.job_id,
+                        kernel=job.kernel, retry=job.retries,
+                        from_epoch=epoch,
+                        verified_bundle=os.path.basename(bundle)
+                        if bundle else None)
+        nn_out(f"jobs: {job.job_id} auto-resumed (attempt "
+               f"{job.retries}/{self.max_retries}) from "
+               f"{'epoch %d' % epoch if bundle else 'scratch'}\n")
+
     def _run_job(self, job: JobState, stop: threading.Event) -> None:
         # one trace per job, keyed by the job id itself: every epoch
         # span, snapshot write and hot swap on this (scheduler) thread
@@ -314,25 +476,32 @@ class JobScheduler:
             model.weights()
             self.store.update(job,
                               baseline_generation=model.generation)
-        self.store.update(job, status="running", started=time.time())
+        self.store.update(job, status="running", started=time.time(),
+                          lease_expires=time.time() + self.lease_s)
         ckpt_dir = job.ckpt_dir
         watch_state = {"gen": 0}
-        resume = (job.resumed_from and ckpt_dir) or None
+        resume = job.auto_resume_from \
+            or ((job.resumed_from and ckpt_dir) or None)
 
         def on_epoch(epoch: int, manager) -> None:
             due = (manager is not None and manager.every
                    and epoch % manager.every == 0) or epoch >= job.epochs
             errors = list(manager.errors) if manager is not None else []
+            # the epoch boundary IS the lease heartbeat: a record whose
+            # lease lapses this far means the driving process died
+            lease = time.time() + self.lease_s
             if due and manager is not None:
                 # snapshotting: the async bundle write must be durable
                 # before the registry swaps it in
                 self.store.update(job, status="snapshotting",
-                                  epoch=epoch, errors=errors)
+                                  epoch=epoch, errors=errors,
+                                  lease_expires=lease)
                 manager.flush()
                 self._reload_into_serving(job, ckpt_dir, watch_state)
                 self.store.update(job, status="running")
             else:
-                self.store.update(job, epoch=epoch, errors=errors)
+                self.store.update(job, epoch=epoch, errors=errors,
+                                  lease_expires=lease)
             self._yield_to_eval(stop)
 
         entries: list = []
@@ -342,7 +511,9 @@ class JobScheduler:
                 ckpt_every=job.params.get("ckpt_every", 1),
                 ckpt_keep=job.params.get("ckpt_keep", 0),
                 kernel_out=job.kernel_out, resume=resume,
-                stop=stop, on_epoch=on_epoch)
+                stop=stop, on_epoch=on_epoch,
+                replicate_to=self.replicate_to,
+                auth_token=self.app.auth_token)
         self._write_console(job, entries)
         # record_final bumped the manifest generation: swap the finished
         # kernel in (same weights as the last bundle, but the bump keeps
@@ -358,7 +529,7 @@ class JobScheduler:
         self.store.update(job, status=status, error=error,
                           epoch=result["epoch"],
                           errors=list(result["errors"]),
-                          finished=time.time())
+                          finished=time.time(), lease_expires=0.0)
         nn_out(f"jobs: {job.job_id} {status} at epoch "
                f"{result['epoch']}/{job.epochs}\n")
         if status == "done" and self.auto_promote:
@@ -645,4 +816,5 @@ class JobScheduler:
             "running": running,
             "by_status": self.store.by_status(),
             "trained_epochs_total": self.store.trained_epochs(),
+            "auto_resumes_total": self.auto_resumes_total,
         }

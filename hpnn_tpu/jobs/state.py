@@ -79,6 +79,15 @@ class JobState:
     #                                  (what --auto-promote compares
     #                                  the candidate against)
     resumed_from: str | None = None  # prior job id (resume submits)
+    # lease-based auto-resume (ISSUE 14): a running job's lease is
+    # refreshed at every epoch boundary (HPNN_JOB_LEASE_S); a job whose
+    # record says active but whose lease expired has a dead owner and
+    # is recovered to interrupted, and interrupted jobs are re-queued
+    # from their newest VERIFIED bundle under a bounded retry budget
+    # (HPNN_JOB_MAX_RETRIES, jittered backoff, then failed)
+    lease_expires: float = 0.0       # wall clock (persisted timestamp)
+    retries: int = 0                 # auto-resume attempts so far
+    auto_resume_from: str | None = None  # ckpt dir/bundle to resume from
     created: float = 0.0
     started: float = 0.0
     finished: float = 0.0
@@ -214,6 +223,17 @@ class JobStore:
     def list(self) -> list[dict]:
         with self._mu:
             return [self._jobs[j].to_dict() for j in sorted(self._jobs)]
+
+    def scan_recovery(self) -> list[JobState]:
+        """The records the auto-resume tick cares about (active or
+        interrupted), as LIVE objects in id order -- the idle tick
+        must not pay a per-job ``asdict`` + ``isfile`` snapshot four
+        times a second under the lock the training thread needs."""
+        with self._mu:
+            return [self._jobs[j] for j in sorted(self._jobs)
+                    if self._jobs[j].status in ("running",
+                                                "snapshotting",
+                                                "interrupted")]
 
     def trained_epochs(self) -> int:
         """Cumulative epochs trained across all jobs -- in-memory fields

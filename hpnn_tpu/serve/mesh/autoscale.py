@@ -35,6 +35,15 @@ this backlog needs" -- and then nobody consumed it.
   ``HPNN_AUTOSCALE_WORKER``) in its environment -- the k8s/slurm/etc.
   integration point; the supervisor still does the pool-side drain
   bookkeeping either way.
+* **exec-hook ack** (ISSUE 14 satellite) -- a hook exiting 0 proves
+  the COMMAND ran, not that the fleet scaled.  Each hook action now
+  awaits observable confirmation within ``HPNN_AUTOSCALE_CONFIRM_S``:
+  a spawn is confirmed by a NEW worker registration, a retire by the
+  victim's goodbye heartbeat (or its table entry disappearing).  An
+  unconfirmed action is counted (``unconfirmed_total``), evented
+  (``autoscale_unconfirmed``), undone pool-side (a stranded retiring
+  victim goes back into routing) and retried after the normal
+  cooldown; no second action starts while a confirmation is pending.
 
 Every action is a ``mesh_event`` (console line / JSON / recorder span
 under trace id "mesh"), and the supervisor's counters ride the
@@ -111,6 +120,14 @@ class WorkerSupervisor:
         self._managed: list[_Managed] = []
         self._mu = threading.Lock()
         self._last_action = 0.0  # monotonic; 0 = act immediately
+        # exec-hook ack (ISSUE 14): one pending confirmation record
+        # {"action", "worker", "deadline", "baseline"} -- no further
+        # actions until it confirms or expires
+        self.confirm_s = env_float("HPNN_AUTOSCALE_CONFIRM_S", 30.0,
+                                   lo=0.1)
+        self._pending_confirm: dict | None = None
+        self.confirmed_total = 0
+        self.unconfirmed_total = 0
         self.spawns_total = 0
         self.retires_total = 0
         self._closed = False
@@ -157,6 +174,8 @@ class WorkerSupervisor:
         it did).  Public so tests and benches can drive the loop
         deterministically."""
         self._reap()
+        if not self._check_confirm():
+            return None  # a hook action is still awaiting its ack
         snap = self.app.autoscale_snapshot()
         desired = max(self.min_workers,
                       min(int(snap["desired_workers"]),
@@ -174,6 +193,50 @@ class WorkerSupervisor:
                 self._last_action = time.monotonic()
                 return "retire"
         return None
+
+    def _check_confirm(self) -> bool:
+        """Resolve the pending exec-hook confirmation, if any.  Returns
+        True when the loop is free to act (nothing pending)."""
+        pending = self._pending_confirm
+        if pending is None:
+            return True
+        addrs = {w.addr: w for w in self.pool.workers()}
+        confirmed = False
+        if pending["action"] == "spawn":
+            # a registration we had not seen at hook time IS the ack
+            confirmed = any(a not in pending["baseline"]
+                            for a in addrs)
+        else:
+            victim = pending["worker"]
+            w = addrs.get(victim)
+            confirmed = w is None or w.goodbye
+        if confirmed:
+            self._pending_confirm = None
+            self.confirmed_total += 1
+            mesh_event("autoscale_confirmed",
+                       f"autoscale: exec hook {pending['action']} "
+                       "confirmed\n", level="dbg",
+                       action=pending["action"],
+                       **({"worker": pending["worker"]}
+                          if pending.get("worker") else {}))
+            return True
+        if time.monotonic() < pending["deadline"]:
+            return False  # still inside the confirmation window
+        # expired unconfirmed: count, event, undo pool-side bookkeeping
+        # and let the ordinary cooldown gate the retry
+        self._pending_confirm = None
+        self.unconfirmed_total += 1
+        if pending["action"] == "retire" and pending.get("worker"):
+            # the victim never left: back into routing it goes
+            self.pool.unretire(pending["worker"])
+        mesh_event("autoscale_unconfirmed",
+                   f"autoscale: exec hook {pending['action']} "
+                   f"UNCONFIRMED after {self.confirm_s:g}s; will retry "
+                   "after cooldown\n", level="warn",
+                   action=pending["action"], confirm_s=self.confirm_s,
+                   **({"worker": pending["worker"]}
+                      if pending.get("worker") else {}))
+        return True
 
     def _reap(self) -> None:
         """Forget managed workers whose process already exited (crash,
@@ -324,6 +387,11 @@ class WorkerSupervisor:
                    HPNN_AUTOSCALE_DESIRED=str(desired))
         if worker is not None:
             env["HPNN_AUTOSCALE_WORKER"] = worker
+        # snapshot the baseline BEFORE the hook runs: a blocking hook
+        # ("scale && wait-for-ready") can let the new worker register
+        # while the command is still executing, and that registration
+        # must count as the confirmation, not as pre-existing
+        baseline = {w.addr for w in self.pool.workers()}
         try:
             rc = subprocess.call(self.exec_hook, shell=True, env=env,
                                  timeout=60.0)
@@ -338,9 +406,18 @@ class WorkerSupervisor:
             self.spawns_total += 1
         else:
             self.retires_total += 1
+        # the ack (ISSUE 14): rc 0 only proves the command ran; hold
+        # further actions until the fleet OBSERVABLY changed (a new
+        # registration / the victim's goodbye) or the window expires
+        self._pending_confirm = {
+            "action": action,
+            "worker": worker,
+            "deadline": time.monotonic() + self.confirm_s,
+            "baseline": baseline,
+        }
         mesh_event(f"autoscale_{action}",
                    f"autoscale: exec hook {action} "
-                   f"(desired {desired})\n",
+                   f"(desired {desired}; awaiting confirmation)\n",
                    desired=desired, hook=True,
                    **({"worker": worker} if worker else {}))
         return True
@@ -349,10 +426,16 @@ class WorkerSupervisor:
     def snapshot(self) -> dict:
         with self._mu:
             managed = len(self._managed)
+        pending = self._pending_confirm
         return {"managed": managed,
                 "min_workers": self.min_workers,
                 "max_workers": self.max_workers,
                 "cooldown_s": self.cooldown_s,
                 "spawns_total": self.spawns_total,
                 "retires_total": self.retires_total,
-                "exec_hook": bool(self.exec_hook)}
+                "exec_hook": bool(self.exec_hook),
+                "confirm_s": self.confirm_s,
+                "confirmed_total": self.confirmed_total,
+                "unconfirmed_total": self.unconfirmed_total,
+                "pending_confirm": (pending["action"] if pending
+                                    else None)}

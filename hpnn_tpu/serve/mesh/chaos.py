@@ -25,12 +25,35 @@ fleet produces:
   latency     an injected delay before the request proceeds normally
   ========== ==========================================================
 
+**IO fault domain** (ISSUE 14): the same deterministic schedules drive
+DISK failures under every durable write -- ``io/atomic.py`` and the
+checkpoint bundle writer consult :func:`pick_io` with the destination
+path, so the snapshot-retry / verified-resume / last-good-fallback
+machinery is testable without a real failing disk:
+
+  ========== ==========================================================
+  enospc      the write raises ``OSError(ENOSPC)`` (disk full)
+  eio         the write raises ``OSError(EIO)`` (generic IO error)
+  torn        only the first half of the payload reaches the file,
+              SILENTLY -- the torn-page/partial-write crash artifact
+              that only content verification can catch
+  bitflip     one deterministic bit of the payload is flipped before
+              the write -- silent media corruption
+  latency     an injected delay before the write proceeds normally
+  ========== ==========================================================
+
 Spec grammar (``HPNN_FAULT`` env var, or :func:`configure`)::
 
     spec  := rule (';' rule)*
     rule  := kind ['@' substr] [':' key '=' val (',' key '=' val)*]
     kind  := reset | reset-after | timeout | truncate | http | latency
-    keys  := side=S     client (default: injected in mesh.transport
+             | enospc | eio | torn | bitflip
+    keys  := domain=D   mesh (default: the HTTP plumbing) or io (durable
+                        writes through io.atomic / the snapshot writer;
+                        ``@substr`` then matches the FILE path).  The
+                        enospc/eio/torn/bitflip kinds are io-only;
+                        reset/timeout/truncate/http are mesh-only
+             side=S     client (default: injected in mesh.transport
                         below every outgoing RPC) or server (injected
                         in the worker's OWN response path -- fabricated
                         5xx, half-written responses, latency, aborted
@@ -69,28 +92,40 @@ from ...utils.nn_log import nn_dbg, nn_warn
 
 KINDS = ("reset", "reset-after", "timeout", "truncate", "http",
          "latency")
+# io-domain kinds (disk faults under io.atomic / the snapshot writer)
+IO_KINDS = ("enospc", "eio", "torn", "bitflip", "latency")
 
 _INT_KEYS = ("after", "every", "times", "seed", "code")
 _FLOAT_KEYS = ("p", "ms", "gap_ms")
-_STR_KEYS = ("side",)
+_STR_KEYS = ("side", "domain")
 SIDES = ("client", "server")
+DOMAINS = ("mesh", "io")
 
 
 class FaultRule:
     """One parsed rule + its live schedule state."""
 
     __slots__ = ("kind", "match", "after", "every", "times", "p",
-                 "seed", "ms", "code", "gap_ms", "side", "calls",
-                 "fired", "_rng", "_t_last_fire")
+                 "seed", "ms", "code", "gap_ms", "side", "domain",
+                 "calls", "fired", "_rng", "_t_last_fire")
 
     def __init__(self, kind: str, match: str | None = None,
                  after: int = 0, every: int = 1, times: int = 0,
                  p: float = 1.0, seed: int = 0, ms: float = 100.0,
                  code: int = 503, gap_ms: float = 0.0,
-                 side: str = "client"):
-        if kind not in KINDS:
-            raise ValueError(f"unknown fault kind {kind!r} "
-                             f"(one of {', '.join(KINDS)})")
+                 side: str = "client", domain: str | None = None):
+        if domain is None:
+            # the io-only kinds imply their domain, so a spec like
+            # "enospc@state.npz" works without an explicit domain=io
+            domain = "io" if kind in IO_KINDS and kind not in KINDS \
+                else "mesh"
+        if domain not in DOMAINS:
+            raise ValueError(f"domain must be one of "
+                             f"{', '.join(DOMAINS)}: {domain!r}")
+        valid = IO_KINDS if domain == "io" else KINDS
+        if kind not in valid:
+            raise ValueError(f"unknown fault kind {kind!r} for domain "
+                             f"{domain} (one of {', '.join(valid)})")
         if every < 1:
             raise ValueError("every must be >= 1")
         if not 0.0 <= p <= 1.0:
@@ -98,6 +133,7 @@ class FaultRule:
         if side not in SIDES:
             raise ValueError(f"side must be one of {', '.join(SIDES)}: "
                              f"{side!r}")
+        self.domain = domain
         self.kind = kind
         self.match = match or None
         self.after = int(after)
@@ -145,6 +181,7 @@ class FaultRule:
                 "after": self.after, "every": self.every,
                 "times": self.times, "gap_ms": self.gap_ms,
                 "p": self.p, "seed": self.seed, "side": self.side,
+                "domain": self.domain,
                 "calls": self.calls, "fired": self.fired}
 
 
@@ -235,13 +272,63 @@ def pick(path: str, side: str = "client") -> FaultRule | None:
         return None
     with _lock:
         for rule in _rules or ():
-            if rule.side != side:
+            if rule.domain != "mesh" or rule.side != side:
                 continue
             if rule.should_fire(path):
                 nn_dbg(f"chaos: injecting {rule.kind} on {path} "
                        f"({side}-side, fired {rule.fired})\n")
                 return rule
     return None
+
+
+def pick_io(path: str) -> FaultRule | None:
+    """The io-domain injection hook: the first ``domain=io`` rule whose
+    schedule fires for this FILE path, or None.  Consulted by
+    ``io.atomic`` and the checkpoint bundle writer below every durable
+    write; same zero-cost-off contract as :func:`pick`."""
+    if _rules is None:
+        _configure_from_env()
+    if not _armed:
+        return None
+    with _lock:
+        for rule in _rules or ():
+            if rule.domain != "io":
+                continue
+            if rule.should_fire(path):
+                nn_dbg(f"chaos: injecting {rule.kind} on {path} "
+                       f"(io-domain, fired {rule.fired})\n")
+                return rule
+    return None
+
+
+def apply_io_fault(rule: FaultRule, path: str, data: bytes) -> bytes:
+    """Apply one fired io-domain rule to a pending write of ``data`` at
+    ``path``: raise for enospc/eio, sleep for latency, and return the
+    (possibly corrupted) payload the writer should actually put on
+    disk -- ``torn`` drops the second half, ``bitflip`` flips one
+    deterministic bit (position keyed by the rule's seed + fire
+    count, so schedules are exactly reproducible)."""
+    import errno
+    import time
+
+    if rule.kind == "enospc":
+        raise OSError(errno.ENOSPC,
+                      f"chaos: injected ENOSPC writing {path}")
+    if rule.kind == "eio":
+        raise OSError(errno.EIO, f"chaos: injected EIO writing {path}")
+    if rule.kind == "latency":
+        time.sleep(rule.ms / 1e3)
+        return data
+    if rule.kind == "torn":
+        return data[:len(data) // 2]
+    if rule.kind == "bitflip":
+        if not data:
+            return data
+        pos = (rule.seed * 2654435761 + rule.fired) % (len(data) * 8)
+        buf = bytearray(data)
+        buf[pos // 8] ^= 1 << (pos % 8)
+        return bytes(buf)
+    return data  # pragma: no cover - exhaustive over IO_KINDS
 
 
 def stats() -> dict:

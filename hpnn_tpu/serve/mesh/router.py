@@ -30,6 +30,7 @@ generations under one label longer than the broadcast takes, and
 from __future__ import annotations
 
 import hashlib
+import os
 import threading
 import time
 from collections import OrderedDict
@@ -117,7 +118,7 @@ class Worker:
 
     __slots__ = ("wid", "addr", "state", "fails", "inflight", "routed",
                  "failovers", "kernels", "created_at", "last_seen",
-                 "jobs", "retired_at")
+                 "jobs", "retired_at", "goodbye")
 
     def __init__(self, addr: str):
         self.wid = addr  # the advertised addr IS the identity
@@ -132,6 +133,7 @@ class Worker:
         self.created_at = time.time()  # displayed registration timestamp
         self.last_seen = time.monotonic()
         self.retired_at = 0.0  # monotonic; set when retiring starts
+        self.goodbye = False   # said {"retiring": true} (graceful exit)
 
     def to_dict(self) -> dict:
         d = {"addr": self.addr, "state": self.state,
@@ -212,6 +214,7 @@ class WorkerPool:
                 if (time.monotonic() - w.retired_at
                         > self.retire_grace_s):
                     w.state = STATE_LIVE
+                    w.goodbye = False  # this is a fresh process
                     mesh_event("worker_readmitted",
                                f"mesh: worker {addr} readmitted "
                                "(re-registration after retirement)\n",
@@ -292,6 +295,11 @@ class WorkerPool:
         half of drain-then-SIGTERM.  False for unknown workers."""
         with self._lock:
             w = self._workers.get(addr)
+            if w is not None and via == "goodbye":
+                # the exec-hook ack (ISSUE 14 satellite): an observed
+                # goodbye is the confirmation a hook-driven retire
+                # really happened on the external system
+                w.goodbye = True
             if w is None or w.state == STATE_RETIRING:
                 return w is not None
             w.state = STATE_RETIRING
@@ -479,6 +487,22 @@ class MeshRouter:
         # computed at: recomputed (one file read + hash) after a reload
         self._blob_meta: dict[str, tuple[int, dict]] = {}
         self._blob_lock = threading.Lock()
+        # replicated checkpoint bundles (ISSUE 14): training hosts POST
+        # packed bundles to /v1/mesh/bundle; the bytes live in the
+        # content-addressed BlobStore the weight distribution uses (a
+        # recovering host pulls them back over GET /v1/mesh/blob/<sha>)
+        # AND in a durable disk spool (HPNN_MESH_BUNDLE_DIR) -- the
+        # whole point of replication is surviving restarts, so LRU
+        # eviction or a router restart must never lose a replica the
+        # shipper was told landed.  The index maps each replication
+        # scope to its bundles (memory first, disk on a cold start).
+        import tempfile
+
+        self._bundle_index: dict[str, list[dict]] = {}
+        self._bundle_lock = threading.Lock()
+        self._bundle_keep = _env_int("HPNN_MESH_BUNDLE_KEEP", 64, lo=1)
+        self.bundle_dir = os.environ.get("HPNN_MESH_BUNDLE_DIR") \
+            or os.path.join(tempfile.gettempdir(), "hpnn-mesh-bundles")
         self.pool = WorkerPool(auth_token=app.auth_token,
                                router_token=self.router_token)
         self.pool.start_health_loop(health_interval_s)
@@ -547,7 +571,103 @@ class MeshRouter:
             meta = self.blob_for(name)
             if meta is not None and meta["sha256"] == sha:
                 return self.blobs.get(sha)
+        # replicated checkpoint bundles have a durable spool the LRU
+        # cannot evict and a restart cannot lose (ISSUE 14)
+        return self.bundle_blob_bytes(sha)
+
+    # --- replicated checkpoint bundles (POST /v1/mesh/bundle) ------------
+    def _bundle_scope_dir(self, scope: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in str(scope))[:64]
+        return os.path.join(self.bundle_dir, safe)
+
+    def store_bundle(self, scope: str, data: bytes, tag: str,
+                     epoch: int) -> dict:
+        """Accept one replicated checkpoint bundle: bytes into the
+        content-addressed blob store AND the durable disk spool
+        (``HPNN_MESH_BUNDLE_DIR``) -- a replica the shipper was told
+        landed must survive LRU eviction and a router restart.  The
+        per-scope index (newest last, bounded to
+        ``HPNN_MESH_BUNDLE_KEEP``, pruned bundles unlinked) is kept in
+        memory and mirrored to the spool's ``index.json``.  Returns
+        the ``{sha256, size}`` the shipper verifies against its own
+        digest; the disk write is part of the contract -- a spool
+        failure fails the request so the shipper retries instead of
+        trusting a volatile copy."""
+        from ...ckpt import replicate as ckpt_replicate
+
+        sha = hashlib.sha256(data).hexdigest()
+        sdir = self._bundle_scope_dir(scope)
+        ckpt_replicate.write_scope_blob(sdir, data, sha)
+        meta = self.blobs.put(data)
+        entry = {"sha256": meta["sha256"], "size": meta["size"],
+                 "tag": str(tag), "epoch": int(epoch),
+                 "stored_at": time.time()}
+        with self._bundle_lock:
+            # the shared spool protocol (ckpt/replicate.py): dedup,
+            # sort, trim to keep-N, atomic index.json, unlink pruned
+            self._bundle_index[str(scope)] = \
+                ckpt_replicate.update_scope_index(sdir, entry,
+                                                  self._bundle_keep)
+        mesh_event("bundle_replicated",
+                   f"mesh: stored replicated bundle {tag} "
+                   f"(scope {scope}, {meta['size']} B)\n",
+                   level="dbg", scope=str(scope), tag=str(tag),
+                   epoch=int(epoch), sha256=meta["sha256"])
+        return meta
+
+    def _load_scope_index_locked(self, scope: str) -> list[dict]:
+        """The live per-scope index; a cold start (empty memory) reads
+        the spool's index.json so replicas survive router restarts."""
+        index = self._bundle_index.get(scope)
+        if index is not None:
+            return index
+        from ...ckpt.replicate import read_scope_index
+
+        index = self._bundle_index[scope] = read_scope_index(
+            self._bundle_scope_dir(scope))
+        return index
+
+    def bundle_list(self, scope: str) -> list[dict]:
+        with self._bundle_lock:
+            return [dict(e)
+                    for e in self._load_scope_index_locked(str(scope))]
+
+    def bundle_blob_bytes(self, sha: str) -> bytes | None:
+        """Spool fallback for ``GET /v1/mesh/blob/<sha>``: a bundle
+        evicted from the LRU (or stored by a previous router process)
+        is re-read from disk, re-verified, and re-inserted."""
+        if not sha or not all(c in "0123456789abcdef" for c in sha):
+            return None
+        with self._bundle_lock:
+            scopes = list(self._bundle_index)
+        try:
+            disk_scopes = os.listdir(self.bundle_dir)
+        except OSError:
+            disk_scopes = []
+        for sdir in {*(self._bundle_scope_dir(s) for s in scopes),
+                     *(os.path.join(self.bundle_dir, d)
+                       for d in disk_scopes)}:
+            path = os.path.join(sdir, f"{sha}.bundle")
+            try:
+                with open(path, "rb") as fp:
+                    data = fp.read()
+            except OSError:
+                continue
+            if hashlib.sha256(data).hexdigest() != sha:
+                nn_warn(f"mesh: spooled bundle {path} fails its "
+                        "sha256; ignoring\n")
+                continue
+            self.blobs.put(data)
+            return data
         return None
+
+    def bundle_stats(self) -> dict:
+        with self._bundle_lock:
+            return {"scopes": len(self._bundle_index),
+                    "bundles": sum(len(v) for v in
+                                   self._bundle_index.values()),
+                    "spool_dir": self.bundle_dir}
 
     # --- registration (POST /v1/mesh/register) ---------------------------
     def register_worker(self, addr: str, kernels: dict | None,
@@ -716,5 +836,6 @@ class MeshRouter:
                 "workers": table,
                 "fleet_collector": self.fleet.stats(),
                 "blobs": self.blobs.stats(),
+                "bundles": self.bundle_stats(),
                 "transport": transport.default_pool().stats(),
                 "standby": self.standby_addr}

@@ -82,6 +82,13 @@ class StandbyMonitor:
             or os.environ.get("HPNN_MESH_BLOB_DIR") \
             or os.path.join(tempfile.gettempdir(),
                             f"hpnn-blobs-{os.getpid()}")
+        # runtime re-pairing (ISSUE 14 satellite): the address THIS
+        # standby answers at, advertised on every mirror poll
+        # (X-HPNN-Standby) so a surviving ACTIVE router adopts a
+        # freshly started standby without a restart -- its next
+        # registration acks then tell every worker where the new
+        # standby is.  Set by the serve CLI once the socket is bound.
+        self.advertise: str | None = None
         self.passive = True
         self.misses = 0
         self.mirrors_total = 0
@@ -101,6 +108,10 @@ class StandbyMonitor:
         headers = {}
         if self.app.auth_token:
             headers["Authorization"] = f"Bearer {self.app.auth_token}"
+        if self.advertise:
+            # announce ourselves: a surviving active router adopts this
+            # standby at runtime and re-advertises the pair to workers
+            headers["X-HPNN-Standby"] = self.advertise
         try:
             status, body = get_json(self.primary, "/v1/mesh/state",
                                     timeout_s=3.0, headers=headers)

@@ -151,9 +151,22 @@ class WorkerAgent:
         self._warned = False
         self.registered = True
         self._backoff.reset()
+        # the PAIR follows the acks (ISSUE 14 re-pairing): the router
+        # that just acked is the active half, and whatever standby it
+        # advertises is the other -- so after a takeover + a fresh
+        # standby attaching, failure alternation spans the CURRENT
+        # pair, not the original (possibly long-dead) primary
+        self.router_addr = target
         standby = ack.get("standby")
-        if isinstance(standby, str) and standby:
+        if isinstance(standby, str) and standby and standby != target:
             self.standby = standby
+        elif self.standby == target:
+            # the old standby IS this active router and it advertises
+            # no replacement: the pair is down to one.  A stale
+            # self.standby equal to the target would make alternation
+            # a no-op forever ("other" == target); clear it until a
+            # new standby attaches and the acks re-advertise a pair
+            self.standby = None
         token = ack.get("router_token")
         if isinstance(token, str) and token:
             self.router_token = token

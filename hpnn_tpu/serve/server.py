@@ -157,6 +157,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 import numpy as np
 
+from ..utils.env import env_int
 from ..utils.nn_log import nn_dbg, nn_out
 from .batcher import DeadlineExceeded, MicroBatcher, QueueFull, ServeClosed
 from .mesh import chaos
@@ -480,13 +481,18 @@ class ServeApp:
     # --- online training jobs -------------------------------------------
     def enable_jobs(self, job_dir: str, capacity: int = 8,
                     preempt_wait_s: float = 2.0,
-                    auto_promote: bool = False):
+                    auto_promote: bool = False,
+                    auto_resume: bool | None = None,
+                    replicate_to: str | None = None):
         """Attach the train-while-serving job subsystem (``serve_nn
         --jobs N``): bounded queue + scheduler worker + persistent job
         store under ``job_dir``, with its gauges wired into /metrics.
         ``auto_promote`` (``--auto-promote``) closes ROADMAP 2(c): a
         finished job's candidate generation is evaluated on a held-out
-        test dir and promoted-if-better / rolled back automatically."""
+        test dir and promoted-if-better / rolled back automatically.
+        ``auto_resume``/``replicate_to`` (ISSUE 14): lease-based job
+        auto-resume from the newest verified bundle, and off-host
+        replication of every verified bundle."""
         from ..jobs import JobScheduler
 
         # jobs consume retained generations (rollback, explicit pins,
@@ -494,7 +500,9 @@ class ServeApp:
         self.registry.retain_generations = True
         self.jobs = JobScheduler(self, job_dir, capacity=capacity,
                                  preempt_wait_s=preempt_wait_s,
-                                 auto_promote=auto_promote)
+                                 auto_promote=auto_promote,
+                                 auto_resume=auto_resume,
+                                 replicate_to=replicate_to)
         self.metrics.set_jobs_source(self.jobs.metrics_snapshot)
         return self.jobs
 
@@ -611,7 +619,68 @@ class ServeApp:
         if not self.authorized(headers):
             raise _HTTPError(401, "unauthorized",
                              "missing or invalid auth token")
+        # standby re-pairing (ISSUE 14 satellite): a freshly started
+        # standby announces itself on every mirror poll; an ACTIVE
+        # router adopts it at runtime, so registration acks advertise
+        # the new pair to workers without restarting the survivor.
+        # Same trust model as the mirror itself: behind the auth token
+        # whenever one is configured (the 401 above)
+        standby = (headers.get("X-HPNN-Standby") or "").strip()
+        if standby and not self.standby_passive():
+            host, _, port = standby.rpartition(":")
+            if (host and port.isdigit() and 0 < int(port) < 65536
+                    and self.mesh_router.standby_addr != standby):
+                prev = self.mesh_router.standby_addr
+                self.mesh_router.standby_addr = standby
+                from .mesh.events import mesh_event
+
+                mesh_event("standby_attached",
+                           f"mesh: standby {standby} attached "
+                           f"(replacing {prev or 'none'}); workers "
+                           "learn it from the next heartbeat ack\n",
+                           standby=standby, previous=prev)
         return self.mesh_router.state_snapshot(bool(self.auth_token))
+
+    def handle_mesh_bundle(self, query: str, body: bytes) -> dict:
+        """POST /v1/mesh/bundle?scope=S&tag=T&epoch=N: a training
+        host replicating one packed checkpoint bundle (ISSUE 14).  The
+        bytes land in the router's content-addressed blob store (the
+        shipper verifies the acked sha256 against its own digest); the
+        per-scope index is what a recovering host lists to find the
+        newest replica."""
+        if self.mesh_router is None:
+            raise _HTTPError(503, "mesh_disabled",
+                             "this server is not a mesh router "
+                             "(start serve_nn with --mesh-role router)")
+        if self.standby_passive():
+            raise _HTTPError(503, "standby_passive",
+                             "this router is a passive standby of "
+                             f"{self.mesh_standby.primary}")
+        params = dict(kv.split("=", 1)
+                      for kv in query.split("&") if "=" in kv)
+        scope = params.get("scope") or ""
+        if not scope:
+            raise _HTTPError(400, "bad_request",
+                             "missing 'scope' query parameter")
+        if not body:
+            raise _HTTPError(400, "bad_request", "empty bundle body")
+        max_mb = env_int("HPNN_MESH_BUNDLE_MAX_MB", 256, lo=1)
+        if len(body) > max_mb << 20:
+            raise _HTTPError(413, "too_large",
+                             f"bundle exceeds {max_mb} MB")
+        try:
+            epoch = int(params.get("epoch") or 0)
+        except ValueError:
+            raise _HTTPError(400, "bad_request", "bad 'epoch'")
+        try:
+            return self.mesh_router.store_bundle(
+                scope, body, params.get("tag") or "", epoch)
+        except OSError as exc:
+            # the durable spool write is part of the contract: tell
+            # the shipper honestly so it retries instead of trusting
+            # a volatile copy
+            raise _HTTPError(507, "spool_failure",
+                             f"bundle spool write failed: {exc}")
 
     def enable_autoscale(self, router_addr: str, confs: list[str],
                          min_workers: int = 1, max_workers: int = 4,
@@ -1281,6 +1350,24 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply(exc.status,
                             {"error": str(exc), "reason": exc.outcome})
             return
+        if path == "/v1/mesh/bundles":
+            # the replicated-checkpoint index for one scope (ISSUE 14):
+            # fleet internals, behind the auth token like /v1/mesh/state
+            if not self.app.authorized(self.headers):
+                self._reply(401, {"error": "missing or invalid auth "
+                                  "token", "reason": "unauthorized"})
+                return
+            router = self.app.mesh_router
+            if router is None:
+                self._reply(404, {"error": "not a mesh router",
+                                  "reason": "mesh_disabled"})
+                return
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+            scope = params.get("scope") or ""
+            self._reply(200, {"scope": scope,
+                              "bundles": router.bundle_list(scope)})
+            return
         m = _BLOB_RE.match(path)
         if m is not None:
             if not self.app.authorized(self.headers):
@@ -1482,7 +1569,8 @@ class _Handler(BaseHTTPRequestHandler):
         a = _JOB_ACTION_RE.match(path)
         prof = path == "/v1/debug/profile"
         mesh_reg = path == "/v1/mesh/register"
-        if (r or t or a or prof or mesh_reg) \
+        bundle = path == "/v1/mesh/bundle"
+        if (r or t or a or prof or mesh_reg or bundle) \
                 and not self.app.authorized(self.headers):
             # every mutating endpoint sits behind the auth token when
             # one is configured; infer/metrics/healthz stay open
@@ -1493,6 +1581,16 @@ class _Handler(BaseHTTPRequestHandler):
         if mesh_reg:
             try:
                 out = self.app.handle_mesh_register(body)
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+                return
+            self._reply(200, out)
+            return
+        if bundle:
+            try:
+                out = self.app.handle_mesh_bundle(
+                    self.path.partition("?")[2], body)
             except _HTTPError as exc:
                 self._reply(exc.status,
                             {"error": str(exc), "reason": exc.outcome})

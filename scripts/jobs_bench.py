@@ -15,7 +15,14 @@ Protocol (bench.py honesty rules):
   a nice-to-have;
 * the row records both phases' latencies, the generation-swap count
   (floor: >= 3), the server's own /metrics jobs + per-generation
-  counters, and the job's final record, so every claim cross-checks.
+  counters, and the job's final record, so every claim cross-checks;
+* phase 3 (ISSUE 14) measures the RECOVERY story end to end: a real
+  ``serve_nn --jobs --job-auto-resume --replicate-to`` subprocess is
+  killed -9 mid-job, the job's newest checkpoint bundle is corrupted,
+  and a restarted server must auto-resume it from the last intact
+  bundle to completion -- the row records kill->done latency,
+  restart->done latency, the replication lag at kill time (in
+  epochs), and asserts zero lost epochs (the job still lands all N).
 
 Self-contained: generates a corpus + kernel in a temp dir, self-hosts
 the server in-process (the same ServeApp serve_nn runs), emits ONE
@@ -28,6 +35,7 @@ import argparse
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import threading
@@ -35,8 +43,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                ".."))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import serve_bench  # noqa: E402
@@ -90,6 +98,141 @@ def _eval_phase(base: str, kernel: str, inputs, sizes, concurrency,
         "p50_ms": round(pct(50) * 1e3, 3),
         "p99_ms": round(pct(99) * 1e3, 3),
     }
+
+
+def _spawn_serve(args, timeout_s=180.0):
+    """One real serve_nn subprocess; returns (proc, port) once its
+    SERVE: listening line lands."""
+    cmd = [sys.executable, "-u",
+           os.path.join(REPO, "apps", "serve_nn.py"),
+           "-p", "0", "--warmup-mode", "off", *args]
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=env)
+    port_box: list = []
+    ready = threading.Event()
+
+    def drain():
+        for line in proc.stdout:
+            if "SERVE: listening on" in line and not port_box:
+                port_box.append(int(line.rsplit(":", 1)[1]))
+                ready.set()
+        ready.set()
+
+    threading.Thread(target=drain, daemon=True).start()
+    if not ready.wait(timeout_s) or not port_box:
+        proc.kill()
+        raise RuntimeError("serve_nn never bound its port")
+    return proc, port_box[0]
+
+
+def _flip_bit(path, pos):
+    data = bytearray(open(path, "rb").read())
+    pos = pos % (len(data) * 8)
+    data[pos // 8] ^= 1 << (pos % 8)
+    open(path, "wb").write(bytes(data))
+
+
+def _recovery_phase(work: str, corpus: str, conf: str,
+                    epochs: int, seed: int) -> dict:
+    """Kill -9 a real auto-resume server mid-job with the newest
+    bundle then corrupted; a restarted server must finish the job from
+    the last intact bundle (ISSUE 14 acceptance as a measured row)."""
+    job_dir = os.path.join(work, "rec_jobs")
+    rep_dir = os.path.join(work, "rec_replica")
+    args = ["--jobs", "2", "--job-dir", job_dir, "--job-auto-resume",
+            "--replicate-to", rep_dir, conf]
+    out: dict = {"epochs": epochs}
+    proc, port = _spawn_serve(args)
+    t_kill = None
+    try:
+        base = f"http://127.0.0.1:{port}"
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/bench/train",
+            {"epochs": epochs, "seed": seed, "train": "BP",
+             "samples": corpus, "ckpt_every": 1})
+        if st != 202:
+            return {"error": f"submit failed: {st} {job}"}
+        jid = job["job_id"]
+        deadline = time.monotonic() + 120
+        snap = {}
+        while time.monotonic() < deadline:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            # epoch k visible => bundle k-1 is durable (the record
+            # bumps before its own epoch's flush)
+            if snap.get("epoch", 0) >= 3 \
+                    or snap.get("status") in ("done", "failed"):
+                break
+            time.sleep(0.01)
+        if snap.get("status") in ("done", "failed"):
+            return {"error": f"job finished before the kill: {snap}"}
+        if snap.get("epoch", 0) < 3:
+            return {"error": "job never reached epoch 3 inside the "
+                    f"poll deadline: {snap}"}
+        kill_epoch = int(snap.get("epoch", 0))
+        t_kill = time.monotonic()
+        proc.kill()
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    ck = os.path.join(job_dir, jid, "ckpt")
+    try:
+        tags = sorted(t for t in os.listdir(ck) if t.startswith("ep"))
+    except OSError as exc:
+        return {"error": f"no checkpoint dir after the kill: {exc}"}
+    if len(tags) < 2:
+        return {"error": f"too few durable bundles at kill: {tags}"}
+    # replication lag at the kill: how many durable local epochs had
+    # not reached the replica yet
+    from hpnn_tpu.ckpt import replicate as repl
+
+    scope = repl.scope_for(ck)
+    replicated = repl.list_replicated(rep_dir, scope)
+    rep_newest = max((e.get("epoch", 0) for e in replicated),
+                     default=0)
+    local_newest = int(tags[-1][2:]) if tags else 0
+    out.update({
+        "kill_epoch": kill_epoch,
+        "local_bundles_at_kill": len(tags),
+        "replica_bundles_at_kill": len(replicated),
+        "replication_lag_epochs": local_newest - rep_newest,
+    })
+    # the crash artifact: newest bundle corrupted -> verified resume
+    # must walk back to the previous intact one
+    _flip_bit(os.path.join(ck, tags[-1], "state.npz"), 8192)
+    proc2, port2 = _spawn_serve(args)
+    t_restart = time.monotonic()
+    try:
+        base = f"http://127.0.0.1:{port2}"
+        deadline = time.monotonic() + 300
+        snap = {}
+        while time.monotonic() < deadline:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            if snap.get("status") in ("done", "failed"):
+                break
+            time.sleep(0.05)
+        t_done = time.monotonic()
+    finally:
+        proc2.terminate()
+        try:
+            proc2.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+    out.update({
+        "job_status": snap.get("status"),
+        "final_epoch": snap.get("epoch"),
+        "retries": snap.get("retries"),
+        "kill_to_done_s": round(t_done - t_kill, 3),
+        "restart_to_done_s": round(t_done - t_restart, 3),
+        # zero lost epochs: the job still landed every one of its N
+        # epochs despite the kill AND the corrupted newest bundle
+        "lost_epochs": epochs - int(snap.get("epoch") or 0),
+    })
+    return out
 
 
 def main() -> int:
@@ -221,10 +364,29 @@ def main() -> int:
             "server_jobs": m.get("jobs"),
             "server_generations": m.get("generations"),
         })
-        ok = (snap["status"] == "done" and dropped == 0 and swaps >= 3)
+        # phase 3 (ISSUE 14): kill -9 -> corrupt newest bundle ->
+        # restart -> lease-based auto-resume from the last intact
+        # bundle, against REAL serve_nn subprocesses
+        rec = _recovery_phase(work, corpus, conf, epochs=args.epochs
+                              + 6, seed=args.seed)
+        row["recovery"] = rec
+        rec_ok = (rec.get("job_status") == "done"
+                  and rec.get("lost_epochs") == 0
+                  and (rec.get("retries") or 0) >= 1
+                  and rec.get("replication_lag_epochs", 99) <= 1)
+        ok = (snap["status"] == "done" and dropped == 0 and swaps >= 3
+              and rec_ok)
         row["floors"] = {"job_done": snap["status"] == "done",
                          "zero_dropped": dropped == 0,
-                         "swaps_ge_3": swaps >= 3}
+                         "swaps_ge_3": swaps >= 3,
+                         "recovered_done": rec.get("job_status")
+                         == "done",
+                         "zero_lost_epochs": rec.get("lost_epochs")
+                         == 0,
+                         "auto_resumed": (rec.get("retries") or 0)
+                         >= 1,
+                         "replication_lag_le_1":
+                         rec.get("replication_lag_epochs", 99) <= 1}
     finally:
         if httpd is not None:
             httpd.shutdown()

@@ -223,6 +223,23 @@ def test_committed_obs_bench_sampled_row_holds_floors():
     assert set(s["statuses"]) == {"200"}
 
 
+def test_committed_jobs_bench_recovery_row_holds_floors():
+    """The committed JOBS_BENCH.json recovery row (ISSUE 14) stays
+    pinned in tier 1: the kill -9 + corrupted-newest-bundle episode
+    really auto-resumed, lost zero epochs, and replication kept pace
+    with the snapshot stream."""
+    art = _load_artifact("JOBS_BENCH.json")
+    assert art["floors"]["recovered_done"] is True
+    rec = art["recovery"]
+    assert rec["job_status"] == "done"
+    assert rec["lost_epochs"] == 0
+    assert rec["retries"] >= 1
+    assert rec["replication_lag_epochs"] <= 1
+    assert rec["local_bundles_at_kill"] >= 2
+    assert rec["kill_to_done_s"] is not None
+    assert rec["restart_to_done_s"] is not None
+
+
 def test_committed_mesh_bench_shed_and_autoscale_rows_hold_floors():
     """The committed MESH_BENCH.json shed + autoscale rows (ISSUE 13)
     stay pinned in tier 1: the chaos 5xx burst engaged and recovered

@@ -444,7 +444,7 @@ def test_json_snapshot_has_exemplars_and_phases():
 # the obs recorder's wall/mono anchor pair):
 _WALL_CLOCK_ALLOWED = re.compile(
     r"(created|started|finished|loaded_at|\"updated\"|wall_base|"
-    r"conf\.seed|int\(time\.time\(\)\))")
+    r"conf\.seed|int\(time\.time\(\)\)|lease|stored_at)")
 
 
 def test_elapsed_time_is_monotonic_in_serve_jobs_ckpt():

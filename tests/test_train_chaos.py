@@ -1,0 +1,850 @@
+"""Fault-tolerant training (ISSUE 14, hpnn_tpu/ckpt + jobs + chaos io
+domain).
+
+The acceptance pins: (1) a training run killed mid-epoch whose NEWEST
+bundle is then corrupted resumes from the last INTACT bundle and still
+lands a byte-identical ``kernel.opt`` + ``-vv`` tail versus the
+uninterrupted run (BP and BPM -- the deterministic trajectory makes
+walking back an epoch free); (2) injected ENOSPC during a snapshot
+never corrupts the manifest; (3) bit-flip fuzz across EVERY bundle
+file is detected -- a corrupted snapshot is never silently loaded;
+(4) a job whose local checkpoint history is gone auto-resumes from the
+off-host replica under the lease/retry machinery.
+"""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import serve_bench  # noqa: E402
+
+from hpnn_tpu import ckpt, cli
+from hpnn_tpu.ckpt import replicate
+from hpnn_tpu.io import corpus as corpus_io
+from hpnn_tpu.models.kernel import generate_kernel
+from hpnn_tpu.serve.mesh import chaos
+from hpnn_tpu.utils import nn_log
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+def _write_corpus(dirpath, rng, n):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n")
+            fp.write(" ".join(f"{v:7.5f}" for v in x) + "\n")
+            fp.write(f"[output] {N_OUT}\n")
+            fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+@pytest.fixture()
+def corpus(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    _write_corpus(tmp_path / "samples", rng, N_SAMP)
+    monkeypatch.chdir(tmp_path)
+    yield tmp_path
+    nn_log.set_verbosity(0)
+    chaos.reset()
+
+
+@pytest.fixture(autouse=True)
+def _disarm_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def _conf(tmp_path, train="BP", seed=1234):
+    text = (
+        "[name] tiny\n[type] ANN\n[init] generate\n"
+        f"[seed] {seed}\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        f"[train] {train}\n"
+        f"[sample_dir] {tmp_path}/samples\n")
+    path = tmp_path / f"nn_{train}.conf"
+    path.write_text(text)
+    return str(path)
+
+
+def _train(args, capsys, env=None):
+    nn_log.set_verbosity(0)
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        rc = cli.train_nn_main(["-vv", *args])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return rc, capsys.readouterr().out
+
+
+def _bundle(tmp_path, epochs=3, seed=5):
+    """A real multi-bundle checkpoint dir built through the public
+    writer (verified bundles + manifest)."""
+    ck = str(tmp_path / "ck")
+    k, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    for ep in range(1, epochs + 1):
+        entry = ckpt.write_snapshot(
+            ck, ep, weights=k.weights, momentum=None, rng_state=None,
+            seed=seed, errors=[0.5 / ep] * ep)
+        ckpt.publish_snapshot(ck, entry, seed=seed,
+                              errors=[0.5 / ep] * ep)
+    return ck
+
+
+def _flip_bit(path, pos=1000):
+    data = bytearray(open(path, "rb").read())
+    pos = pos % (len(data) * 8)
+    data[pos // 8] ^= 1 << (pos % 8)
+    open(path, "wb").write(bytes(data))
+
+
+# --- chaos io domain (grammar + schedules) ----------------------------------
+
+def test_io_domain_grammar_and_sides():
+    rules = chaos.parse_spec(
+        "enospc@state.npz:times=1;bitflip:domain=io;"
+        "latency:domain=io,ms=1;reset@/infer")
+    assert [(r.kind, r.domain) for r in rules] == [
+        ("enospc", "io"), ("bitflip", "io"), ("latency", "io"),
+        ("reset", "mesh")]
+    # io kinds are rejected in the mesh domain and vice versa
+    with pytest.raises(ValueError):
+        chaos.parse_spec("torn:domain=mesh")
+    with pytest.raises(ValueError):
+        chaos.parse_spec("reset:domain=io")
+    with pytest.raises(ValueError):
+        chaos.parse_spec("enospc:domain=bogus")
+
+
+def test_pick_io_is_side_and_domain_scoped():
+    chaos.configure("enospc@target:times=1;reset@target")
+    try:
+        # the mesh rule never fires for io picks and vice versa
+        assert chaos.pick_io("/tmp/other") is None
+        rule = chaos.pick_io("/tmp/target/file")
+        assert rule is not None and rule.kind == "enospc"
+        assert chaos.pick_io("/tmp/target/file") is None  # times=1
+        assert chaos.pick("http://x/target").kind == "reset"
+    finally:
+        chaos.reset()
+
+
+def test_apply_io_fault_kinds(tmp_path):
+    enospc = chaos.FaultRule("enospc", domain="io")
+    with pytest.raises(OSError) as exc:
+        chaos.apply_io_fault(enospc, "f", b"data")
+    assert exc.value.errno == 28  # ENOSPC
+    eio = chaos.FaultRule("eio", domain="io")
+    with pytest.raises(OSError):
+        chaos.apply_io_fault(eio, "f", b"data")
+    torn = chaos.FaultRule("torn", domain="io")
+    assert chaos.apply_io_fault(torn, "f", b"abcdef") == b"abc"
+    flip = chaos.FaultRule("bitflip", domain="io", seed=3)
+    out1 = chaos.apply_io_fault(flip, "f", b"abcdef")
+    assert out1 != b"abcdef" and len(out1) == 6
+    # deterministic: same seed + fire count -> same corruption
+    flip2 = chaos.FaultRule("bitflip", domain="io", seed=3)
+    assert chaos.apply_io_fault(flip2, "f", b"abcdef") == out1
+
+
+def test_atomic_write_consults_io_domain(tmp_path):
+    from hpnn_tpu.io.atomic import atomic_write_bytes
+
+    dest = str(tmp_path / "out.bin")
+    atomic_write_bytes(dest, b"good")
+    chaos.configure("enospc@out.bin:times=1")
+    try:
+        with pytest.raises(OSError):
+            atomic_write_bytes(dest, b"new")
+        # the failed write never touched the published file
+        assert open(dest, "rb").read() == b"good"
+        atomic_write_bytes(dest, b"new")  # times=1: next write lands
+        assert open(dest, "rb").read() == b"new"
+    finally:
+        chaos.reset()
+
+
+# --- verified snapshot writes -----------------------------------------------
+
+def test_enospc_snapshot_write_retries_and_succeeds(tmp_path):
+    chaos.configure("enospc@state.npz:times=1")
+    ck = _bundle(tmp_path, epochs=1)
+    assert chaos.stats()["injected_total"] == 1
+    ok, reason = ckpt.verify_bundle(os.path.join(ck, "ep00000001"))
+    assert ok, reason
+
+
+def test_torn_write_never_publishes_or_poisons_manifest(tmp_path):
+    ck = _bundle(tmp_path, epochs=2)
+    man_before = ckpt.read_manifest(ck)
+    # every attempt torn: the bundle write must FAIL (no silent corrupt
+    # publish) and the manifest must stay exactly as it was
+    chaos.configure("torn@state.npz")
+    k, _ = generate_kernel(5, N_IN, [N_HID], N_OUT)
+    with pytest.raises(OSError):
+        ckpt.write_snapshot(ck, 3, weights=k.weights, momentum=None,
+                            rng_state=None, seed=5, errors=[0.1])
+    chaos.reset()
+    man_after = ckpt.read_manifest(ck)
+    assert man_after is not None
+    assert man_after["generation"] == man_before["generation"]
+    assert man_after["latest"] == "ep00000002"
+    assert sorted(t for t in os.listdir(ck) if t.startswith("ep")) == \
+        ["ep00000001", "ep00000002"]  # no ep3, no tmp litter
+
+
+def test_persistent_bitflip_never_replaces_good_manifest(tmp_path):
+    """A disk that corrupts EVERY write (bitflip, no times cap) must
+    exhaust the manifest writer's retries with the PREVIOUS manifest
+    still published -- the staged bytes are verified BEFORE the
+    replace, never after."""
+    ck = _bundle(tmp_path, epochs=1)
+    man_before = open(os.path.join(ck, "manifest.json"), "rb").read()
+    k, _ = generate_kernel(5, N_IN, [N_HID], N_OUT)
+    entry = ckpt.write_snapshot(ck, 2, weights=k.weights, momentum=None,
+                                rng_state=None, seed=5,
+                                errors=[0.1, 0.2])
+    chaos.configure("bitflip@manifest.json")
+    try:
+        with pytest.raises(OSError):
+            ckpt.publish_snapshot(ck, entry, seed=5, errors=[0.1, 0.2])
+    finally:
+        chaos.reset()
+    assert open(os.path.join(ck, "manifest.json"), "rb").read() \
+        == man_before
+    assert not any(".stage" in n for n in os.listdir(ck))
+
+
+def test_worker_clears_stale_standby_equal_to_active(monkeypatch):
+    """Re-pairing hygiene: after a takeover the surviving router may
+    advertise NO standby; a worker whose remembered standby IS that
+    router must clear it, or failure alternation degenerates to a
+    no-op ('other' == target) forever."""
+    from hpnn_tpu.serve.mesh import worker as worker_mod
+
+    class _Reg:
+        retain_generations = False
+
+        def names(self):
+            return []
+
+    class _App:
+        registry = _Reg()
+        auth_token = None
+        jobs = None
+
+    agent = worker_mod.WorkerAgent(_App(), "127.0.0.1:9001",
+                                   "127.0.0.1:9100", interval_s=60.0)
+    # history: the original primary died, the worker followed its
+    # remembered standby B, which is now the active router
+    agent.standby = "127.0.0.1:9002"
+    agent.current = "127.0.0.1:9002"
+    monkeypatch.setattr(worker_mod, "post_json",
+                        lambda *a, **kw: (200, {"ok": True}, {}))
+    assert agent.beat()
+    assert agent.router_addr == "127.0.0.1:9002"
+    assert agent.standby is None  # no self-alternation possible
+    # and a fresh standby attaching re-pairs via the next ack
+    monkeypatch.setattr(
+        worker_mod, "post_json",
+        lambda *a, **kw: (200, {"ok": True,
+                                "standby": "127.0.0.1:9003"}, {}))
+    assert agent.beat()
+    assert agent.standby == "127.0.0.1:9003"
+    agent.close(goodbye=False)
+
+
+def test_enospc_manifest_write_retries_never_corrupts(tmp_path):
+    ck = _bundle(tmp_path, epochs=1)
+    chaos.configure("enospc@manifest.json:times=1")
+    k, _ = generate_kernel(5, N_IN, [N_HID], N_OUT)
+    entry = ckpt.write_snapshot(ck, 2, weights=k.weights, momentum=None,
+                                rng_state=None, seed=5, errors=[0.1, 0.2])
+    ckpt.publish_snapshot(ck, entry, seed=5, errors=[0.1, 0.2])
+    chaos.reset()
+    man = ckpt.read_manifest(ck)
+    assert man is not None and man["latest"] == "ep00000002"
+    assert chaos.stats()["armed"] is False
+
+
+def test_bundle_fingerprints_cover_every_file(tmp_path):
+    ck = _bundle(tmp_path, epochs=1)
+    meta = json.load(open(os.path.join(ck, "ep00000001",
+                                       "snapshot.json")))
+    prints = meta["fingerprints"]
+    assert set(prints) == {"kernel.opt", "state.npz"}
+    for name, rec in prints.items():
+        assert rec == ckpt.fingerprint_file(
+            os.path.join(ck, "ep00000001", name))
+
+
+# --- bit-flip fuzz: detect-and-fallback never silently loads ----------------
+
+@pytest.mark.parametrize("victim", ["state.npz", "kernel.opt",
+                                    "snapshot.json", "manifest.json"])
+def test_bitflip_fuzz_detect_and_fallback(tmp_path, victim):
+    ck = _bundle(tmp_path, epochs=3)
+    if victim == "manifest.json":
+        # a corrupt manifest must not block resume: the on-disk bundle
+        # walk still finds an intact bundle (conservatively older when
+        # the flip lands in a recorded fingerprint) -- never None,
+        # never garbage
+        _flip_bit(os.path.join(ck, victim), pos=64)
+        with nn_log.capture():
+            snap = ckpt.load_snapshot(ck)
+        assert snap is not None and snap.epoch in (2, 3)
+        return
+    for pos in (0, 997, 40_001, 262_143):
+        shutil.rmtree(ck)
+        ck = _bundle(tmp_path, epochs=3)
+        _flip_bit(os.path.join(ck, "ep00000003", victim), pos=pos)
+        ok, reason = ckpt.verify_bundle(os.path.join(ck, "ep00000003"))
+        assert not ok, (victim, pos)
+        assert victim in reason
+        with nn_log.capture() as entries:
+            snap = ckpt.load_snapshot(ck)
+        # NEVER the corrupted newest: the walk lands on epoch 2
+        assert snap is not None and snap.epoch == 2, (victim, pos)
+        assert any("failed verification" in text
+                   for _lvl, text in entries), (victim, pos)
+
+
+def test_all_bundles_corrupt_is_a_loud_none(tmp_path):
+    ck = _bundle(tmp_path, epochs=2)
+    for tag in ("ep00000001", "ep00000002"):
+        _flip_bit(os.path.join(ck, tag, "state.npz"), pos=900)
+    with nn_log.capture() as entries:
+        assert ckpt.load_snapshot(ck) is None
+    assert any("no INTACT snapshot" in text for _l, text in entries)
+
+
+# --- resume with corrupted newest bundle: byte parity (acceptance) ----------
+
+@pytest.mark.parametrize("train", ["BP", "BPM"])
+def test_kill_corrupt_resume_byte_parity(corpus, capsys, train):
+    """Kill at an epoch boundary, corrupt the NEWEST bundle, resume:
+    the run walks back to the last intact bundle and still finishes
+    byte-identical to the uninterrupted run (kernel.opt AND the -vv
+    stream tail) -- determinism makes the replayed epoch free."""
+    conf = _conf(corpus, train=train)
+    epochs = 3
+
+    os.makedirs("full")
+    os.chdir("full")
+    rc, out_full = _train([f"--epochs={epochs}", "--ckpt-every=1",
+                           "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    full_opt = open("kernel.opt", "rb").read()
+    os.chdir("..")
+
+    os.makedirs("part")
+    os.chdir("part")
+    rc, out_kill = _train([f"--epochs={epochs}", "--ckpt-every=1",
+                           "--ckpt-dir=ck", conf], capsys,
+                          env={"HPNN_CKPT_KILL_AT_EPOCH": "2"})
+    assert rc == 0
+    assert f"CKPT: interrupted at epoch 2/{epochs}" in out_kill
+    # the crash artifact: the newest bundle's state is torn/corrupt
+    _flip_bit("ck/ep00000002/state.npz", pos=4096)
+
+    rc, out_res = _train([f"--epochs={epochs}", "--resume",
+                          "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    part_opt = open("kernel.opt", "rb").read()
+    os.chdir("..")
+
+    assert part_opt == full_opt
+    # resumed from epoch 1 (the intact bundle), NOT the corrupt 2
+    mark = f"NN: EPOCH        2/{epochs:8d}\n"
+    assert mark in out_res
+    assert out_res[out_res.index(mark):] == out_full[out_full.index(mark):]
+
+
+def test_resume_restores_from_replica_when_local_history_lost(
+        corpus, capsys):
+    conf = _conf(corpus)
+    epochs = 2
+    os.makedirs("run")
+    os.chdir("run")
+    rc, out_full = _train([f"--epochs={epochs}", "--ckpt-every=1",
+                           "--ckpt-dir=ck", "--replicate-to=../rep",
+                           conf], capsys)
+    assert rc == 0
+    full_opt = open("kernel.opt", "rb").read()
+    scope = replicate.scope_for("ck")
+    assert os.path.isfile(os.path.join("..", "rep", scope,
+                                       "index.json"))
+    # the disk died: the whole local checkpoint history is gone
+    shutil.rmtree("ck")
+    rc, out_res = _train([f"--epochs={epochs}", "--resume",
+                          "--ckpt-dir=ck", "--replicate-to=../rep",
+                          conf], capsys)
+    assert rc == 0
+    assert open("kernel.opt", "rb").read() == full_opt
+    os.chdir("..")
+
+
+# --- replication ------------------------------------------------------------
+
+def test_pack_unpack_bundle_roundtrip_and_tamper(tmp_path):
+    ck = _bundle(tmp_path, epochs=1)
+    bundle = os.path.join(ck, "ep00000001")
+    blob, meta = replicate.pack_bundle(bundle)
+    assert meta["tag"] == "ep00000001" and meta["epoch"] == 1
+    assert meta["kernel_fingerprint"] == \
+        json.load(open(os.path.join(bundle,
+                                    "snapshot.json")))["fingerprint"]
+    out = replicate.unpack_bundle(blob, str(tmp_path / "restored"))
+    ok, reason = ckpt.verify_bundle(out)
+    assert ok, reason
+    for name in ("kernel.opt", "state.npz", "snapshot.json"):
+        assert open(os.path.join(out, name), "rb").read() == \
+            open(os.path.join(bundle, name), "rb").read()
+    # a tampered blob must refuse to unpack
+    bad = bytearray(blob)
+    bad[len(bad) // 2] ^= 0xFF
+    with pytest.raises(replicate.ReplicateError):
+        replicate.unpack_bundle(bytes(bad), str(tmp_path / "bad"))
+
+
+def test_dir_replication_restore_walks_to_newest_intact(tmp_path):
+    ck = _bundle(tmp_path, epochs=3)
+    rep = replicate.Replicator(str(tmp_path / "rep"), ck)
+    metas = [rep.replicate(os.path.join(ck, f"ep0000000{e}"))
+             for e in (1, 2, 3)]
+    assert all(m is not None for m in metas)
+    assert rep.stats()["shipped_total"] == 3
+    # corrupt the NEWEST replica blob: restore must land epoch 2
+    newest = os.path.join(tmp_path, "rep", rep.scope,
+                          f"{metas[2]['sha256']}.bundle")
+    _flip_bit(newest, pos=5000)
+    with nn_log.capture():
+        out = replicate.restore_bundle(str(tmp_path / "rep"), rep.scope,
+                                       str(tmp_path / "recovered"))
+    assert out is not None and out.endswith("ep00000002")
+    ok, reason = ckpt.verify_bundle(out)
+    assert ok, reason
+
+
+def test_router_replication_roundtrip_over_http(tmp_path, monkeypatch):
+    """http:// destination: the blob lands in the router's
+    content-addressed BlobStore AND durable spool, the scope index
+    serves it back, and restore pulls it through
+    GET /v1/mesh/blob/<sha> -- including from a FRESH router process
+    (cold memory, warm spool) and after LRU eviction."""
+    from hpnn_tpu.serve.server import ServeApp, serve_in_thread
+
+    monkeypatch.setenv("HPNN_MESH_BUNDLE_DIR",
+                       str(tmp_path / "spool"))
+    ck = _bundle(tmp_path, epochs=2)
+    app = ServeApp(max_batch=8)
+    app.enable_mesh_router(required_workers=1)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    try:
+        dest = f"http://127.0.0.1:{httpd.server_address[1]}"
+        rep = replicate.Replicator(dest, ck)
+        for e in (1, 2):
+            assert rep.replicate(os.path.join(ck, f"ep0000000{e}")) \
+                is not None
+        idx = replicate.list_replicated(dest, rep.scope)
+        assert [e["tag"] for e in idx] == ["ep00000001", "ep00000002"]
+        out = replicate.restore_bundle(dest, rep.scope,
+                                       str(tmp_path / "recovered"))
+        assert out is not None and out.endswith("ep00000002")
+        ok, reason = ckpt.verify_bundle(out)
+        assert ok, reason
+        scope = rep.scope
+    finally:
+        httpd.shutdown()
+        app.close()
+    # a RESTARTED router (fresh process stand-in: new app, empty
+    # memory) must still list and serve the replicas from its spool
+    app2 = ServeApp(max_batch=8)
+    app2.enable_mesh_router(required_workers=1)
+    httpd2, _ = serve_in_thread("127.0.0.1", 0, app2)
+    try:
+        dest = f"http://127.0.0.1:{httpd2.server_address[1]}"
+        idx = replicate.list_replicated(dest, scope)
+        assert [e["tag"] for e in idx] == ["ep00000001", "ep00000002"]
+        out = replicate.restore_bundle(dest, scope,
+                                       str(tmp_path / "recovered2"))
+        assert out is not None and out.endswith("ep00000002")
+        ok, reason = ckpt.verify_bundle(out)
+        assert ok, reason
+    finally:
+        httpd2.shutdown()
+        app2.close()
+
+
+def test_router_bundle_endpoint_requires_auth_when_configured(
+        tmp_path, monkeypatch):
+    from hpnn_tpu.serve.server import ServeApp, serve_in_thread
+
+    monkeypatch.setenv("HPNN_MESH_BUNDLE_DIR",
+                       str(tmp_path / "spool"))
+    ck = _bundle(tmp_path, epochs=1)
+    app = ServeApp(max_batch=8, auth_token="sekrit")
+    app.enable_mesh_router(required_workers=1)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    try:
+        dest = f"http://127.0.0.1:{httpd.server_address[1]}"
+        bad = replicate.Replicator(dest, ck, auth_token="wrong")
+        with nn_log.capture():
+            assert bad.replicate(os.path.join(ck, "ep00000001")) is None
+        good = replicate.Replicator(dest, ck, auth_token="sekrit")
+        assert good.replicate(os.path.join(ck, "ep00000001")) \
+            is not None
+        with pytest.raises(replicate.ReplicateError):
+            replicate.list_replicated(dest, good.scope)  # no token
+        assert len(replicate.list_replicated(
+            dest, good.scope, auth_token="sekrit")) == 1
+    finally:
+        httpd.shutdown()
+        app.close()
+
+
+# --- corpus pack integrity (satellite) --------------------------------------
+
+def test_corpus_pack_trailer_detects_corruption(tmp_path, monkeypatch):
+    cdir = str(tmp_path / "samples")
+    _write_corpus(tmp_path / "samples", np.random.default_rng(3),
+                  N_SAMP)
+    names = sorted(os.listdir(cdir))
+    order = list(range(len(names)))
+    with nn_log.capture():
+        _ev, X, _T = corpus_io.load_ordered(cdir, names, order, "H",
+                                            N_IN, N_OUT)
+    assert X.shape == (N_SAMP, N_IN)
+    pack = corpus_io.pack_path(cdir)
+    assert os.path.isfile(pack)
+    # trailer present and verifiable
+    size = os.path.getsize(pack)
+    assert corpus_io._pack_content_ok(pack, size - 40)
+    # flip one DATA byte (stat fingerprint of the sources is unchanged,
+    # so only the content sha can catch this)
+    _flip_bit(pack, pos=(size - 100) * 8)
+    corpus_io._verified_packs.clear()
+    with nn_log.capture() as entries:
+        _ev, X2, _T2 = corpus_io.load_ordered(cdir, names, order, "H",
+                                              N_IN, N_OUT)
+    assert any("failed its content sha256" in text
+               for _l, text in entries)
+    # the rebuild served correct rows and re-landed a good pack
+    np.testing.assert_array_equal(np.asarray(X2), np.asarray(X))
+    corpus_io._verified_packs.clear()
+    assert corpus_io._pack_content_ok(pack,
+                                      os.path.getsize(pack) - 40)
+
+
+def test_corpus_pack_verify_memoized_per_process(tmp_path):
+    cdir = str(tmp_path / "samples")
+    _write_corpus(tmp_path / "samples", np.random.default_rng(4), 4)
+    names = sorted(os.listdir(cdir))
+    with nn_log.capture():
+        corpus_io.load_ordered(cdir, names, list(range(4)), "H",
+                               N_IN, N_OUT)
+    pack = corpus_io.pack_path(cdir)
+    end = os.path.getsize(pack) - 40
+    corpus_io._verified_packs.clear()
+    assert corpus_io._pack_content_ok(pack, end)
+    assert len(corpus_io._verified_packs) == 1
+    # memoized: corrupting the file now goes UNNOTICED by design until
+    # the trailer (the memo key) changes -- the once-per-process
+    # contract.  A rebuilt pack (new trailer) re-verifies.
+    key = next(iter(corpus_io._verified_packs))
+    assert corpus_io._pack_content_ok(pack, end)
+    assert next(iter(corpus_io._verified_packs)) == key
+
+
+# --- lease-based job auto-resume --------------------------------------------
+
+def _mini_app(tmp_path, auto_resume=True, replicate_to=None):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.serve.server import ServeApp
+
+    kern, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "serve.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / "serve.conf"
+    conf.write_text(f"[name] tiny\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    app = ServeApp(max_batch=8)
+    assert app.add_model(str(conf), warmup=False) is not None
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=4,
+                    auto_resume=auto_resume, replicate_to=replicate_to)
+    return app
+
+
+def _wait_status(store, jid, want, timeout_s=120.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        snap = store.snapshot(jid)
+        if snap and snap["status"] in want:
+            return snap
+        time.sleep(0.05)
+    raise AssertionError(f"job {jid} never reached {want}: "
+                         f"{store.snapshot(jid)}")
+
+
+def test_job_lease_refreshes_and_clears(tmp_path, corpus):
+    app = _mini_app(tmp_path, auto_resume=False)
+    try:
+        sched = app.jobs
+        job = sched.submit("tiny", {"epochs": 2, "seed": 9,
+                                    "samples": str(corpus / "samples"),
+                                    "ckpt_every": 1})
+        snap = _wait_status(sched.store, job.job_id, ("done",))
+        assert snap["lease_expires"] == 0.0  # cleared at terminal
+        assert snap["retries"] == 0
+    finally:
+        app.close()
+
+
+def test_interrupted_job_auto_resumes_to_done(tmp_path, corpus):
+    # phase 1: a job runs partway and is interrupted by a drain
+    app = _mini_app(tmp_path, auto_resume=False)
+    sched = app.jobs
+    job = sched.submit("tiny", {"epochs": 4, "seed": 9,
+                                "samples": str(corpus / "samples"),
+                                "ckpt_every": 1})
+    _wait_status(sched.store, job.job_id, ("running", "snapshotting"))
+    app.close()  # graceful drain: job lands interrupted, resumable
+    snap = sched.store.snapshot(job.job_id)
+    assert snap["status"] == "interrupted"
+
+    # phase 2: a restarted server with auto-resume finishes it
+    app2 = _mini_app(tmp_path, auto_resume=True)
+    try:
+        snap = _wait_status(app2.jobs.store, job.job_id, ("done",))
+        assert snap["epoch"] == 4
+        assert snap["retries"] >= 1
+        assert app2.jobs.auto_resumes_total >= 1
+        # byte parity with the offline CLI run of the same conf/seed
+        job_opt = open(snap["path"] + "/kernel.opt", "rb").read()
+        os.makedirs(str(tmp_path / "offline"), exist_ok=True)
+        cwd = os.getcwd()
+        os.chdir(str(tmp_path / "offline"))
+        try:
+            nn_log.set_verbosity(0)
+            rc = cli.train_nn_main(
+                ["--epochs=4", "--ckpt-every=1", "--ckpt-dir=ck",
+                 snap["path"] + "/nn.conf"])
+            assert rc == 0
+            assert open("kernel.opt", "rb").read() == job_opt
+        finally:
+            os.chdir(cwd)
+    finally:
+        app2.close()
+
+
+def test_auto_resume_from_replica_after_local_loss(tmp_path, corpus):
+    rep_dir = str(tmp_path / "rep")
+    app = _mini_app(tmp_path, auto_resume=False, replicate_to=rep_dir)
+    sched = app.jobs
+    job = sched.submit("tiny", {"epochs": 3, "seed": 9,
+                                "samples": str(corpus / "samples"),
+                                "ckpt_every": 1})
+    _wait_status(sched.store, job.job_id, ("running", "snapshotting"))
+    app.close()
+    snap = sched.store.snapshot(job.job_id)
+    assert snap["status"] == "interrupted"
+    ck = sched.store.get(job.job_id).ckpt_dir
+    scope = replicate.scope_for(ck)
+    assert os.path.isdir(os.path.join(rep_dir, scope))
+    # the local checkpoint history is LOST (dead disk)
+    shutil.rmtree(ck)
+
+    app2 = _mini_app(tmp_path, auto_resume=True, replicate_to=rep_dir)
+    try:
+        snap = _wait_status(app2.jobs.store, job.job_id, ("done",))
+        assert snap["epoch"] == 3
+        # the restore really landed replica bundles back on disk
+        assert any(t.startswith("ep") for t in os.listdir(ck))
+    finally:
+        app2.close()
+
+
+def test_retry_budget_exhaustion_lands_failed(tmp_path, corpus,
+                                              monkeypatch):
+    app = _mini_app(tmp_path, auto_resume=False)
+    sched = app.jobs
+    job = sched.submit("tiny", {"epochs": 2, "seed": 9,
+                                "samples": str(corpus / "samples")})
+    _wait_status(sched.store, job.job_id, ("done",))
+    app.close()
+    # forge an interrupted record whose budget is already spent
+    store = sched.store
+    j = store.get(job.job_id)
+    store.update(j, status="interrupted", retries=99)
+
+    app2 = _mini_app(tmp_path, auto_resume=True)
+    try:
+        snap = _wait_status(app2.jobs.store, job.job_id, ("failed",))
+        assert "retry budget exhausted" in snap["error"]
+    finally:
+        app2.close()
+
+
+# --- the acceptance e2e: kill -9 + corrupt newest + auto-resume -------------
+
+def _spawn_serve(args, timeout_s=180.0):
+    cmd = [sys.executable, "-u",
+           os.path.join(REPO, "apps", "serve_nn.py"),
+           "-p", "0", "--warmup-mode", "off", *args]
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=env)
+    port_box: list = []
+    ready = threading.Event()
+
+    def drain():
+        for line in proc.stdout:
+            if "SERVE: listening on" in line and not port_box:
+                port_box.append(int(line.rsplit(":", 1)[1]))
+                ready.set()
+        ready.set()
+
+    threading.Thread(target=drain, daemon=True).start()
+    if not ready.wait(timeout_s) or not port_box:
+        proc.kill()
+        raise RuntimeError("serve_nn never bound its port")
+    return proc, port_box[0]
+
+
+@pytest.mark.slow
+def test_kill9_corrupt_auto_resume_e2e(tmp_path, corpus):
+    """The ISSUE 14 acceptance: kill -9 a serve_nn process mid-job,
+    corrupt the job's NEWEST checkpoint bundle, restart the server --
+    the job auto-resumes from the last intact bundle and the final
+    ``kernel.opt`` is byte-identical to the offline ``train_nn`` run
+    of the same conf/corpus/seed."""
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+
+    kern, _ = generate_kernel(11, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / "serve.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / "serve.conf"
+    conf.write_text(f"[name] tiny\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    job_dir = str(tmp_path / "jobs")
+    rep_dir = str(tmp_path / "rep")
+    args = ["--jobs", "2", "--job-dir", job_dir, "--job-auto-resume",
+            "--replicate-to", rep_dir, str(conf)]
+    epochs = 40
+    proc, port = _spawn_serve(args)
+    try:
+        base = f"http://127.0.0.1:{port}"
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"epochs": epochs, "seed": 9, "train": "BP",
+             "samples": str(corpus / "samples"), "ckpt_every": 1})
+        assert st == 202, job
+        jid = job["job_id"]
+        # wait until the job is visibly mid-run, then kill -9.  The
+        # record's epoch is bumped BEFORE that epoch's bundle flush, so
+        # epoch >= 3 is the first point where ep1 AND ep2 are
+        # guaranteed durable (on_epoch(2) completed its flush)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            if snap["epoch"] >= 3:
+                break
+            if snap["status"] in ("done", "failed"):
+                break
+            time.sleep(0.01)
+        assert snap["status"] not in ("done", "failed"), \
+            f"job finished before the kill window: {snap}"
+        proc.kill()  # SIGKILL: no drain, no final snapshot
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    # the crash artifact: the newest bundle's bytes are torn/corrupt
+    ck = os.path.join(job_dir, jid, "ckpt")
+    tags = sorted(t for t in os.listdir(ck) if t.startswith("ep"))
+    assert len(tags) >= 2, tags
+    _flip_bit(os.path.join(ck, tags[-1], "state.npz"), pos=8192)
+
+    proc2, port2 = _spawn_serve(args)
+    try:
+        base = f"http://127.0.0.1:{port2}"
+        deadline = time.monotonic() + 300
+        snap = None
+        while time.monotonic() < deadline:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            if snap["status"] in ("done", "failed"):
+                break
+            time.sleep(0.1)
+        assert snap is not None and snap["status"] == "done", snap
+        assert snap["epoch"] == epochs
+        assert snap["retries"] >= 1
+        job_opt = open(os.path.join(job_dir, jid, "kernel.opt"),
+                       "rb").read()
+    finally:
+        proc2.terminate()
+        try:
+            proc2.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc2.kill()
+    # byte parity with the offline CLI on the job's own conf
+    os.makedirs("offline", exist_ok=True)
+    os.chdir("offline")
+    nn_log.set_verbosity(0)
+    rc = cli.train_nn_main([f"--epochs={epochs}", "--ckpt-every=1",
+                            "--ckpt-dir=ck",
+                            os.path.join(job_dir, jid, "nn.conf")])
+    assert rc == 0
+    assert open("kernel.opt", "rb").read() == job_opt
+    os.chdir("..")
+
+
+def test_expired_lease_recovers_stale_active_record(tmp_path, corpus):
+    app = _mini_app(tmp_path, auto_resume=False)
+    sched = app.jobs
+    job = sched.submit("tiny", {"epochs": 2, "seed": 9,
+                                "samples": str(corpus / "samples"),
+                                "ckpt_every": 1})
+    _wait_status(sched.store, job.job_id, ("done",))
+    app.close()
+    # forge a stale active record with an expired lease (a dead owner
+    # on a shared job dir -- restart recovery never saw it)
+    j = sched.store.get(job.job_id)
+    sched.store.update(j, status="running",
+                       lease_expires=time.time() - 10.0)
+
+    app2 = _mini_app(tmp_path, auto_resume=True)
+    try:
+        # recover() flips restart-actives; the forged record goes
+        # through recover OR the lease scan -- either way it must end
+        # done again via auto-resume
+        snap = _wait_status(app2.jobs.store, job.job_id, ("done",))
+        assert snap["retries"] >= 1
+    finally:
+        app2.close()
