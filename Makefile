@@ -28,12 +28,14 @@ CKPT_TESTS = tests/test_ckpt.py tests/test_epoch_pipeline.py \
              tests/test_dp_pipeline.py
 JOBS_TESTS = tests/test_jobs.py
 OBS_TESTS = tests/test_obs.py tests/test_fleet_obs.py
+TRACE_TESTS = tests/test_trace_analytics.py
 AUTOSCALE_TESTS = tests/test_autoscale.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
 	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(TRAIN_CHAOS_TESTS) \
-	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) $(AUTOSCALE_TESTS) -q
+	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) $(TRACE_TESTS) \
+	    $(AUTOSCALE_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -84,6 +86,19 @@ ckpt-check:
 # after a SIGKILL)
 obs-check:
 	env JAX_PLATFORMS=cpu python -m pytest $(OBS_TESTS) -q
+
+# trace-analytics tier (ISSUE 15): sidecar index build/staleness/
+# repair + offset fetch, search filter/order/limit, spool-reader edge
+# cases (torn tail, rotation racing a concurrent read), critical-path
+# self-time math incl. the cross-host stitch, timeline ordering, the
+# event-name registry source scan, nn_event/job-transition span
+# plumbing, the search/critical/timeline endpoints + offline-tool
+# byte-identity, healthz brownout fields, span-spool gauges; slow:
+# the chaos-latency 2-subprocess-worker acceptance e2e (search after
+# SIGKILL, injected-delay attribution, shed-bracketed timeline,
+# post-mortem tool reproduction)
+trace-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(TRACE_TESTS) -q
 
 # elastic-lifecycle tier (ISSUE 13): the RETIRING pool state (never
 # picked, never health-promoted, heartbeat cannot resurrect), the
@@ -200,4 +215,4 @@ obs-bench:
 .PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
     ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
     serve-bench io-bench epoch-bench dp-epoch-bench mfu-bench \
-    mesh-bench autoscale-check
+    mesh-bench autoscale-check trace-check

@@ -127,6 +127,27 @@ class JobState:
         return cls(**{k: v for k, v in d.items() if k in fields})
 
 
+def _record_transition(job_id: str, kernel: str, prev: str,
+                       status: str, epoch: int) -> None:
+    """One job lifecycle transition as a zero-duration recorder span
+    (``?trace=job:<id>`` and the timeline both see it); never raises
+    into the store's write path."""
+    try:
+        from ..obs import trace as obs_trace
+
+        if not obs_trace.enabled():
+            return
+        import time as _time
+
+        now = _time.monotonic()
+        obs_trace.record("job.state", now, now,
+                         trace_id=f"job:{job_id}", parent_id=None,
+                         job=job_id, kernel=kernel, status=status,
+                         previous=prev, epoch=epoch)
+    except Exception:
+        pass
+
+
 class JobStore:
     """Directory-backed job index: create/load/update, crash recovery.
 
@@ -192,7 +213,9 @@ class JobStore:
                            path=path, created=time.time())
             self._jobs[job_id] = job
             self._save_locked(job)
-            return job
+        # the birth transition: the timeline's first jobs entry
+        _record_transition(job_id, kernel, "", "queued", 0)
+        return job
 
     def discard(self, job: JobState) -> None:
         """Remove a job that never ran (admission failed mid-submit):
@@ -205,11 +228,22 @@ class JobStore:
 
     def update(self, job: JobState, **fields) -> None:
         """Mutate + persist under the store lock (the scheduler's only
-        write path; HTTP readers snapshot under the same lock)."""
+        write path; HTTP readers snapshot under the same lock).  A
+        STATUS change additionally lands in the flight recorder (and
+        so the durable span spool) as a zero-duration ``job.state``
+        span under the job's trace id -- the incident timeline's jobs
+        feed (ISSUE 15); recording happens outside the lock and is a
+        no-op while tracing is off."""
         with self._mu:
+            prev = job.status
             for k, v in fields.items():
                 setattr(job, k, v)
             self._save_locked(job)
+            status = job.status
+            epoch = job.epoch
+        if status != prev:
+            _record_transition(job.job_id, job.kernel, prev, status,
+                               epoch)
 
     def get(self, job_id: str) -> JobState | None:
         with self._mu:

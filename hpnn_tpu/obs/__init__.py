@@ -41,6 +41,50 @@ background spool into rotating NDJSON segments (``--span-dir``) so
 post-hoc analysis survives SIGKILL.
 """
 
+# --- structured event-name registry (ISSUE 15 satellite) --------------------
+# Every ``nn_event``/``mesh_event`` name emitted anywhere in the tree,
+# declared HERE with the subsystem category the incident timeline files
+# it under.  A source-scanning test (tests/test_trace_analytics.py)
+# fails on any literal event name missing from this table, so the
+# timeline's event -> category mapping can never silently rot: adding
+# an event means declaring it.  (``mesh_event`` names emit with the
+# ``mesh_`` prefix -- declare the prefixed form.)
+EVENT_NAMES: dict[str, str] = {
+    # serve hot path
+    "slow_request": "serve",
+    # SLO error-budget burn (obs/slo.py)
+    "slo_burn": "slo",
+    "slo_burn_cleared": "slo",
+    # checkpoint verification / resume fallback (ckpt/)
+    "ckpt_fallback": "ckpt",
+    # online-training jobs lifecycle (jobs/)
+    "job_lease_expired": "jobs",
+    "job_auto_resume": "jobs",
+    "job_auto_resume_failed": "jobs",
+    "auto_promote": "jobs",
+    # mesh lifecycle (serve/mesh/, emitted via mesh_event)
+    "mesh_worker_registered": "mesh",
+    "mesh_worker_readmitted": "mesh",
+    "mesh_worker_retiring": "mesh",
+    "mesh_worker_removed": "mesh",
+    "mesh_worker_ejected": "mesh",
+    "mesh_worker_router_switch": "mesh",
+    "mesh_worker_catch_up": "mesh",
+    "mesh_failover_retry": "mesh",
+    "mesh_reload_broadcast": "mesh",
+    "mesh_bundle_replicated": "mesh",
+    "mesh_standby_mirror": "standby",
+    "mesh_standby_takeover": "standby",
+    "mesh_standby_attached": "standby",
+    "mesh_shed_engaged": "slo",
+    "mesh_shed_cleared": "slo",
+    "mesh_autoscale_spawn": "autoscale",
+    "mesh_autoscale_retire": "autoscale",
+    "mesh_autoscale_confirmed": "autoscale",
+    "mesh_autoscale_unconfirmed": "autoscale",
+    "mesh_autoscale_reaped": "autoscale",
+}
+
 from .trace import (  # noqa: F401
     current_ctx,
     disable,
@@ -72,4 +116,5 @@ __all__ = [
     "last_seq", "new_span_id", "new_trace_id", "record",
     "render_ndjson", "ring_id", "sample_stats", "sample_trace",
     "set_exporter", "set_role", "set_sample_rate", "snapshot", "span",
+    "EVENT_NAMES",
 ]

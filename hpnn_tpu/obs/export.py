@@ -99,6 +99,15 @@ class SpanExporter:
         self.dropped_total = 0
         self.rotations_total = 0
         self.segments_pruned_total = 0
+        # trace-index sidecars (ISSUE 15): built right after a segment
+        # finalizes, ON the writer thread -- indexing rides rotation,
+        # never the request path (HPNN_TRACE_INDEX=0 opts out; queries
+        # then fall back to scans)
+        from .index import index_enabled
+
+        self.index_segments = index_enabled()
+        self.index_builds_total = 0
+        self.index_build_s_total = 0.0
         self._closed = False
         self._thread = threading.Thread(
             target=self._loop, name="hpnn-span-exporter", daemon=True)
@@ -188,6 +197,18 @@ class SpanExporter:
         self._open_bytes = 0
         self.rotations_total += 1
         self._retain_locked()
+        if self.index_segments:
+            # sidecar build rides the rotation (writer thread): search
+            # never pays a back-fill for segments this process wrote
+            t0 = time.monotonic()
+            try:
+                from .index import build_index
+
+                build_index(final)
+                self.index_builds_total += 1
+                self.index_build_s_total += time.monotonic() - t0
+            except Exception:
+                pass  # queries fall back to the lazy scan-and-repair
         return final
 
     def _retain_locked(self) -> None:
@@ -219,6 +240,12 @@ class SpanExporter:
                 os.unlink(path)
             except OSError:
                 continue
+            try:  # the sidecar index dies with its segment
+                from .index import index_path
+
+                os.unlink(index_path(path))
+            except OSError:
+                pass
             total -= sz
             self.segments_pruned_total += 1
 
@@ -270,6 +297,13 @@ class SpanExporter:
         with self._io:
             open_bytes = self._open_bytes
         segs = list_segments(self.span_dir)
+        oldest_age = 0.0
+        if segs:
+            try:
+                mtime = os.stat(segs[0]).st_mtime
+                oldest_age = max(0.0, time.time() - mtime)  # "updated"
+            except OSError:
+                pass
         return {"span_dir": self.span_dir,
                 "exported_total": self.exported_total,
                 "dropped_total": self.dropped_total,
@@ -277,6 +311,10 @@ class SpanExporter:
                 "segments_pruned_total": self.segments_pruned_total,
                 "segments": len(segs),
                 "open_bytes": open_bytes,
+                "oldest_segment_age_s": round(oldest_age, 3),
+                "index_builds_total": self.index_builds_total,
+                "index_build_s_total": round(self.index_build_s_total,
+                                             6),
                 "queue_depth": len(self._q)}
 
 

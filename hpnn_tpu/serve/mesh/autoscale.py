@@ -415,7 +415,10 @@ class WorkerSupervisor:
             "deadline": time.monotonic() + self.confirm_s,
             "baseline": baseline,
         }
-        mesh_event(f"autoscale_{action}",
+        # literal event names (not an f-string): the obs.EVENT_NAMES
+        # source-scan registry keys every emitted name statically
+        mesh_event("autoscale_spawn" if action == "spawn"
+                   else "autoscale_retire",
                    f"autoscale: exec hook {action} "
                    f"(desired {desired}; awaiting confirmation)\n",
                    desired=desired, hook=True,

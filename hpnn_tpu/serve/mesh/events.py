@@ -38,7 +38,9 @@ def mesh_event(event: str, human: str, level: str = "out",
     ("out", "warn" or "dbg").  ``fields`` are the structured payload
     for the JSON event and the recorder span."""
     if nn_log.log_json_enabled():
-        nn_log.nn_event(f"mesh_{event}", **fields)
+        # _record_span=False: the mesh.<event> recorder span below is
+        # this event's one span -- no event.mesh_* double
+        nn_log.nn_event(f"mesh_{event}", _record_span=False, **fields)
     elif level == "warn":
         nn_log.nn_warn(human)
     elif level == "dbg":

@@ -319,6 +319,54 @@ class FleetObserver:
         return obs_trace.render_ndjson(
             self.merged_spans(trace_id=trace_id, limit=limit))
 
+    # --- trace search federation (ISSUE 15) -------------------------------
+    def federated_search(self, params: dict) -> dict:
+        """Every LIVE worker's ``/v1/debug/trace/search?...&local=1``
+        result rows keyed by addr (None = unreachable / no index
+        there).  Dead workers are deliberately skipped: their spans
+        are already in this router's store/spool -- that IS how dead
+        hosts stay queryable.  Workers are queried concurrently on the
+        pool's RPC executor, like the metrics federation."""
+        import urllib.parse
+
+        qs = urllib.parse.urlencode(
+            {k: v for k, v in params.items()
+             if v not in (None, "") and k != "local"})
+        path = "/v1/debug/trace/search?local=1" + (
+            "&" + qs if qs else "")
+        headers = {}
+        if self.auth_token:
+            headers["Authorization"] = f"Bearer {self.auth_token}"
+
+        def query(addr: str):
+            try:
+                status, raw, _h = get_raw(addr, path, timeout_s=2.0,
+                                          headers=headers)
+                if status != 200:
+                    return None
+                body = json.loads(raw.decode("utf-8"))
+            except TRANSPORT_ERRORS:
+                return None
+            except (UnicodeDecodeError, json.JSONDecodeError):
+                return None
+            rows = body.get("traces") if isinstance(body, dict) else None
+            return rows if isinstance(rows, list) else None
+
+        from .router import STATE_DEAD
+
+        out: dict = {}
+        futures = {}
+        for w in self.pool.workers():
+            if w.state == STATE_DEAD:
+                continue
+            futures[w.addr] = self.pool.executor.submit(query, w.addr)
+        for addr, fut in futures.items():
+            try:
+                out[addr] = fut.result(timeout=5.0)
+            except Exception:
+                out[addr] = None
+        return out
+
     # --- metrics federation ----------------------------------------------
     def federated_metrics(self) -> dict:
         """Every known worker's JSON metrics snapshot keyed by addr;

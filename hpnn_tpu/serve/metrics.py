@@ -729,6 +729,20 @@ class ServeMetrics:
                 "currently retained in the span dir.",
                 "# TYPE hpnn_span_export_segments gauge",
                 f"hpnn_span_export_segments {se['segments']}",
+                "# HELP hpnn_span_export_open_bytes Bytes written to "
+                "the current open (unrotated) spool segment.",
+                "# TYPE hpnn_span_export_open_bytes gauge",
+                f"hpnn_span_export_open_bytes {se['open_bytes']}",
+                "# HELP hpnn_span_export_oldest_segment_age_s Age of "
+                "the oldest retained finalized segment (0 when none).",
+                "# TYPE hpnn_span_export_oldest_segment_age_s gauge",
+                f"hpnn_span_export_oldest_segment_age_s "
+                f"{se.get('oldest_segment_age_s', 0.0)}",
+                "# HELP hpnn_span_export_index_builds_total Trace-index"
+                " sidecars built at segment rotation (ISSUE 15).",
+                "# TYPE hpnn_span_export_index_builds_total counter",
+                f"hpnn_span_export_index_builds_total "
+                f"{se.get('index_builds_total', 0)}",
             ]
         if snap.get("mesh") is not None:
             msh = snap["mesh"]

@@ -869,6 +869,115 @@ class ServeApp:
         rec["requested_seconds"] = seconds
         return rec
 
+    # --- trace analytics (ISSUE 15) --------------------------------------
+    def _analysis_spans(self) -> list[dict]:
+        """The in-memory span set analysis falls back to when no span
+        spool is configured: this process's ring plus (on a mesh
+        router) the fleet store's collected worker spans."""
+        from ..obs import trace as obs_trace
+
+        if self.mesh_router is not None:
+            return self.mesh_router.fleet.merged_spans(drain=True)
+        return obs_trace.snapshot()
+
+    def handle_trace_search(self, params: dict,
+                            federate: bool = True) -> dict:
+        """GET /v1/debug/trace/search: per-trace summaries from the
+        trace index (``--span-dir`` sidecars; ring fallback without a
+        spool).  On a mesh router the query FEDERATES across every
+        live worker -- and because the fleet store/spool already holds
+        collected spans of SIGKILLed workers, dead hosts stay
+        queryable.  ``federate=False`` (``?local=1``) answers from
+        this process only -- the form the federation fan-out itself
+        uses."""
+        from ..obs import index as trace_index
+
+        try:
+            if self.span_exporter is not None:
+                # pending spans become searchable first (drain, not
+                # flush: a polling search must not force rotations)
+                self.span_exporter.drain()
+                payload = trace_index.search(self.span_exporter.span_dir,
+                                             params)
+            else:
+                payload = trace_index.search_spans(
+                    self._analysis_spans(), params)
+        except (TypeError, ValueError) as exc:
+            raise _HTTPError(400, "bad_request", f"bad query: {exc}")
+        if federate and self.mesh_router is not None:
+            have = {r["trace"] for r in payload["traces"]}
+            remote = self.mesh_router.fleet.federated_search(params)
+            merged = list(payload["traces"])
+            for addr in sorted(remote):
+                for row in remote[addr] or []:
+                    if row.get("trace") in have:
+                        continue  # the collector/spool copy wins
+                    have.add(row.get("trace"))
+                    row["host"] = addr
+                    merged.append(row)
+            merged.sort(key=lambda r: (-(r.get("start_ts") or 0.0),
+                                       r.get("trace") or ""))
+            limit = payload["query"].get("limit")
+            if limit is not None and limit >= 0:
+                merged = merged[:limit]
+            payload["traces"] = merged
+            payload["count"] = len(merged)
+        return payload
+
+    def handle_trace_critical(self, params: dict) -> dict:
+        """GET /v1/debug/trace/critical: per-phase p50/p99 critical-
+        path self-time over the index's sampled traces -- "queue_wait
+        owns 61% of p99".  Answers from the span spool when one is
+        configured (byte-identical to ``obs.tool critical`` over the
+        same directory), else from the ring/fleet store."""
+        from ..obs import analyze
+
+        try:
+            kernel = params.get("kernel") or None
+            window_s = (float(params["window"])
+                        if params.get("window") not in (None, "")
+                        else None)
+            limit = (int(params["limit"])
+                     if params.get("limit") not in (None, "") else None)
+        except (TypeError, ValueError) as exc:
+            raise _HTTPError(400, "bad_request", f"bad query: {exc}")
+        if self.span_exporter is not None:
+            self.span_exporter.drain()
+            return analyze.critical_from_dir(
+                self.span_exporter.span_dir, kernel=kernel,
+                window_s=window_s, limit=limit)
+        return analyze.critical_from_spans(
+            self._analysis_spans(), kernel=kernel, window_s=window_s,
+            limit=limit)
+
+    def handle_trace_timeline(self, params: dict) -> str:
+        """GET /v1/debug/trace?timeline=1: the incident timeline as
+        NDJSON -- spans, structured events and job state transitions
+        in one time-ordered narrative.  Spool-backed when a span dir
+        is configured (so ``obs.tool timeline`` reproduces it
+        post-mortem), ring/fleet-store-backed otherwise."""
+        from ..obs import analyze
+
+        try:
+            since = (float(params["since"])
+                     if params.get("since") not in (None, "") else None)
+            until = (float(params["until"])
+                     if params.get("until") not in (None, "") else None)
+            limit = (int(params["limit"])
+                     if params.get("limit") not in (None, "") else None)
+        except (TypeError, ValueError) as exc:
+            raise _HTTPError(400, "bad_request", f"bad query: {exc}")
+        if self.span_exporter is not None:
+            from ..obs.export import read_spool
+
+            self.span_exporter.drain()
+            spans = read_spool(self.span_exporter.span_dir)
+        else:
+            spans = self._analysis_spans()
+        return analyze.render_timeline(
+            analyze.build_timeline(spans, since=since, until=until,
+                                   limit=limit))
+
     # --- request handling (transport-independent) ----------------------
     def handle_infer(self, name: str, body: bytes,
                      headers=None,
@@ -1326,7 +1435,17 @@ class _Handler(BaseHTTPRequestHandler):
                     "queue_depth": {name: b.depth() for name, b in
                                     self.app.batchers.items()},
                     "active_jobs": 0 if jobs is None else
-                    jobs.queue.depth() + (1 if jobs._current else 0)}
+                    jobs.queue.depth() + (1 if jobs._current else 0),
+                    # brownout visibility (ISSUE 15 satellite): probes
+                    # see a burning error budget / an engaged shed gate
+                    # without parsing /metrics.  Transition-maintained
+                    # int + bool reads -- the ok/warming status
+                    # contract is unchanged by these fields
+                    "slo_burning": (self.app.slo.burning_count
+                                    if self.app.slo is not None else 0),
+                    "shed_engaged": (bool(self.app.shedder.active)
+                                     if self.app.shedder is not None
+                                     else False)}
             if mesh is not None:
                 body["mesh"] = mesh
             if warming:
@@ -1387,6 +1506,34 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, data,
                         content_type="application/octet-stream")
             return
+        if path in ("/v1/debug/trace/search", "/v1/debug/trace/critical"):
+            # trace analytics (ISSUE 15): index-backed search and
+            # critical-path attribution; 404 only when there is
+            # NOTHING to answer from (no spool and tracing off)
+            from ..obs import trace as obs_trace
+
+            params = dict(
+                kv.split("=", 1) for kv in query.split("&") if "=" in kv)
+            if self.app.span_exporter is None \
+                    and not obs_trace.enabled():
+                self._reply(404, {"error": "tracing is disabled and no "
+                                  "span spool is configured (start "
+                                  "serve_nn with --trace and/or "
+                                  "--span-dir)",
+                                  "reason": "tracing_disabled"})
+                return
+            try:
+                if path.endswith("/search"):
+                    out = self.app.handle_trace_search(
+                        params, federate=params.get("local") != "1")
+                else:
+                    out = self.app.handle_trace_critical(params)
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+                return
+            self._reply(200, out)
+            return
         if path == "/v1/debug/trace":
             from ..obs import trace as obs_trace
 
@@ -1403,6 +1550,25 @@ class _Handler(BaseHTTPRequestHandler):
                                   "reason": "bad_request"})
                 return
             trace_id = params.get("trace") or None
+            if params.get("timeline") == "1":
+                # the incident timeline (ISSUE 15): usable as long as
+                # there is ANY source -- a spool left by an earlier
+                # (even dead) process, or the live ring
+                if self.app.span_exporter is None \
+                        and not obs_trace.enabled():
+                    self._reply(404, {"error": "tracing is disabled and "
+                                      "no span spool is configured",
+                                      "reason": "tracing_disabled"})
+                    return
+                try:
+                    text = self.app.handle_trace_timeline(params)
+                except _HTTPError as exc:
+                    self._reply(exc.status, {"error": str(exc),
+                                             "reason": exc.outcome})
+                    return
+                self._reply(200, text.encode("utf-8"),
+                            content_type="application/x-ndjson")
+                return
             if params.get("spool") == "1":
                 # read back through the DURABLE spool (ISSUE 13): the
                 # rotated segments plus the open spool files, so a

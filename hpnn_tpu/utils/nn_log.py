@@ -102,12 +102,22 @@ def _write(stream, level: str, prefix: str, text: str) -> None:
         _emit(stream, prefix + text)
 
 
-def nn_event(event: str, **fields) -> None:
+def nn_event(event: str, _record_span: bool = True, **fields) -> None:
     """A structured operational event (e.g. the serve layer's
     slow-request flag).  HPNN_LOG_JSON=1 emits one ungated JSON line
     (machine consumers opted in; an event is data, not chatter); text
     mode renders ``event: k=v ...`` through :func:`nn_warn`, so the
-    normal verbosity gate applies."""
+    normal verbosity gate applies.
+
+    With tracing on, the event ALSO lands in the flight recorder (and
+    so the durable span spool) as a zero-duration ``event.<name>`` span
+    under the well-known ``events`` trace id -- the incident timeline's
+    feed (ISSUE 15).  ``_record_span=False`` is for emitters that
+    already record their own span (``serve.mesh.events.mesh_event``).
+    Emission is unchanged either way: console/JSON output stays
+    byte-identical with tracing on or off."""
+    if _record_span:
+        _record_event_span(event, fields)
     if log_json_enabled():
         # render the FULL record before the capture check: a captured
         # event replays byte-identically to a direct emission (one
@@ -122,6 +132,44 @@ def nn_event(event: str, **fields) -> None:
         return
     body = " ".join(f"{k}={v}" for k, v in fields.items())
     nn_warn(f"{event}: {body}\n")
+
+
+# the well-known trace id structured events file under in the flight
+# recorder: `?trace=events` (or the timeline view) pulls every
+# slo_burn/ckpt_fallback/job_* event out of any recorder dump
+EVENTS_TRACE_ID = "events"
+
+
+def _record_event_span(event: str, fields: dict) -> None:
+    """Mirror one structured event into the flight recorder as a
+    zero-duration span (no-op while tracing is off -- one attribute
+    read; never raises into the emitting path)."""
+    try:
+        from ..obs import trace as obs_trace
+
+        if not obs_trace.enabled():
+            return
+        now = time.monotonic()
+        attrs = {}
+        for k, v in fields.items():
+            if not (isinstance(v, (str, int, float, bool))
+                    or v is None):
+                continue
+            if k in ("name", "trace_id", "parent_id", "span_id"):
+                continue  # record()'s own parameters
+            if k in ("trace", "span", "parent", "ts", "dur_s",
+                     "thread", "seq"):
+                # event fields colliding with the span record's
+                # STRUCTURAL keys (rec.update(attrs) would clobber
+                # them: a slow_request's trace=<id> field must not
+                # re-home the event span out of the events trace)
+                k = f"event_{k}"
+            attrs[k] = v
+        obs_trace.record(f"event.{event}", now, now,
+                         trace_id=EVENTS_TRACE_ID, parent_id=None,
+                         **attrs)
+    except Exception:
+        pass  # observability must never break the log path
 
 
 # --- deferred emission (thread-local capture) -------------------------------

@@ -22,6 +22,13 @@ workers -- the PR-9 mesh -- and the SAME load runs twice:
    trace (explicit id) still proves the merged tree works -- the
    production configuration for fleet QPS.
 
+The ON round additionally spools to a ``--span-dir`` with small
+segments, so trace-index sidecar builds ride every rotation DURING the
+measured load -- the overhead ceiling is re-asserted with indexing on
+(ISSUE 15).  A separate ``index`` row prices the analytics themselves:
+sidecar build cost at rotation and search latency over >= 10k spooled
+spans, indexed vs the HPNN_TRACE_INDEX=0 body scan.
+
 Floors (bench.py protocol: asserted, rc!=0 on a miss):
 
 * zero non-200 responses in every round;
@@ -33,7 +40,11 @@ Floors (bench.py protocol: asserted, rc!=0 on a miss):
   traced request yields a MERGED route -> worker -> device tree from
   the router endpoint (an overhead number for a broken feature would
   be worthless) -- in the sampled round via FORCED capture, with the
-  head sampler's dropped counter > 0 proving the drop path ran.
+  head sampler's dropped counter > 0 proving the drop path ran;
+* the ON round's spool really indexed (>= 1 sidecar built at
+  rotation), the index row covered >= 10k spans, the indexed search
+  answered correctly, and indexed search beat the body scan by >= the
+  speedup floor.
 
 ``--real`` (``make obs-bench REAL=1``) keeps the ambient JAX platform
 (chip workers); default forces CPU everywhere.
@@ -55,6 +66,110 @@ sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 OVERHEAD_CEILING = 1.75   # ON p50 <= OFF p50 * this ...
 OVERHEAD_SLACK_MS = 25.0  # ... + this (single-core CPU jitter floor)
+INDEX_MIN_SPANS = 10000   # the index row must cover at least this
+SEARCH_SPEEDUP_FLOOR = 1.5  # indexed search vs the body scan
+
+
+def index_bench(tmp: str) -> tuple[dict, list[str]]:
+    """The trace-analytics row (ISSUE 15): spool >= 10k spans through
+    a real exporter (sidecars built at rotation -- THAT cost is the
+    committed number), then time kernel+min_ms search with the index
+    vs the HPNN_TRACE_INDEX=0 full body scan."""
+    import time as _t
+
+    from hpnn_tpu.obs import index as trace_index
+    from hpnn_tpu.obs.export import SpanExporter, list_segments
+
+    span_dir = os.path.join(tmp, "spool-index")
+    exp = SpanExporter(span_dir, segment_bytes=192 * 1024,
+                       segment_age_s=3600.0,
+                       max_dir_bytes=1 << 30)
+    base = _t.time()
+    n_traces = 2100
+    kids = (("parse", 0.0, 0.001), ("queue_wait", 0.001, 0.006),
+            ("device_launch", 0.007, 0.002), ("d2h", 0.009, 0.001))
+    for i in range(n_traces):
+        tid = f"bench{i:06d}"
+        t0 = base + i * 1e-3
+        root = f"{tid}-r"
+        exp.offer({"name": "serve.request", "trace": tid, "span": root,
+                   "parent": None, "ts": round(t0, 6), "dur_s": 0.01,
+                   "thread": "b", "kernel": "bench", "outcome": "ok"})
+        for j, (nm, off, dur) in enumerate(kids):
+            exp.offer({"name": nm, "trace": tid,
+                       "span": f"{tid}-{j}", "parent": root,
+                       "ts": round(t0 + off, 6), "dur_s": dur,
+                       "thread": "b"})
+        if i % 256 == 0:
+            exp.drain()  # keep the bounded queue from dropping
+    exp.flush()
+    stats = exp.stats()
+    exp.close()
+    segs = list_segments(span_dir)
+    query = {"kernel": "bench", "min_ms": 9, "limit": 50}
+
+    def timed_search(runs: int = 3) -> tuple[float, dict]:
+        best, res = None, None
+        for _ in range(runs):
+            t0 = _t.monotonic()
+            res = trace_index.search(span_dir, query)
+            dt = (_t.monotonic() - t0) * 1e3
+            best = dt if best is None else min(best, dt)
+        return round(best, 3), res
+
+    # scan baseline: no sidecars, index disabled
+    for seg in segs:
+        try:
+            os.unlink(trace_index.index_path(seg))
+        except OSError:
+            pass
+    os.environ["HPNN_TRACE_INDEX"] = "0"
+    try:
+        scan_ms, scan_res = timed_search()
+    finally:
+        del os.environ["HPNN_TRACE_INDEX"]
+    # back-fill once (the lazy-repair path), then time the indexed hit
+    t0 = _t.monotonic()
+    trace_index.search(span_dir, query)
+    backfill_ms = round((_t.monotonic() - t0) * 1e3, 3)
+    indexed_ms, idx_res = timed_search()
+    hit = trace_index.search(span_dir, {"trace": "bench001000"})
+    speedup = round(scan_ms / indexed_ms, 2) if indexed_ms > 0 else 0.0
+    row = {
+        "spans": stats["exported_total"],
+        "traces": n_traces,
+        "segments": len(segs),
+        "dropped": stats["dropped_total"],
+        "index_build_ms_total": round(
+            stats["index_build_s_total"] * 1e3, 3),
+        "index_builds": stats["index_builds_total"],
+        "index_build_ms_per_segment": round(
+            stats["index_build_s_total"] * 1e3
+            / max(stats["index_builds_total"], 1), 3),
+        "backfill_ms": backfill_ms,
+        "search_scan_ms": scan_ms,
+        "search_indexed_ms": indexed_ms,
+        "search_speedup": speedup,
+        "hit_ok": bool(hit["count"] == 1
+                       and idx_res["count"] == 50
+                       and idx_res == scan_res),
+        "speedup_floor": SEARCH_SPEEDUP_FLOOR,
+    }
+    failed = []
+    if row["spans"] < INDEX_MIN_SPANS:
+        failed.append(f"index row spooled only {row['spans']} spans "
+                      f"(< {INDEX_MIN_SPANS})")
+    if not row["hit_ok"]:
+        failed.append("indexed search answered wrong (hit/count/scan "
+                      "mismatch)")
+    if row["index_builds"] != len(segs):
+        failed.append(f"rotation built {row['index_builds']} sidecars "
+                      f"for {len(segs)} segments")
+    if speedup < SEARCH_SPEEDUP_FLOOR:
+        failed.append(f"indexed search speedup {speedup}x under the "
+                      f"{SEARCH_SPEEDUP_FLOOR}x floor "
+                      f"(scan {scan_ms}ms vs indexed {indexed_ms}ms)")
+    return row, failed
 
 
 def main() -> int:
@@ -96,11 +211,12 @@ def main() -> int:
                     fast_threshold=4)
 
     def run_round(trace_on: bool,
-                  sample: float | None = None) -> tuple[dict, dict]:
+                  sample: float | None = None,
+                  span_dir: str | None = None) -> tuple[dict, dict]:
         """One fresh router + 2 workers; returns (load stats, extras)."""
         procs: list = []
         rapp = ServeApp(trace=trace_on if trace_on else False,
-                        trace_sample=sample,
+                        trace_sample=sample, span_dir=span_dir,
                         **serve_kw)
         rapp.enable_mesh_router(required_workers=2,
                                 health_interval_s=0.5)
@@ -190,6 +306,10 @@ def main() -> int:
                     "federation_scrapes": scrape_counts["n"],
                     "federation_scrape_errors": scrape_counts["errors"],
                 }
+                if rapp.span_exporter is not None:
+                    # the ON round spools + indexes DURING the measured
+                    # load: the ceiling above prices indexing-on
+                    extras["span_export"] = rapp.span_exporter.stats()
                 if sample is not None:
                     from hpnn_tpu.obs import trace as obs_trace
 
@@ -202,9 +322,14 @@ def main() -> int:
             rhttpd.shutdown()
             rapp.close(drain=True)
 
+    # small spool segments: the ON round must really rotate + index
+    # under the measured load, not spool into one open file
+    os.environ.setdefault("HPNN_SPAN_SEGMENT_KB", "64")
     off, _ = run_round(trace_on=False)
-    on, extras = run_round(trace_on=True)
+    on, extras = run_round(trace_on=True,
+                           span_dir=os.path.join(tmp, "spool-on"))
     sampled, sampled_extras = run_round(trace_on=True, sample=0.01)
+    index_row, index_failed = index_bench(tmp)
 
     keep = ("rows_per_s", "requests_per_s", "p50_ms", "p99_ms",
             "statuses")
@@ -229,6 +354,9 @@ def main() -> int:
     row["sampled"]["merged_tree_ok"] = sampled_extras.get(
         "merged_tree_ok", False)
     row["sampled"]["sampling"] = sampled_extras.get("sampling")
+    # trace-index row (ISSUE 15): build cost at rotation + search
+    # latency over >= 10k spooled spans, indexed vs body scan
+    row["index"] = index_row
 
     failed: list[str] = []
     if off["statuses"] != {"200": args.requests}:
@@ -260,6 +388,11 @@ def main() -> int:
     if samp_stats.get("dropped_total", 0) <= 0:
         failed.append("sampled round never exercised the drop path "
                       f"(sampling stats: {samp_stats})")
+    se = extras.get("span_export") or {}
+    if se.get("index_builds_total", 0) < 1:
+        failed.append("ON round never built a sidecar at rotation "
+                      f"(span_export: {se})")
+    failed += index_failed
 
     row["floors_failed"] = failed
     print(json.dumps(row))

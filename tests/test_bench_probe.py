@@ -223,6 +223,29 @@ def test_committed_obs_bench_sampled_row_holds_floors():
     assert set(s["statuses"]) == {"200"}
 
 
+def test_committed_obs_bench_index_row_holds_floors():
+    """The committed OBS_BENCH.json trace-index row (ISSUE 15) stays
+    pinned in tier 1: >= 10k spans spooled, one sidecar per rotated
+    segment (indexing rode rotation), the indexed search answered
+    byte-identically to the body scan, beat it by the speedup floor,
+    and the ON round (which now spools + indexes under load) held the
+    same overhead ceiling."""
+    art = _load_artifact("OBS_BENCH.json")
+    assert art["floors_failed"] == []
+    idx = art["index"]
+    assert idx["spans"] >= 10000
+    assert idx["segments"] >= 2
+    assert idx["index_builds"] == idx["segments"]
+    assert idx["hit_ok"] is True
+    assert idx["search_speedup"] >= idx["speedup_floor"] >= 1.5
+    assert idx["search_indexed_ms"] < idx["search_scan_ms"]
+    assert idx["index_build_ms_per_segment"] > 0
+    # the ON round really exercised rotation-time indexing
+    assert art["span_export"]["index_builds_total"] >= 1
+    ceiling = (art["off"]["p50_ms"] * 1.75) + 25.0
+    assert art["on"]["p50_ms"] <= ceiling
+
+
 def test_committed_jobs_bench_recovery_row_holds_floors():
     """The committed JOBS_BENCH.json recovery row (ISSUE 14) stays
     pinned in tier 1: the kill -9 + corrupted-newest-bundle episode

@@ -1,0 +1,1074 @@
+"""Trace analytics (ISSUE 15): the cross-host trace index,
+critical-path attribution, the incident timeline, and the offline
+post-mortem tool.
+
+Fast tier: sidecar index build/load/staleness + byte-offset fetch,
+search filter/order/limit semantics, index-missing/stale fallback-then
+-repair, the HPNN_TRACE_INDEX=0 scan path, spool-reader edge cases
+(torn open-segment tail through search; rotation racing a concurrent
+spool read), critical-path self-time math (incl. the cross-host stitch
+and sibling containment), critical-report share aggregation, timeline
+ordering/categories, the nn_event -> recorder span plumbing, job
+state-transition spans, the event-name source-scan registry, the
+search/critical/timeline HTTP endpoints (and their byte-identity with
+the offline tool), /healthz brownout fields and the span-spool
+/metrics gauges under the exposition lint.
+
+Slow tier: the acceptance e2e -- a 2-subprocess-worker mesh under
+sampled load with a chaos ``latency`` fault on the workers' serve
+path; router-side search finds the forced trace by kernel+min_ms after
+the serving worker is SIGKILLed, critical attributes the injected
+delay to the remote-wait phase, the timeline shows shed engage/clear
+bracketing an SLO burn, and ``python -m hpnn_tpu.obs.tool`` reproduces
+all three answers byte-identically from the span dir after the router
+is gone.
+"""
+
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import mesh_bench  # noqa: E402
+import serve_bench  # noqa: E402
+from test_fleet_obs import _get_raw, _write_kernel_conf  # noqa: E402
+from test_obs import lint_prometheus  # noqa: E402
+
+from hpnn_tpu import obs  # noqa: E402
+from hpnn_tpu.obs import analyze  # noqa: E402
+from hpnn_tpu.obs import index as trace_index  # noqa: E402
+from hpnn_tpu.obs import trace as obs_trace  # noqa: E402
+from hpnn_tpu.obs.export import (  # noqa: E402
+    SpanExporter,
+    list_segments,
+    read_spool,
+)
+from hpnn_tpu.serve.mesh import chaos  # noqa: E402
+from hpnn_tpu.serve.server import ServeApp, serve_in_thread  # noqa: E402
+from hpnn_tpu.utils import nn_log  # noqa: E402
+
+N_IN = 8
+
+
+@pytest.fixture(autouse=True)
+def _obs_reset():
+    obs.disable()
+    obs_trace.set_role(None)
+    obs_trace.set_sample_rate(None)
+    obs_trace.set_exporter(None)
+    nn_log.set_verbosity(0)
+    chaos.configure(None)
+    yield
+    obs.disable()
+    obs_trace.set_sample_rate(None)
+    obs_trace.set_exporter(None)
+    obs_trace.set_role(None)
+    nn_log.set_verbosity(0)
+    chaos.configure(None)
+
+
+def _mk_span(trace, name, t0, dur_s, parent=None, span=None, **attrs):
+    rec = {"name": name, "trace": trace,
+           "span": span or f"{trace}-{name}-{t0:.6f}",
+           "parent": parent, "ts": round(t0, 6),
+           "dur_s": round(dur_s, 9), "thread": "t"}
+    rec.update(attrs)
+    return rec
+
+
+def _request_tree(tid, t0, kernel="tiny", total=0.010, queue=0.006,
+                  outcome="ok"):
+    """A realistic serve-request span tree: parse -> queue_wait ->
+    device_launch -> d2h under one root."""
+    root_id = f"{tid}-root"
+    spans = [
+        _mk_span(tid, "serve.request", t0, total, span=root_id,
+                 kernel=kernel, outcome=outcome),
+        _mk_span(tid, "parse", t0, 0.001, parent=root_id),
+        _mk_span(tid, "queue_wait", t0 + 0.001, queue, parent=root_id),
+        _mk_span(tid, "device_launch", t0 + 0.001 + queue,
+                 total - 0.002 - queue, parent=root_id),
+        _mk_span(tid, "d2h", t0 + total - 0.001, 0.001,
+                 parent=root_id),
+    ]
+    return spans
+
+
+def _spool_with_traces(tmp_path, n=8, **exp_kw):
+    """An exporter + n spooled request trees; returns (exporter,
+    span_dir).  Caller closes."""
+    span_dir = str(tmp_path / "spool")
+    exp_kw.setdefault("segment_bytes", 2048)
+    exp_kw.setdefault("segment_age_s", 30.0)
+    exp = SpanExporter(span_dir, **exp_kw)
+    base = time.time()
+    for i in range(n):
+        for s in _request_tree(f"t{i:03d}", base + i * 0.05,
+                               total=0.010 + i * 0.001):
+            exp.offer(s)
+    exp.drain()
+    return exp, span_dir
+
+
+# --- sidecar index -----------------------------------------------------------
+
+def test_rotation_builds_sidecar_with_offsets_and_summary(tmp_path):
+    exp, span_dir = _spool_with_traces(tmp_path, n=8)
+    try:
+        exp.flush()
+        segs = list_segments(span_dir)
+        assert segs, "nothing rotated"
+        assert exp.index_builds_total == len(segs)
+        for seg in segs:
+            idx = trace_index.load_index(seg)
+            assert idx is not None, f"no sidecar for {seg}"
+            assert idx["version"] == trace_index.INDEX_VERSION
+            for tid, row in idx["traces"].items():
+                # kernel/root come from the trace's root span, which
+                # may sit in ANOTHER segment when rotation cut the
+                # trace -- the directory-level search merges that
+                assert row["kernel"] in ("tiny", None)
+                assert row["spans"] == len(row["offsets"])
+                # offsets really point at that trace's lines
+                with open(seg, "rb") as fp:
+                    for off in row["offsets"]:
+                        fp.seek(off)
+                        s = json.loads(fp.readline())
+                        assert s["trace"] == tid
+        # the merged view has the root-derived fields for every trace
+        res = trace_index.search(span_dir, {"limit": 100})
+        assert res["count"] == 8
+        for row in res["traces"]:
+            assert row["kernel"] == "tiny"
+            assert row["root"] == "serve.request"
+            assert row["status"] == "ok"
+            assert row["spans"] == 5
+    finally:
+        exp.close()
+
+
+def test_fetch_trace_via_offsets_equals_scan(tmp_path):
+    exp, span_dir = _spool_with_traces(tmp_path, n=6)
+    try:
+        exp.flush()
+        spans = trace_index.fetch_trace(span_dir, "t003")
+        assert sorted(s["name"] for s in spans) == sorted(
+            ["serve.request", "parse", "queue_wait", "device_launch",
+             "d2h"])
+        by_scan = [s for s in read_spool(span_dir)
+                   if s["trace"] == "t003"]
+        assert sorted(spans, key=lambda s: s["span"]) == sorted(
+            by_scan, key=lambda s: s["span"])
+    finally:
+        exp.close()
+
+
+def test_search_filters_order_and_limit(tmp_path):
+    exp, span_dir = _spool_with_traces(tmp_path, n=10)
+    try:
+        # one slow failed trace, newest
+        base = time.time() + 10.0
+        for s in _request_tree("slow01", base, total=0.200,
+                               queue=0.150, outcome="error"):
+            exp.offer(s)
+        exp.flush()
+        res = trace_index.search(span_dir, {"kernel": "tiny"})
+        assert res["count"] == 11
+        # newest-first
+        starts = [r["start_ts"] for r in res["traces"]]
+        assert starts == sorted(starts, reverse=True)
+        assert res["traces"][0]["trace"] == "slow01"
+        # min_ms
+        res = trace_index.search(span_dir, {"min_ms": 100})
+        assert [r["trace"] for r in res["traces"]] == ["slow01"]
+        # status
+        res = trace_index.search(span_dir, {"status": "error"})
+        assert [r["trace"] for r in res["traces"]] == ["slow01"]
+        # trace id
+        res = trace_index.search(span_dir, {"trace": "t004"})
+        assert res["count"] == 1
+        assert res["traces"][0]["dur_ms"] == pytest.approx(14.0,
+                                                           abs=0.5)
+        # since/until exclude the slow one
+        res = trace_index.search(span_dir, {"until": base - 1.0})
+        assert all(r["trace"] != "slow01" for r in res["traces"])
+        # limit
+        res = trace_index.search(span_dir, {"limit": 3})
+        assert res["count"] == 3 and len(res["traces"]) == 3
+        # unknown kernel
+        res = trace_index.search(span_dir, {"kernel": "nope"})
+        assert res["count"] == 0
+    finally:
+        exp.close()
+
+
+def test_event_spans_do_not_kernel_tag_their_trace(tmp_path):
+    """A structured event mentioning a kernel (slo_burn kernel=...,
+    slow_request) must not drag the whole ``events``/``mesh`` trace
+    into that kernel's search results."""
+    exp, span_dir = _spool_with_traces(tmp_path, n=2)
+    try:
+        exp.offer(_mk_span("events", "event.slo_burn",
+                           time.time() + 99.0, 0.0, kernel="tiny",
+                           objective="availability"))
+        exp.offer(_mk_span("mesh", "mesh.shed_engaged",
+                           time.time() + 99.5, 0.0, kernel="tiny"))
+        exp.flush()
+        res = trace_index.search(span_dir, {"kernel": "tiny"})
+        assert {r["trace"] for r in res["traces"]} == {"t000", "t001"}
+        res = trace_index.search(span_dir, {"trace": "events"})
+        assert res["count"] == 1
+        assert res["traces"][0]["kernel"] is None
+    finally:
+        exp.close()
+
+
+def test_search_env_default_limit(tmp_path, monkeypatch):
+    exp, span_dir = _spool_with_traces(tmp_path, n=6)
+    try:
+        exp.flush()
+        monkeypatch.setenv("HPNN_TRACE_SEARCH_LIMIT", "2")
+        res = trace_index.search(span_dir, {})
+        assert res["query"]["limit"] == 2 and res["count"] == 2
+    finally:
+        exp.close()
+
+
+def test_missing_sidecar_falls_back_to_scan_then_repairs(tmp_path):
+    exp, span_dir = _spool_with_traces(tmp_path, n=4)
+    try:
+        exp.flush()
+        segs = list_segments(span_dir)
+        baseline = trace_index.search(span_dir, {"kernel": "tiny"})
+        for seg in segs:
+            os.unlink(trace_index.index_path(seg))
+        # back-fill: the query still answers...
+        res = trace_index.search(span_dir, {"kernel": "tiny"})
+        assert res == baseline
+        # ...and repaired every sidecar for the next one
+        for seg in segs:
+            assert os.path.exists(trace_index.index_path(seg))
+    finally:
+        exp.close()
+
+
+def test_stale_or_corrupt_sidecar_rebuilt(tmp_path):
+    exp, span_dir = _spool_with_traces(tmp_path, n=4)
+    try:
+        exp.flush()
+        seg = list_segments(span_dir)[0]
+        baseline = trace_index.search(span_dir, {"kernel": "tiny"})
+        # corrupt: junk bytes
+        with open(trace_index.index_path(seg), "w") as fp:
+            fp.write("{not json")
+        assert trace_index.load_index(seg) is None
+        assert trace_index.search(span_dir, {"kernel": "tiny"}) \
+            == baseline
+        assert trace_index.load_index(seg) is not None
+        # stale: size mismatch (a sidecar from some other segment)
+        idx = trace_index.load_index(seg)
+        idx["size"] += 7
+        with open(trace_index.index_path(seg), "w") as fp:
+            json.dump(idx, fp)
+        assert trace_index.load_index(seg) is None
+        assert trace_index.search(span_dir, {"kernel": "tiny"}) \
+            == baseline
+        assert trace_index.load_index(seg) is not None
+    finally:
+        exp.close()
+
+
+def test_index_disabled_env_scans_and_writes_nothing(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("HPNN_TRACE_INDEX", "0")
+    exp, span_dir = _spool_with_traces(tmp_path, n=4)
+    try:
+        exp.flush()
+        segs = list_segments(span_dir)
+        assert exp.index_builds_total == 0
+        res = trace_index.search(span_dir, {"kernel": "tiny"})
+        assert res["count"] == 4
+        for seg in segs:
+            assert not os.path.exists(trace_index.index_path(seg))
+    finally:
+        exp.close()
+
+
+def test_trace_spanning_segments_merges_summaries(tmp_path):
+    span_dir = str(tmp_path / "spool")
+    exp = SpanExporter(span_dir, segment_bytes=1 << 20,
+                       segment_age_s=30.0)
+    try:
+        base = time.time()
+        root_id = "cross-root"
+        exp.offer(_mk_span("cross", "serve.request", base, 0.050,
+                           span=root_id, kernel="tiny", outcome="ok"))
+        exp.flush()  # rotation 1: root alone
+        exp.offer(_mk_span("cross", "queue_wait", base + 0.001, 0.040,
+                           parent=root_id))
+        exp.flush()  # rotation 2: the child lands in a later segment
+        assert len(list_segments(span_dir)) == 2
+        res = trace_index.search(span_dir, {"trace": "cross"})
+        assert res["count"] == 1
+        row = res["traces"][0]
+        assert row["spans"] == 2
+        assert row["root"] == "serve.request"
+        assert row["dur_ms"] == pytest.approx(50.0, abs=1.0)
+        assert len(trace_index.fetch_trace(span_dir, "cross")) == 2
+    finally:
+        exp.close()
+
+
+def test_retention_prunes_sidecars_with_segments(tmp_path):
+    span_dir = str(tmp_path / "spool")
+    exp = SpanExporter(span_dir, segment_bytes=512, segment_age_s=30.0,
+                       max_dir_bytes=2048)
+    try:
+        for i in range(200):
+            for s in _request_tree(f"r{i:04d}", time.time() + i * 1e-3):
+                exp.offer(s)
+            if i % 20 == 0:
+                exp.flush()
+        exp.flush()
+        assert exp.segments_pruned_total > 0
+        # no orphan sidecars: every .idx.json has its segment
+        names = set(os.listdir(span_dir))
+        for n in sorted(names):
+            if n.endswith(trace_index.INDEX_SUFFIX):
+                assert n[:-len(trace_index.INDEX_SUFFIX)] in names, \
+                    f"orphan sidecar {n}"
+    finally:
+        exp.close()
+
+
+# --- spool-reader edge cases (satellite) -------------------------------------
+
+def test_search_skips_torn_open_segment_tail(tmp_path):
+    exp, span_dir = _spool_with_traces(tmp_path, n=3)
+    try:
+        exp.drain()
+        # simulate a killed writer: half a JSON line at the open tail
+        open_files = [n for n in os.listdir(span_dir)
+                      if n.startswith(".spool-")]
+        assert open_files, "expected an open spool"
+        with open(os.path.join(span_dir, open_files[0]), "a") as fp:
+            fp.write('{"name": "serve.request", "trace": "torn01", '
+                     '"span": "x", "ts": 1')
+        res = trace_index.search(span_dir, {})
+        assert res["count"] == 3
+        assert all(r["trace"] != "torn01" for r in res["traces"])
+    finally:
+        exp.close()
+
+
+def test_rotation_racing_concurrent_spool_read(tmp_path):
+    """A reader hammering the spool while the writer rotates tiny
+    segments must never crash and never see a span twice; every
+    offered span is readable once the writer settles."""
+    span_dir = str(tmp_path / "spool")
+    exp = SpanExporter(span_dir, segment_bytes=600, segment_age_s=30.0,
+                       max_dir_bytes=64 << 20)
+    errors: list = []
+    stop = threading.Event()
+
+    def reader():
+        while not stop.is_set():
+            try:
+                read_spool(span_dir)
+                trace_index.search(span_dir, {"kernel": "tiny"})
+            except Exception as exc:  # pragma: no cover - the point
+                errors.append(exc)
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    total = 0
+    try:
+        for i in range(120):
+            for s in _request_tree(f"race{i:04d}", time.time() + i):
+                exp.offer(s)
+                total += 1
+            exp.drain()  # interleave writes with reader traffic
+        exp.flush()
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        exp.close()
+    assert errors == []
+    spans = read_spool(span_dir)
+    assert len(spans) == total
+    res = trace_index.search(span_dir, {"kernel": "tiny",
+                                        "limit": 1000})
+    assert res["count"] == 120
+
+
+# --- critical-path attribution ----------------------------------------------
+
+def test_critical_path_self_times_simple_tree():
+    t0 = 1000.0
+    spans = _request_tree("c1", t0, total=0.010, queue=0.006)
+    selfs = analyze.phase_self_times(spans)
+    assert selfs["queue_wait"] == pytest.approx(0.006, abs=1e-6)
+    assert selfs["parse"] == pytest.approx(0.001, abs=1e-6)
+    assert selfs["device_launch"] == pytest.approx(0.002, abs=1e-6)
+    assert selfs["d2h"] == pytest.approx(0.001, abs=1e-6)
+    # the root owns nothing: its children tile it end to end
+    assert selfs.get("serve.request", 0.0) == pytest.approx(0.0,
+                                                            abs=1e-6)
+    assert sum(selfs.values()) == pytest.approx(0.010, abs=1e-5)
+
+
+def test_critical_path_charges_uncovered_gap_to_parent():
+    t0 = 1000.0
+    root = _mk_span("g1", "serve.request", t0, 0.010, span="g1-root")
+    kid = _mk_span("g1", "parse", t0, 0.002, parent="g1-root")
+    selfs = analyze.phase_self_times([root, kid])
+    assert selfs["serve.request"] == pytest.approx(0.008, abs=1e-6)
+    assert selfs["parse"] == pytest.approx(0.002, abs=1e-6)
+
+
+def test_cross_host_stitch_attributes_remote_wait():
+    """A remote batch: the router's mesh.route/d2h window contains the
+    worker's own root (same trace, different host).  The injected gap
+    between RPC start and the worker's accounted time must land on the
+    ROUTER-side wait phase, not vanish."""
+    t0 = 2000.0
+    rpc = 0.150  # whole worker RPC window
+    spans = [
+        _mk_span("x1", "serve.request", t0, 0.160, span="x1-root",
+                 kernel="tiny", outcome="ok"),
+        _mk_span("x1", "parse", t0, 0.001, parent="x1-root"),
+        _mk_span("x1", "queue_wait", t0 + 0.001, 0.004,
+                 parent="x1-root"),
+        # batcher's remote batch: device_launch ~0, d2h = the collect
+        # wait, mesh.route = the whole RPC window (sibling containment)
+        _mk_span("x1", "device_launch", t0 + 0.005, 0.0001,
+                 parent="x1-root"),
+        _mk_span("x1", "d2h", t0 + 0.0051, rpc - 0.0001,
+                 parent="x1-root"),
+        _mk_span("x1", "mesh.route", t0 + 0.005, rpc,
+                 parent="x1-root", worker="w:1"),
+        # the worker's half: starts 120ms into the RPC (injected
+        # latency before its handler ran), accounts 25ms
+        _mk_span("x1", "serve.request", t0 + 0.125, 0.025,
+                 span="x1-wroot", host="w:1", role="worker",
+                 kernel="tiny", outcome="ok"),
+        _mk_span("x1", "queue_wait", t0 + 0.126, 0.020,
+                 parent="x1-wroot", host="w:1", role="worker"),
+    ]
+    roots, children = analyze.build_tree(spans)
+    assert len(roots) == 1  # the worker root was stitched in
+    selfs = analyze.phase_self_times(spans)
+    # d2h (the remote wait) owns everything the worker never accounted
+    # for: the injected 120ms before its handler ran plus the 5ms
+    # response tail; the worker's queue_wait owns its 20ms
+    assert selfs["d2h"] == pytest.approx(0.125, abs=0.002)
+    assert selfs["queue_wait"] == pytest.approx(0.004 + 0.020,
+                                                abs=0.002)
+    assert selfs.get("mesh.route", 0.0) < 0.001
+
+
+def test_critical_report_shares_and_top_phase():
+    traces = []
+    for i in range(20):
+        traces.append(_request_tree(f"s{i:02d}", 3000.0 + i,
+                                    total=0.010, queue=0.006))
+    rep = analyze.critical_report(traces, "tiny", None)
+    assert rep["traces_analyzed"] == 20
+    assert rep["top_phase"] == "queue_wait"
+    assert rep["phases"]["queue_wait"]["share_p99"] == pytest.approx(
+        0.6, abs=0.05)
+    assert rep["critical_ms"]["p99"] == pytest.approx(10.0, abs=0.5)
+    shares = sum(p["share_p99"] for p in rep["phases"].values())
+    assert shares == pytest.approx(1.0, abs=0.01)
+
+
+def test_critical_report_skips_structureless_traces():
+    lone = [[_mk_span("l1", "serve.request", 0.0, 0.01)]]
+    rep = analyze.critical_report(lone, None, None)
+    assert rep["traces_analyzed"] == 0
+    assert rep["phases"] == {} and rep["top_phase"] is None
+
+
+# --- incident timeline -------------------------------------------------------
+
+def test_timeline_merges_events_jobs_and_roots_in_order():
+    t0 = 5000.0
+    spans = [
+        _mk_span("mesh", "mesh.shed_engaged", t0 + 2.0, 0.0,
+                 lane="low"),
+        _mk_span("events", "event.slo_burn", t0 + 1.5, 0.0,
+                 kernel="tiny", objective="availability"),
+        _mk_span("job:job-000001", "job.state", t0 + 1.0, 0.0,
+                 job="job-000001", status="running",
+                 previous="queued", epoch=0),
+        _mk_span("mesh", "mesh.shed_cleared", t0 + 4.0, 0.0),
+        # a request root rides along; its phase children do not
+        *_request_tree("t1", t0, total=0.010),
+    ]
+    entries = analyze.build_timeline(spans)
+    names = [e["name"] for e in entries]
+    assert names == ["serve.request", "job.state", "event.slo_burn",
+                     "mesh.shed_engaged", "mesh.shed_cleared"]
+    kinds = {e["name"]: e["kind"] for e in entries}
+    assert kinds["event.slo_burn"] == "slo"
+    assert kinds["mesh.shed_engaged"] == "slo"
+    assert kinds["job.state"] == "jobs"
+    assert kinds["serve.request"] == "span"
+    # detail carries the structured fields
+    burn = next(e for e in entries if e["name"] == "event.slo_burn")
+    assert burn["detail"]["objective"] == "availability"
+    # since/until/limit bound the view
+    assert len(analyze.build_timeline(spans, since=t0 + 1.9)) == 2
+    assert len(analyze.build_timeline(spans, until=t0 + 1.1)) == 2
+    assert len(analyze.build_timeline(spans, limit=1)) == 1
+
+
+def test_nn_event_records_event_span_when_tracing(capsys):
+    nn_log.set_verbosity(1)
+    nn_log.nn_event("ckpt_fallback", bundle="b-1", reason="torn")
+    assert obs_trace.snapshot() == []  # tracing off: nothing recorded
+    obs_trace.enable(256)
+    nn_log.nn_event("ckpt_fallback", bundle="b-2", reason="torn")
+    spans = obs_trace.snapshot(trace_id=nn_log.EVENTS_TRACE_ID)
+    assert len(spans) == 1
+    assert spans[0]["name"] == "event.ckpt_fallback"
+    assert spans[0]["bundle"] == "b-2"
+    assert spans[0]["dur_s"] == 0.0
+    # console emission unchanged by the recording
+    out = capsys.readouterr().out
+    assert out.count("ckpt_fallback:") == 2
+
+
+def test_nn_event_structural_field_collision_stays_in_events_trace():
+    """An event carrying a field named like a span-record structural
+    key (the batcher's slow_request has ``trace=<request id>``) must
+    stay under the EVENTS trace with the field remapped -- not re-home
+    itself into the request's trace as a spurious second root that
+    hijacks the critical path."""
+    obs_trace.enable(256)
+    nn_log.set_verbosity(0)
+    nn_log.nn_event("slow_request", kernel="tiny", trace="req123",
+                    seconds=0.5, ts=123.0)
+    assert obs_trace.snapshot(trace_id="req123") == []
+    spans = obs_trace.snapshot(trace_id=nn_log.EVENTS_TRACE_ID)
+    assert len(spans) == 1
+    s = spans[0]
+    assert s["name"] == "event.slow_request"
+    assert s["event_trace"] == "req123"  # remapped, not dropped
+    assert s["event_ts"] == 123.0
+    assert s["dur_s"] == 0.0 and s["kernel"] == "tiny"
+
+
+def test_job_store_update_records_state_transition(tmp_path):
+    from hpnn_tpu.jobs.state import JobStore
+
+    obs_trace.enable(256)
+    store = JobStore(str(tmp_path / "jobs"))
+    job = store.create("tiny", {})
+    store.update(job, status="running", started=time.time())
+    store.update(job, epoch=1)  # no status change: no span
+    store.update(job, status="done")
+    spans = obs_trace.snapshot(trace_id=f"job:{job.job_id}")
+    states = [(s["previous"], s["status"]) for s in spans
+              if s["name"] == "job.state"]
+    assert states == [("", "queued"), ("queued", "running"),
+                      ("running", "done")]
+
+
+# --- event-name registry (satellite) ----------------------------------------
+
+_EVENT_CALL_RE = re.compile(
+    r"\b(nn_event|mesh_event|nn_log\.nn_event)\(\s*(.)", re.S)
+_EVENT_NAME_RE = re.compile(r'^"([a-zA-Z0-9_]+)"')
+
+
+def test_every_emitted_event_name_is_declared():
+    """Source scan: every literal ``nn_event``/``mesh_event`` name in
+    hpnn_tpu/ must be declared in obs.EVENT_NAMES (mesh_event names
+    with the ``mesh_`` prefix), and no call site may pass a dynamic
+    (non-literal) name -- the timeline's event -> category mapping
+    stays honest by construction.  The generic relay in
+    serve/mesh/events.py is the one allowed non-literal site."""
+    offenders = []
+    found: set = set()
+    root = os.path.join(REPO, "hpnn_tpu")
+    for dirpath, _dirs, files in sorted(os.walk(root)):
+        for fname in sorted(files):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root)
+            src = open(path).read()
+            for m in _EVENT_CALL_RE.finditer(src):
+                fn = m.group(1)
+                if "def " in src[max(0, m.start() - 4):m.start()]:
+                    continue
+                tail = src[m.start(2):m.start(2) + 120]
+                if rel == os.path.join("serve", "mesh", "events.py") \
+                        and 'f"mesh_{event}"' in tail:
+                    continue  # the relay: names come from its callers
+                if rel == os.path.join("utils", "nn_log.py"):
+                    continue  # the emitter itself
+                name_m = _EVENT_NAME_RE.match(tail)
+                lineno = src[:m.start()].count("\n") + 1
+                if name_m is None:
+                    offenders.append(
+                        f"{rel}:{lineno}: non-literal {fn} name: "
+                        f"{tail.splitlines()[0]!r}")
+                    continue
+                name = name_m.group(1)
+                if fn == "mesh_event":
+                    name = "mesh_" + name
+                found.add(name)
+                if name not in obs.EVENT_NAMES:
+                    offenders.append(
+                        f"{rel}:{lineno}: event {name!r} not declared "
+                        "in obs.EVENT_NAMES")
+    assert offenders == [], "\n".join(offenders)
+    # and the registry carries no dead entries
+    dead = set(obs.EVENT_NAMES) - found
+    assert dead == set(), f"EVENT_NAMES entries never emitted: {dead}"
+
+
+# --- endpoints + offline tool ------------------------------------------------
+
+def _run_tool(*args):
+    out = subprocess.run(
+        [sys.executable, "-m", "hpnn_tpu.obs.tool", *args],
+        capture_output=True, cwd=REPO,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert out.returncode == 0, out.stderr.decode()
+    return out.stdout
+
+
+def test_endpoints_over_http_and_tool_byte_identity(tmp_path):
+    conf = _write_kernel_conf(tmp_path)
+    spool = str(tmp_path / "spool")
+    app = ServeApp(max_batch=16, max_queue_rows=256, trace=True,
+                   span_dir=spool)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        xs = np.random.default_rng(5).uniform(-1, 1, (3, N_IN))
+        for i in range(6):
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer",
+                {"inputs": xs.tolist()},
+                headers={"X-HPNN-Trace-Id": f"reqtrace{i:02d}"})
+            assert st == 200
+        # settle: the last request's respond span lands right after
+        # its reply -- captures must not race it
+        prev = None
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            _st, cur, _h = _get_raw(
+                base + "/v1/debug/trace/search?kernel=tiny")
+            if cur == prev:
+                break
+            prev = cur
+            time.sleep(0.2)
+        # search finds them, kernel-filtered, via the live endpoint
+        st, body = serve_bench.http_json(
+            base + "/v1/debug/trace/search?kernel=tiny")
+        assert st == 200 and body["count"] == 6
+        assert {r["trace"] for r in body["traces"]} == {
+            f"reqtrace{i:02d}" for i in range(6)}
+        assert all(r["root"] == "serve.request"
+                   and r["status"] == "ok" for r in body["traces"])
+        # critical names a real phase
+        st, crit = serve_bench.http_json(
+            base + "/v1/debug/trace/critical?kernel=tiny")
+        assert st == 200 and crit["traces_analyzed"] == 6
+        # serve.request self-time = the callable-lookup gap (the first
+        # request's XLA compile), which can dominate a cold registry
+        assert crit["top_phase"] in ("device_launch", "queue_wait",
+                                     "respond", "parse", "d2h",
+                                     "batch_assembly", "pad_h2d",
+                                     "serve.request")
+        shares = sum(p["share_p99"] for p in crit["phases"].values())
+        assert shares == pytest.approx(1.0, abs=0.02)
+        # timeline is NDJSON of roots
+        st, raw, _h = _get_raw(base + "/v1/debug/trace?timeline=1")
+        assert st == 200
+        entries = [json.loads(ln) for ln in raw.decode().splitlines()]
+        assert sum(e["name"] == "serve.request" for e in entries) == 6
+        # bad queries 400
+        st, _ = serve_bench.http_json(
+            base + "/v1/debug/trace/search?min_ms=soon")
+        assert st == 400
+        st, _ = serve_bench.http_json(
+            base + "/v1/debug/trace/critical?window=x")
+        assert st == 400
+        # byte-identity: the offline tool over the same span dir
+        # reproduces all three live bodies exactly
+        st, search_raw, _h = _get_raw(
+            base + "/v1/debug/trace/search?kernel=tiny&min_ms=1")
+        st, crit_raw, _h = _get_raw(
+            base + "/v1/debug/trace/critical?kernel=tiny")
+        st, tl_raw, _h = _get_raw(base + "/v1/debug/trace?timeline=1")
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+    assert _run_tool("search", "--span-dir", spool, "--kernel", "tiny",
+                     "--min-ms", "1") == search_raw
+    assert _run_tool("critical", "--span-dir", spool,
+                     "--kernel", "tiny") == crit_raw
+    assert _run_tool("timeline", "--span-dir", spool) == tl_raw
+
+
+def test_tool_index_subcommand_builds_and_reports(tmp_path):
+    exp, span_dir = _spool_with_traces(tmp_path, n=5)
+    try:
+        exp.flush()
+        segs = list_segments(span_dir)
+        for seg in segs:
+            os.unlink(trace_index.index_path(seg))
+    finally:
+        exp.close()
+    out = json.loads(_run_tool("index", "--span-dir", span_dir))
+    assert out["segments"] == len(segs)
+    assert out["built"] == len(segs)
+    assert out["traces"] == 5 and out["spans"] == 25
+    for seg in segs:
+        assert os.path.exists(trace_index.index_path(seg))
+
+
+def test_search_endpoint_without_spool_answers_from_ring(tmp_path):
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=16, max_queue_rows=256, trace=True)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        xs = np.zeros((2, N_IN))
+        st, _ = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", {"inputs": xs.tolist()},
+            headers={"X-HPNN-Trace-Id": "ringtrace"})
+        assert st == 200
+        st, body = serve_bench.http_json(
+            base + "/v1/debug/trace/search?trace=ringtrace")
+        assert st == 200 and body["count"] == 1
+        assert body["traces"][0]["kernel"] == "tiny"
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_search_404_when_tracing_off_and_no_spool(tmp_path):
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=16, trace=False)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        for path in ("/v1/debug/trace/search",
+                     "/v1/debug/trace/critical",
+                     "/v1/debug/trace?timeline=1"):
+            st, body = serve_bench.http_json(base + path)
+            assert st == 404 and body["reason"] == "tracing_disabled"
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- healthz + metrics satellites -------------------------------------------
+
+def test_healthz_reports_slo_burning_and_shed_flag(tmp_path):
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=16, slo_availability=0.9, shed_low=True)
+    app.slo.fast_s = app.slo.slow_s = 10.0
+    app.slo.burn_threshold = 1.0
+    app.slo.eval_interval_s = 0.0
+    app.shedder._eval_every = 0.0
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        st, body = serve_bench.http_json(base + "/healthz")
+        assert st == 200
+        assert body["slo_burning"] == 0
+        assert body["shed_engaged"] is False
+        for _ in range(10):  # all failures: the budget burns
+            app.slo.record_outcome("tiny", False)
+        assert app.slo.any_burning()
+        app.shedder.should_shed(2)  # poll engages the gate
+        st, body = serve_bench.http_json(base + "/healthz")
+        assert st == 200, "status contract must be unchanged"
+        assert body["slo_burning"] >= 1
+        assert body["shed_engaged"] is True
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_healthz_flags_default_without_slo(tmp_path):
+    conf = _write_kernel_conf(tmp_path)
+    app = ServeApp(max_batch=16)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    try:
+        st, body = serve_bench.http_json(base + "/healthz")
+        assert st == 200
+        assert body["slo_burning"] == 0
+        assert body["shed_engaged"] is False
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_metrics_span_spool_gauges_lint(tmp_path):
+    """The span-spool gauges (open bytes, segment count, dropped
+    offers, oldest-segment age, index builds) render in both formats
+    and survive the exposition lint against a populated registry."""
+    from test_obs import _populated_metrics
+
+    exp, span_dir = _spool_with_traces(tmp_path, n=4)
+    try:
+        exp.flush()
+        exp.offer({"name": "pending"})  # open-segment bytes > 0
+        exp.drain()
+        obs_trace.set_exporter(exp)
+        m = _populated_metrics()
+        snap = m.snapshot()
+        se = snap["span_export"]
+        assert se["segments"] >= 1
+        assert se["open_bytes"] > 0
+        assert se["oldest_segment_age_s"] >= 0.0
+        assert se["index_builds_total"] >= 1
+        assert "dropped_total" in se
+        text = m.render_prometheus()
+        series = lint_prometheus(text)
+        names = {name for name, _ in series}
+        for want in ("hpnn_span_export_open_bytes",
+                     "hpnn_span_export_oldest_segment_age_s",
+                     "hpnn_span_export_segments",
+                     "hpnn_span_export_index_builds_total",
+                     "hpnn_span_export_spans_total"):
+            assert want in names, want
+    finally:
+        obs_trace.set_exporter(None)
+        exp.close()
+
+
+# --- the acceptance e2e (slow): real subprocess mesh ------------------------
+
+@pytest.mark.slow
+def test_trace_analytics_e2e_chaos_latency_and_offline_tool(
+        tmp_path, monkeypatch):
+    """Acceptance (ISSUE 15): 2-subprocess-worker mesh under sampled
+    load with a chaos ``latency`` fault on the workers' serve path.
+    Router-side search finds the forced trace by kernel+min_ms AFTER
+    the serving worker is SIGKILLed; critical attributes the injected
+    delay to the remote-wait phase (>= the injected share, within
+    tolerance); the timeline shows shed engage/clear bracketing an SLO
+    burn; and the offline tool reproduces all three answers from the
+    span dir alone after the router is gone."""
+    inj_ms = 120.0
+    conf = _write_kernel_conf(tmp_path)
+    spool = str(tmp_path / "spool")
+    monkeypatch.setenv("HPNN_TRACE_BUFFER", "65536")
+    monkeypatch.setenv("HPNN_FLEET_TRACE_BUFFER", "65536")
+    monkeypatch.setenv("HPNN_FLEET_POLL_S", "0.3")
+    monkeypatch.setenv("HPNN_SPAN_SEGMENT_AGE_S", "0.3")
+    rapp = ServeApp(max_batch=16, max_queue_rows=512, trace=True,
+                    trace_sample=0.5, span_dir=spool,
+                    slo_availability=0.9, shed_low=True)
+    rapp.slo.fast_s = 1.0
+    rapp.slo.slow_s = 2.0
+    rapp.slo.burn_threshold = 2.0
+    rapp.slo.eval_interval_s = 0.0
+    rapp.shedder.clear_after_s = 1.0
+    rapp.shedder._eval_every = 0.05
+    rapp.enable_mesh_router(required_workers=2, health_interval_s=0.2)
+    assert rapp.add_model(conf) is not None
+    rhttpd, _ = serve_in_thread("127.0.0.1", 0, rapp)
+    rport = rhttpd.server_address[1]
+    base = f"http://127.0.0.1:{rport}"
+    procs = []
+    xs = {"inputs": np.zeros((2, N_IN)).tolist()}
+    try:
+        # both workers arm the same server-side schedule: a 503 burst
+        # (the SLO burn) for their first 6 infers, THEN the latency
+        # fault on every one -- bucket affinity pins the whole serial
+        # load to ONE worker, so the burst and the injected delay both
+        # land wherever the router routes.  The spec rides the
+        # environment into the subprocesses only; workers sample at 0
+        # so ONLY router-kept traces capture (fleet-consistent sampled
+        # load)
+        wargs = ("--trace", "--trace-sample", "0")
+        monkeypatch.setenv(
+            "HPNN_FAULT",
+            "http@/v1/kernels/tiny/infer:side=server,every=1,times=6,"
+            f"code=503;latency@/v1/kernels/tiny/infer:side=server,"
+            f"ms={inj_ms:g}")
+        for _ in range(2):
+            procs.append(mesh_bench.spawn_worker(
+                conf, f"127.0.0.1:{rport}", wargs))
+        monkeypatch.delenv("HPNN_FAULT")
+        mesh_bench.wait_healthz_ok(base, timeout_s=120.0)
+
+        # phase 1 -- the 503 burst burns the budget; shed engages on
+        # the low lane, then clears with hysteresis (the timeline must
+        # bracket the burn with engage/clear)
+        saw_503 = 0
+        for _ in range(10):
+            st, _b = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs)
+            if st == 503:
+                saw_503 += 1
+        assert saw_503 >= 4, f"chaos 503 burst never landed ({saw_503})"
+        assert rapp.slo.any_burning()
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs,
+            headers={"X-HPNN-Priority": "low"})
+        assert st == 429 and body["reason"] == "shed"
+        deadline = time.monotonic() + 30
+        st = 429
+        while st == 429 and time.monotonic() < deadline:
+            time.sleep(0.2)
+            st, _b = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs,
+                headers={"X-HPNN-Priority": "low"})
+        assert st == 200, "shed never cleared"
+
+        # phase 2 -- sampled load through the latency fault; one
+        # FORCED trace (explicit id always captures)
+        st, body = serve_bench.http_json(
+            base + "/v1/kernels/tiny/infer", xs,
+            headers={"X-HPNN-Trace-Id": "analytics01"})
+        assert st == 200 and body["trace"] == "analytics01"
+        for _ in range(10):
+            st, _b = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs)
+            assert st == 200
+
+        # the worker that served the forced trace is the victim
+        deadline = time.monotonic() + 30
+        victim_addr = None
+        while victim_addr is None and time.monotonic() < deadline:
+            _st, raw, _h = _get_raw(
+                base + "/v1/debug/trace?trace=analytics01")
+            for ln in raw.decode().splitlines():
+                s = json.loads(ln)
+                if s["name"] == "mesh.route":
+                    victim_addr = s["worker"]
+                    break
+            if victim_addr is None:
+                time.sleep(0.3)
+        assert victim_addr, "forced trace never showed a mesh.route"
+        victim = next(p for p, port in procs
+                      if victim_addr.endswith(f":{port}"))
+        victim.send_signal(signal.SIGKILL)
+        t_kill = time.monotonic()
+        while time.monotonic() - t_kill < 20.0:
+            if rapp.mesh_router.pool.table().get(
+                    victim_addr, {}).get("state") == "dead":
+                break
+            time.sleep(0.1)
+
+        # settle: final collector drain + spool drain, then wait for
+        # the spool to go quiet (byte-stable captures -- the whole
+        # point is that the offline tool reproduces these bytes)
+        rapp.mesh_router.fleet.drain_once()
+        rapp.span_exporter.drain()
+
+        def stable_raw(path: str) -> bytes:
+            prev = None
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                _st, cur, _h = _get_raw(base + path)
+                if cur == prev:
+                    return cur
+                prev = cur
+                time.sleep(0.5)
+            return prev
+
+        search_path = "/v1/debug/trace/search?kernel=tiny&min_ms=80"
+        search_raw = stable_raw(search_path)
+
+        # --- search: the forced trace, by kernel+min_ms, AFTER the
+        # worker that served it is dead
+        res = json.loads(search_raw)
+        by_id = {r["trace"]: r for r in res["traces"]}
+        assert "analytics01" in by_id, sorted(by_id)
+        assert by_id["analytics01"]["kernel"] == "tiny"
+        assert by_id["analytics01"]["dur_ms"] >= inj_ms * 0.8
+        assert by_id["analytics01"]["status"] == "ok"
+
+        # --- critical: the injected delay is attributed to the
+        # remote-wait phase at >= the injected share (with tolerance)
+        crit_raw = stable_raw("/v1/debug/trace/critical?kernel=tiny")
+        crit = json.loads(crit_raw)
+        assert crit["traces_analyzed"] >= 3
+        p99 = crit["critical_ms"]["p99"]
+        assert p99 >= inj_ms * 0.8
+        injected_share = inj_ms / p99
+        wait_phase = crit["phases"].get("d2h") or {}
+        assert wait_phase.get("p99_self_ms", 0.0) >= inj_ms * 0.6, crit
+        assert wait_phase.get("share_p99", 0.0) >= \
+            injected_share * 0.6, crit
+        # the remote wait out-ranks every SERVING phase the injection
+        # could be confused with (pad_h2d/serve.request may carry the
+        # worker's one-off first-request XLA compile, which is real
+        # and honestly attributed -- but it is not the injected fault)
+        for other in ("queue_wait", "device_launch", "mesh.route",
+                      "parse", "batch_assembly", "respond"):
+            o = crit["phases"].get(other) or {}
+            assert wait_phase["p99_self_ms"] >= \
+                o.get("p99_self_ms", 0.0), (other, crit)
+        # no event/mesh pseudo-traces polluted the kernel report
+        assert not any(n.startswith(("event.", "mesh.shed"))
+                       for n in crit["phases"]), crit["phases"]
+
+        # --- timeline: shed engage/clear bracketing the burn.  The
+        # until bound is FIXED at capture time, so the live bytes and
+        # the post-mortem tool's answer cover the same window even if
+        # shutdown writes more events later
+        t_cap = f"{time.time():.6f}"
+        tl_raw = stable_raw(
+            f"/v1/debug/trace?timeline=1&until={t_cap}")
+        entries = [json.loads(ln) for ln in
+                   tl_raw.decode().splitlines()]
+        names = [e["name"] for e in entries]
+        assert "mesh.shed_engaged" in names
+        assert "mesh.shed_cleared" in names
+        assert "event.slo_burn" in names
+        t_of = {e["name"]: e["ts"] for e in entries}
+        assert t_of["event.slo_burn"] <= t_of["mesh.shed_engaged"] \
+            + 0.5
+        assert t_of["mesh.shed_engaged"] < t_of["mesh.shed_cleared"]
+        burn_clear = [e["ts"] for e in entries
+                      if e["name"] == "event.slo_burn_cleared"]
+        if burn_clear:  # the burn-out lands inside the bracket
+            assert t_of["mesh.shed_engaged"] <= burn_clear[0] \
+                <= t_of["mesh.shed_cleared"] + 0.5
+    finally:
+        for proc, _port in procs:
+            if proc.poll() is None:
+                proc.kill()
+        rhttpd.shutdown()
+        rapp.close(drain=True)
+
+    # --- the router is GONE: the offline tool reproduces all three
+    # answers byte-identically from the span dir alone
+    assert _run_tool("search", "--span-dir", spool, "--kernel", "tiny",
+                     "--min-ms", "80") == search_raw
+    assert _run_tool("critical", "--span-dir", spool,
+                     "--kernel", "tiny") == crit_raw
+    assert _run_tool("timeline", "--span-dir", spool,
+                     "--until", t_cap) == tl_raw
