@@ -30,12 +30,13 @@ JOBS_TESTS = tests/test_jobs.py
 OBS_TESTS = tests/test_obs.py tests/test_fleet_obs.py
 TRACE_TESTS = tests/test_trace_analytics.py
 AUTOSCALE_TESTS = tests/test_autoscale.py
+LNN_TESTS = tests/test_lnn.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
 	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(TRAIN_CHAOS_TESTS) \
 	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) $(TRACE_TESTS) \
-	    $(AUTOSCALE_TESTS) -q
+	    $(AUTOSCALE_TESTS) $(LNN_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -202,6 +203,22 @@ mesh-bench:
 	python scripts/mesh_bench.py --out MESH_BENCH.json \
 	    $(if $(REAL),--real)
 
+# regression-workloads tier (ISSUE 16): the default-mode LNN byte-parity
+# pin (LNN stdout == SNN stdout, fallthrough preserved), native
+# linear-head training/eval (--lnn native / HPNN_LNN_NATIVE=1), the
+# trainer registry + batched CG trainer, conf/CLI grammar
+lnn-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(LNN_TESTS) -q
+
+# trainer race harness (ISSUE 16): {BP, BPM, CG} x {ANN, SNN, LNN} from
+# one seeded kernel, error-vs-wall trajectories + gap-closure
+# epochs-to-target per cell; emits TRAINERS_BENCH.json, rc!=0 unless
+# CG beats BP somewhere and every cell ran.  tests/test_bench_probe.py
+# holds the committed artifact to the same floors in `make check` tier 1
+trainers-bench:
+	env JAX_PLATFORMS=cpu python scripts/trainers_bench.py \
+	    --out TRAINERS_BENCH.json
+
 # fleet observability overhead (ISSUE 10 + 13): the same 2-worker mesh
 # load with tracing + metrics federation OFF vs ON vs SAMPLED
 # (--trace-sample 0.01, the fleet-QPS configuration; forced capture
@@ -215,4 +232,4 @@ obs-bench:
 .PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
     ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
     serve-bench io-bench epoch-bench dp-epoch-bench mfu-bench \
-    mesh-bench autoscale-check trace-check
+    mesh-bench autoscale-check trace-check lnn-check trainers-bench

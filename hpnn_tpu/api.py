@@ -57,6 +57,7 @@ from .io.conf import (
     NN_TRAIN_BP,
     NN_TRAIN_BPM,
     NN_TYPE_ANN,
+    NN_TYPE_LNN,
     NN_TYPE_SNN,
     NN_TYPE_UKN,
     NNConf,
@@ -86,6 +87,10 @@ class NNDef:
     # etc.), read by the checkpoint manager for the manifest's error
     # trajectory; None until an epoch has run
     last_epoch_stats: dict | None = None
+    # native-trainer carry (hpnn_tpu.train): e.g. the CG direction /
+    # prior gradient / restart counter.  Snapshotted and restored by the
+    # checkpoint subsystem for bit-exact resume, like BPM momentum.
+    trainer_state: dict | None = None
 
     # accessor parity with _NN(get,n_inputs) etc. (libhpnn.c:1013-1066)
     @property
@@ -565,7 +570,7 @@ class _EpochPipeline:
                     tile=tile, storage=tstorage, route=troute)
             else:
                 self.train_fn, _ = ops.select_train_epoch(
-                    self.dtype, donate=True, defer_stats=True)
+                    self.dtype, donate=True, defer_stats=True, kind=kind)
         if self.weights is None:
             # first epoch (or post-resume) staging from the float64 host
             # weights; afterwards the carry never leaves the device
@@ -934,8 +939,9 @@ def _train_kernel_pipelined(nn, pipe: _EpochPipeline, kind: str,
     EPOCH_METRICS["h2d_bytes"] += pipe.h2d_last
     EPOCH_METRICS["epochs"] += 1
     EPOCH_METRICS["mode"] = pipe.mode
-    # the reference tail (libhpnn.c:1291-1301)
-    if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN):
+    # the reference tail (libhpnn.c:1291-1301); native LNN (kind) skips
+    # the unimplemented warning like ANN/SNN
+    if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN) or kind == NN_TYPE_LNN:
         if momentum:
             nn.kernel.momentum_free()
     else:
@@ -946,6 +952,23 @@ def _train_kernel_pipelined(nn, pipe: _EpochPipeline, kind: str,
         # still device-resident between calls, just not deferred
         pipe.join(nn)
     return True
+
+
+def kernel_kind(conf: NNConf) -> str:
+    """The compute family a conf's model actually trains/evals with.
+
+    The reference routes LNN through the SNN code paths after warning
+    (``libhpnn.c:1260-1261``) -- the default here, byte-for-byte.  With
+    the native LNN opt-in (``[lnn] native`` / ``--lnn native`` /
+    ``HPNN_LNN_NATIVE=1``) the linear-output regression head
+    (ops.steps) takes over instead."""
+    if conf.type == NN_TYPE_ANN:
+        return NN_TYPE_ANN
+    from .train import native_lnn
+
+    if native_lnn(conf):
+        return NN_TYPE_LNN
+    return NN_TYPE_SNN
 
 
 def train_kernel(nn: NNDef) -> bool:
@@ -961,9 +984,12 @@ def train_kernel(nn: NNDef) -> bool:
     if conf.type == NN_TYPE_UKN:
         return False
     momentum = conf.train == NN_TRAIN_BPM
+    from .train import native_lnn, native_trainer
+
+    lnn_native = native_lnn(conf)
 
     def _prologue():
-        if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN):
+        if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN) or lnn_native:
             if momentum:
                 # ann_momentum_init (libhpnn.c:1175)
                 nn.kernel.momentum_init()
@@ -972,7 +998,8 @@ def train_kernel(nn: NNDef) -> bool:
             # training proceeds through the SNN fallthrough
             # (libhpnn.c:1180-1182, 1260-1261).  (LNN+BPM would
             # dereference NULL momentum there; we train with zeroed
-            # momentum instead -- documented deviation.)
+            # momentum instead -- documented deviation.)  The native
+            # linear-output opt-in (kernel_kind) silences this.
             nn_error("unimplemented NN type!\n")
 
     if pipeline_active(nn) and getattr(nn, "_pipeline_defer", False):
@@ -1008,8 +1035,8 @@ def train_kernel(nn: NNDef) -> bool:
     # weights carried on device epoch to epoch
     pipe = _pipeline_for(nn, conf)
     if pipe is not None:
-        kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
-        return _train_kernel_pipelined(nn, pipe, kind, momentum)
+        return _train_kernel_pipelined(nn, pipe, kernel_kind(conf),
+                                       momentum)
 
     names = list_sample_dir(conf.samples)
     staged = None
@@ -1030,7 +1057,7 @@ def train_kernel(nn: NNDef) -> bool:
             staged = tuple(jnp.asarray(w, dtype=wdtype)
                            for w in nn.kernel.weights)
             if conf.batch <= 0 and _model_shards(conf) <= 1:
-                ops.select_train_epoch(dtype)
+                ops.select_train_epoch(dtype, kind=kernel_kind(conf))
         with phase("load_samples"):
             events, xs, ts = handle.result()
         EPOCH_METRICS["stage_s"] += time.perf_counter() - t_stage
@@ -1055,12 +1082,31 @@ def train_kernel(nn: NNDef) -> bool:
     def finish() -> bool:
         # the tail the reference always runs (libhpnn.c:1291-1301):
         # momentum teardown for ANN/SNN, second warning for LNN
-        if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN):
+        if conf.type in (NN_TYPE_ANN, NN_TYPE_SNN) or lnn_native:
             if momentum:
                 nn.kernel.momentum_free()  # ann_momentum_free (libhpnn.c:1297)
         else:
             nn_error("unimplemented NN type!\n")
         return True
+
+    # native trainer registry (hpnn_tpu.train): an opted-in entry (e.g.
+    # --trainer cg on a [train] CG conf) takes the whole epoch here --
+    # whole-corpus GEMM-shaped loss/grad, its own one-line-per-epoch
+    # grammar.  Without the opt-in, [train] CG keeps the reference's
+    # untrainable fallthrough below, byte-for-byte.
+    entry = native_trainer(conf)
+    if entry is not None and xs is not None:
+        kind = kernel_kind(conf)
+        weights = staged
+        trace_weights(weights, "train-in")
+        with phase(f"train_epoch_{entry.name}"):
+            new_weights = entry.run_epoch(nn, weights, xs, ts, kind,
+                                          wdtype)
+            nn.kernel.weights = [np.asarray(w, dtype=np.float64)
+                                 for w in new_weights]
+        ok = finish()
+        trace_weights(nn.kernel.weights, "train-out")
+        return ok
 
     trainable = conf.train in (NN_TRAIN_BP, NN_TRAIN_BPM)
     if xs is None or not trainable:
@@ -1076,7 +1122,8 @@ def train_kernel(nn: NNDef) -> bool:
     # (names is not None on every path reaching here, so staged is set)
     weights = staged
     # LNN trains through the SNN fallthrough (libhpnn.c:1260-1261)
-    kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
+    # unless the native linear-output head is opted in (kernel_kind)
+    kind = kernel_kind(conf)
     trace_weights(weights, "train-in")
 
     # prefetch the TEST corpus while the epoch runs on device: the host
@@ -1135,7 +1182,7 @@ def train_kernel(nn: NNDef) -> bool:
             train_epoch_fn, _ = ops.select_train_epoch(
                 dtype, tile=tile, storage=tstorage, route=troute)
         else:
-            train_epoch_fn, _ = ops.select_train_epoch(dtype)
+            train_epoch_fn, _ = ops.select_train_epoch(dtype, kind=kind)
         t_up = time.perf_counter()
         xs_dev = jnp.asarray(xs, dtype=dtype)
         ts_dev = jnp.asarray(ts, dtype=dtype)
@@ -1570,7 +1617,7 @@ def run_kernel(nn: NNDef) -> None:
                 dtype = _dtype_of(conf)
                 weights = tuple(jnp.asarray(w, dtype=dtype)
                                 for w in nn.kernel.weights)
-                ops.select_run_batch(dtype)
+                ops.select_run_batch(dtype, kind=kernel_kind(conf))
             with phase("load_tests"):
                 events, xs, ts = handle.result()
             if xs is not None:
@@ -1609,8 +1656,9 @@ def run_kernel(nn: NNDef) -> None:
     # weights/xs_dev were staged during the overlapped load: every path
     # reaching the eval below had usable names + loaded rows
     dtype = _dtype_of(conf)
-    # LNN evaluates through the SNN branch (libhpnn.c:1455-1456)
-    kind = NN_TYPE_SNN if conf.type != NN_TYPE_ANN else NN_TYPE_ANN
+    # LNN evaluates through the SNN branch (libhpnn.c:1455-1456) unless
+    # the native linear-output head is opted in (kernel_kind)
+    kind = kernel_kind(conf)
     model_shards = _model_shards(conf)
     with phase("eval_batch"):
         if model_shards > 1:
@@ -1623,7 +1671,7 @@ def run_kernel(nn: NNDef) -> None:
             outs = np.asarray(tp_run_batch(weights, xs_dev, kind, mesh),
                               dtype=np.float64)
         else:
-            run_batch_fn, _ = ops.select_run_batch(dtype)
+            run_batch_fn, _ = ops.select_run_batch(dtype, kind=kind)
             outs = np.asarray(run_batch_fn(weights, xs_dev, kind),
                               dtype=np.float64)
 
@@ -1648,6 +1696,19 @@ def run_kernel(nn: NNDef) -> None:
                 nn_cout(" [PASS]\n")
             else:
                 nn_cout(f" [FAIL idx={target + 1}]\n")
+        elif kind == NN_TYPE_LNN:
+            # native LNN regression grammar (new capability -- the
+            # reference has no LNN test path): per-output values at DBG,
+            # one MSE summary per file.  No PASS/FAIL verdict: regression
+            # has no class to match.
+            nn_dbg("   IDX |          OUTPUT |          TARGET\n")
+            nn_dbg("-------|-----------------|----------------\n")
+            for idx in range(n_out):
+                nn_dbg(f" {idx + 1:5d} | {out[idx]:15.10f} "
+                       f"| {t[idx]:15.10f}\n")
+            nn_dbg("-------|-----------------|----------------\n")
+            mse = float(np.mean((out - t) ** 2))
+            nn_cout(f" MSE={mse:15.10f}\n")
         else:
             # SNN: res=0; guess=0; is_ok=0  (libhpnn.c:1499-1514)
             res = 0.0
@@ -1723,6 +1784,9 @@ def train_job(conf_path: str, *, epochs: int, ckpt_dir: str,
         nn.kernel.weights = list(snap.weights)
         nn.conf.seed = snap.seed
         start_epoch = snap.epoch
+        # native-trainer carry (CG direction / prior gradient / restart
+        # counter): restored like BPM momentum for bit-exact resume
+        nn.trainer_state = snap.trainer_state
     mgr = CheckpointManager(ckpt_dir, every=ckpt_every,
                             keep_last=ckpt_keep, target_epochs=epochs,
                             replicate_to=replicate_to,

@@ -102,6 +102,13 @@ class CheckpointManager:
             "train": conf.train,
             "dtype": conf.dtype,
             "target_epochs": self.target_epochs,
+            # native-trainer carry (CG direction/grad/meta) -- copied so
+            # the async writer sees the epoch-boundary state even if the
+            # next epoch mutates it in place
+            "trainer_state": ({k: np.array(v) for k, v in
+                               nn.trainer_state.items()}
+                              if getattr(nn, "trainer_state", None)
+                              else None),
         }
 
     # --- saving -----------------------------------------------------------
@@ -173,7 +180,8 @@ class CheckpointManager:
             momentum=job["momentum"], rng_state=job["rng_state"],
             seed=job["seed"], errors=job["errors"], name=job["name"],
             train=job["train"], dtype=job["dtype"],
-            target_epochs=job["target_epochs"])
+            target_epochs=job["target_epochs"],
+            trainer_state=job.get("trainer_state"))
         snap.publish_snapshot(self.ckpt_dir, entry, seed=job["seed"],
                               errors=job["errors"],
                               keep_last=self.keep_last)

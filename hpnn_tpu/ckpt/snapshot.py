@@ -102,6 +102,9 @@ class SnapshotState:
     path: str                          # bundle directory
     fingerprint: str | None            # of kernel.opt in the bundle
     target_epochs: int = 0             # the run's --epochs goal (0: unknown)
+    # native-trainer carry (hpnn_tpu.train): flat f64 arrays keyed
+    # cg_d/cg_g/cg_meta for the CG trainer (None for BP/BPM)
+    trainer_state: dict | None = None
 
     @property
     def topology(self) -> list[int]:
@@ -125,7 +128,7 @@ def _durable_write(path: str, data: bytes) -> None:
 
 
 def _state_npz_bytes(weights, momentum, rng_state, epoch: int,
-                     seed: int) -> bytes:
+                     seed: int, trainer_state=None) -> bytes:
     arrays = {f"w{i}": np.asarray(w, dtype=np.float64)
               for i, w in enumerate(weights)}
     if momentum is not None:
@@ -133,6 +136,15 @@ def _state_npz_bytes(weights, momentum, rng_state, epoch: int,
                        for i, m in enumerate(momentum)})
     if rng_state is not None:
         arrays["rng"] = np.asarray(rng_state, dtype=np.int64)
+    if trainer_state:
+        # native-trainer carry (CG direction/grad/meta); keys are
+        # namespaced "cg_*" so the momentum loader's "m"-prefix filter
+        # and these never collide
+        for k, v in trainer_state.items():
+            if not k.startswith("cg_"):
+                raise ValueError(f"trainer_state key {k!r} must be "
+                                 "namespaced 'cg_*'")
+            arrays[k] = np.asarray(v)
     arrays["meta"] = np.asarray([int(epoch), int(seed)], dtype=np.int64)
     buf = io.BytesIO()
     np.savez(buf, **arrays)
@@ -168,7 +180,7 @@ def _verify_staged(path: str, data: bytes) -> None:
 def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
                    rng_state, seed: int, errors, name: str = "(null)",
                    train: str = "", dtype: str = "f64",
-                   target_epochs: int = 0) -> dict:
+                   target_epochs: int = 0, trainer_state=None) -> dict:
     """Write one atomic bundle for ``epoch``; returns its index entry
     (tag/epoch/mean_err/fingerprint) for the manifest.  Every staged
     file is read back and byte-verified before the directory rename;
@@ -186,7 +198,7 @@ def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
     kernel_text = dumps_kernel(Kernel(name=name, weights=list(weights)))
     kernel_bytes = encode_kernel_text(kernel_text)
     state_bytes = _state_npz_bytes(weights, momentum, rng_state, epoch,
-                                   seed)
+                                   seed, trainer_state)
     fp_kernel = fingerprint_bytes(kernel_bytes)
     errors = [None if e is None else float(e) for e in errors]
     meta = {
@@ -203,6 +215,7 @@ def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
         "train": train,
         "dtype": dtype,
         "momentum": momentum is not None,
+        "trainer_state": bool(trainer_state),
         "target_epochs": int(target_epochs),
         "created": time.time(),
     }
@@ -494,6 +507,8 @@ def _load_bundle_state(bundle: str) -> SnapshotState | None:
                 (k for k in z.files if k.startswith("m") and k != "meta"),
                 key=lambda k: int(k[1:]))] or None
             rng = [int(v) for v in z["rng"]] if "rng" in z.files else None
+            trainer_state = {k: z[k] for k in z.files
+                             if k.startswith("cg_")} or None
             epoch, seed = (int(v) for v in z["meta"])
     except (OSError, KeyError, ValueError) as exc:
         nn_error(f"CKPT: unreadable snapshot state in {bundle}: {exc}\n")
@@ -508,7 +523,8 @@ def _load_bundle_state(bundle: str) -> SnapshotState | None:
                          rng_state=rng, epoch=epoch, seed=seed,
                          errors=errors, tag=os.path.basename(bundle),
                          path=bundle, fingerprint=fp_actual,
-                         target_epochs=int(meta.get("target_epochs", 0)))
+                         target_epochs=int(meta.get("target_epochs", 0)),
+                         trainer_state=trainer_state)
 
 
 def load_snapshot(path: str, verify: bool = True) -> SnapshotState | None:

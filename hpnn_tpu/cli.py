@@ -58,6 +58,10 @@ def _help_text(name: str, train: bool) -> str:
         "--ckpt-dir DIR \tcheckpoint directory (default ./ckpt).",
         "--profile-dir DIR \tcapture the whole run as a jax.profiler",
         "\ttrace into DIR (TensorBoard-loadable; chip-side on TPU).",
+        "--lnn native \topt into the native LNN regression kernel",
+        "\t(linear output head + MSE objective) instead of the",
+        "\treference's warn-and-fallthrough; HPNN_LNN_NATIVE=1 is the",
+        "\tenv equivalent.  Default keeps reference byte parity.",
     ]
     if train:
         lines += [
@@ -86,6 +90,13 @@ def _help_text(name: str, train: bool) -> str:
             "\thttp://HOST:PORT of a mesh router); --resume restores",
             "\tfrom DEST when no local bundle survives.  Default:",
             "\t$HPNN_REPLICATE_TO.",
+            "--trainer T \tselect the trainer from the registry:",
+            "\t'cg' (batched nonlinear conjugate gradient,",
+            "\tPolak-Ribiere + restart, on-device line search;",
+            "\tHPNN_CG_ITERS iterations per epoch), 'bp', or 'bpm'.",
+            "\tWins over the conf [train]/[trainer] keywords; CG",
+            "\tstate (direction/gradient/restarts) rides snapshot",
+            "\tbundles and resumes bit-exactly.",
         ]
     lines += [
         "***********************************",
@@ -103,6 +114,11 @@ _LONG_OPTS = {"--compile-cache": "compile_cache",
               "--ckpt-dir": "ckpt_dir",
               "--profile-dir": "profile_dir",
               "--replicate-to": "replicate_to"}
+# enumerated long options (value must be one of the listed choices).
+# --lnn parses for BOTH train_nn and run_nn (the native regression head
+# applies to eval too); --trainer is train_nn-only.
+_LONG_CHOICE_OPTS = {"--lnn": ("lnn", ("native",), True),
+                     "--trainer": ("trainer", ("cg", "bp", "bpm"), False)}
 # integer-valued long options (value validated like the reference's
 # numeric switches); min value enforced at parse time.  Most are
 # train_nn-only; _SHARED_INT_OPTS also parse for run_nn.
@@ -125,6 +141,7 @@ def _parse_args(argv: list[str], name: str, train: bool):
     filename = None
     extras = {v: None for v in _LONG_OPTS.values()}
     extras.update({v: None for v, _ in _LONG_INT_OPTS.values()})
+    extras.update({v: None for v, _, _ in _LONG_CHOICE_OPTS.values()})
     extras["resume"] = None
     numeric = {"O": runtime.set_omp_threads, "B": runtime.set_omp_blas,
                "S": runtime.set_cuda_streams}
@@ -185,6 +202,20 @@ def _parse_args(argv: list[str], name: str, train: bool):
             extras[dest] = int(digits)
             i += 1
             continue
+        if key in _LONG_CHOICE_OPTS:
+            dest, choices, shared = _LONG_CHOICE_OPTS[key]
+            if train or shared:
+                if not eq:
+                    i += 1
+                    val = argv[i] if i < len(argv) else ""
+                if val.strip().lower() not in choices:
+                    sys.stderr.write(
+                        f"syntax error: bad {key} parameter!\n")
+                    sys.stdout.write(_help_text(name, train))
+                    raise SystemExit(-1)
+                extras[dest] = val.strip().lower()
+                i += 1
+                continue
         if key in _LONG_OPTS:
             if not eq:
                 i += 1
@@ -318,6 +349,19 @@ def _train_nn_body(filename: str, extras: dict) -> int:
     if extras.get("tile") is not None:
         # the CLI flag wins over a [tile] conf keyword
         neural.conf.tile = extras["tile"]
+    if extras.get("lnn"):
+        # --lnn native: opt into the native LNN regression head (wins
+        # over a [lnn] conf keyword, like --tile over [tile])
+        neural.conf.lnn = extras["lnn"]
+    if extras.get("trainer"):
+        # --trainer cg|bp|bpm: select a registry trainer; coerces the
+        # conf [train] type so snapshots/serve report coherently
+        from .io.conf import NN_TRAIN_BP, NN_TRAIN_BPM, NN_TRAIN_CG
+
+        t = extras["trainer"]
+        neural.conf.trainer = t
+        neural.conf.train = {"cg": NN_TRAIN_CG, "bpm": NN_TRAIN_BPM,
+                             "bp": NN_TRAIN_BP}[t]
     replicate_to = extras.get("replicate_to") \
         or os.environ.get("HPNN_REPLICATE_TO") or None
     snap = None
@@ -368,6 +412,9 @@ def _train_nn_body(filename: str, extras: dict) -> int:
         neural.kernel.weights = list(snap.weights)
         neural.conf.seed = snap.seed
         start_epoch = snap.epoch
+        # native-trainer carry (CG direction/grad/restart counter):
+        # restored so the resumed trajectory is bit-exact
+        neural.trainer_state = snap.trainer_state
         if isinstance(resume, str) and not extras.get("ckpt_dir"):
             # an explicit --resume PATH names the run's checkpoint
             # home: continued snapshots go back THERE (the bundle's
@@ -468,6 +515,8 @@ def _run_nn_body(filename: str, extras: dict) -> int:
         sys.stderr.write("FAILED to read NN configuration file! (ABORTING)\n")
         runtime.deinit_all()
         return -1
+    if extras.get("lnn"):
+        neural.conf.lnn = extras["lnn"]
     if neural.conf.f_kernel:
         # staleness guard (checkpoint subsystem): when a manifest has a
         # recorded fingerprint for this exact kernel file and the bytes

@@ -64,6 +64,12 @@ class NNConf:
     #                       GEMM-shaped step; -1 = autotuned; 0 = off.  On
     #                       the [batch] route the batch is the group and
     #                       the value sets launch granularity.
+    lnn: str = ""         # [lnn] native -> native linear-output LNN kernel
+    #                       (hpnn_tpu.train); "" keeps the reference's
+    #                       warn-and-SNN-fallthrough byte-for-byte
+    trainer: str = ""     # [trainer] cg|bp|bpm -> native trainer registry
+    #                       selection (hpnn_tpu.train); cg also coerces
+    #                       [train] to CG.  "" = reference dispatch.
 
 
 def _clean(value: str) -> str:
@@ -154,7 +160,7 @@ def parse_conf(fp: IO[str]) -> NNConf | None:
                 nn_error(f"[output] value: {_after(line, '[output')}")
                 return None
             conf.n_outputs = v
-        if "[train" in line:
+        if "[train" in line and "[trainer" not in line:
             value = _after(line, "[train")
             first = value[:1]
             if first == "B":
@@ -186,6 +192,26 @@ def parse_conf(fp: IO[str]) -> NNConf | None:
                 nn_error(f"[model] value: {_after(line, '[model').strip()}\n")
                 return None
             conf.model = v
+        if "[trainer" in line:
+            value = _clean(_after(line, "[trainer")).lower()
+            if value not in ("cg", "bp", "bpm"):
+                nn_error("Malformed NN configuration file!\n")
+                nn_error(f"[trainer] value: {value}\n")
+                return None
+            conf.trainer = value
+            if value == "cg":
+                conf.train = NN_TRAIN_CG
+            elif value == "bpm":
+                conf.train = NN_TRAIN_BPM
+            elif value == "bp":
+                conf.train = NN_TRAIN_BP
+        if "[lnn" in line:
+            value = _clean(_after(line, "[lnn")).lower()
+            if value != "native":
+                nn_error("Malformed NN configuration file!\n")
+                nn_error(f"[lnn] value: {value}\n")
+                return None
+            conf.lnn = value
         if "[tile" in line:
             rest = _after(line, "[tile")
             if _clean(rest).lower() == "auto":

@@ -49,9 +49,9 @@ from .state import (
 
 __all__ = ["JobScheduler", "JobQueueFull", "JobError"]
 
-_TRAINERS = ("BP", "BPM")
+_TRAINERS = ("BP", "BPM", "CG")
 _DTYPES = ("f64", "f32", "bf16")
-_TYPES = ("ANN", "SNN")
+_TYPES = ("ANN", "SNN", "LNN")
 
 # console.log prefixes per captured nn_log level (replay-equivalent at
 # the verbosity the entries were captured under)
@@ -188,6 +188,14 @@ class JobScheduler:
         if ktype not in _TYPES:
             raise JobError(f"'type' must be one of {_TYPES}: {ktype}")
         clean["type"] = ktype
+        # the native linear-head gate rides the job conf: inherited
+        # from the served model unless overridden at submit, so a job
+        # against a native-LNN kernel trains the same head it serves
+        lnn = str(params.get("lnn")
+                  or getattr(model.nn.conf, "lnn", None) or "").lower()
+        if lnn and lnn != "native":
+            raise JobError(f"'lnn' must be 'native': {lnn}")
+        clean["lnn"] = lnn
         dtype = str(params.get("dtype") or model.dtype_name)
         if dtype not in _DTYPES:
             raise JobError(f"'dtype' must be one of {_DTYPES}: {dtype}")
@@ -263,6 +271,14 @@ class JobScheduler:
             f"[dtype] {clean['dtype']}",
             f"[sample_dir] {clean['samples']}",
         ]
+        if clean["train"] == "CG":
+            # [train] CG alone would warn-and-fall-through like the
+            # reference; the keyword engages the native batched trainer
+            lines.insert(lines.index(f"[train] {clean['train']}") + 1,
+                         "[trainer] cg")
+        if clean.get("lnn"):
+            lines.insert(lines.index(f"[type] {clean['type']}") + 1,
+                         f"[lnn] {clean['lnn']}")
         with open(job.conf_path, "w") as fp:
             fp.write("\n".join(lines) + "\n")
 
@@ -550,19 +566,24 @@ class JobScheduler:
         self.store.update(job, auto_promote={"action": "skipped",
                                              "reason": reason})
 
-    def _eval_generation(self, kernel: str, xs, ts, gen: int):
-        """Classification error of one pinned generation over the test
-        rows, THROUGH the serving path (batcher pinned submits): the
-        eval traffic is real traffic -- it rides the same A/B
-        generation counters a canary fraction rides, which is exactly
-        the evidence the decision records.  Returns (error fraction,
-        generation that actually served, requests)."""
+    def _eval_generation(self, kernel: str, xs, ts, gen: int,
+                         objective: str = "accuracy"):
+        """Test error of one pinned generation over the test rows,
+        THROUGH the serving path (batcher pinned submits): the eval
+        traffic is real traffic -- it rides the same A/B generation
+        counters a canary fraction rides, which is exactly the
+        evidence the decision records.  ``objective`` picks the error
+        metric: 'accuracy' (argmax classification error fraction, the
+        ANN/SNN default) or 'mse' (mean squared error, the regression
+        objective auto-promote uses for linear-head LNN kernels).
+        Returns (error, generation that actually served, requests)."""
         import numpy as np
 
         b = self.app.batchers.get(kernel)
         if b is None:
             raise JobError(f"kernel '{kernel}' has no batcher")
         wrong = requests = 0
+        sq_sum = 0.0
         served_all: set[int] = set()
         for i in range(0, xs.shape[0], b.max_batch):
             chunk = np.asarray(xs[i:i + b.max_batch], dtype=np.float64)
@@ -571,10 +592,18 @@ class JobScheduler:
             served = int(served if served is not None else gen)
             served_all.add(served)
             self.app.metrics.count_generation(kernel, served)
-            want = np.argmax(ts[i:i + chunk.shape[0]], axis=1)
-            wrong += int(np.sum(np.argmax(outs, axis=1) != want))
+            if objective == "mse":
+                d = (np.asarray(outs, np.float64)
+                     - np.asarray(ts[i:i + chunk.shape[0]], np.float64))
+                sq_sum += float(np.sum(d * d))
+            else:
+                want = np.argmax(ts[i:i + chunk.shape[0]], axis=1)
+                wrong += int(np.sum(np.argmax(outs, axis=1) != want))
             requests += 1
-        err = wrong / float(xs.shape[0])
+        if objective == "mse":
+            err = sq_sum / float(xs.shape[0] * ts.shape[1])
+        else:
+            err = wrong / float(xs.shape[0])
         return err, served_all, requests
 
     def _auto_promote(self, job: JobState) -> None:
@@ -638,8 +667,16 @@ class JobScheduler:
             if xs is None or xs.shape[0] == 0:
                 return self._skip_promote(
                     job, f"no loadable test rows under {test_dir}")
+            # regression kernels (linear output head -- native LNN)
+            # cannot be judged by argmax accuracy: a constant output
+            # would score 100% on 1-wide targets.  Auto-promote picks
+            # the objective from the SERVED kernel's head
+            from ..models.kernel import is_regression
+
+            objective = ("mse" if is_regression(model.kind)
+                         else "accuracy")
             base_err, base_served, base_req = self._eval_generation(
-                job.kernel, xs, ts, baseline)
+                job.kernel, xs, ts, baseline, objective=objective)
             if base_served != {baseline}:
                 # the baseline was pruned between the table read and
                 # the eval (weights_for fell back): a decision against
@@ -648,9 +685,10 @@ class JobScheduler:
                     job, f"baseline generation {baseline} no longer "
                     f"servable (got {sorted(base_served)})")
             cand_err, _cand_served, cand_req = self._eval_generation(
-                job.kernel, xs, ts, candidate)
+                job.kernel, xs, ts, candidate, objective=objective)
             canary = self.app.metrics.generation_requests(job.kernel)
             record = {
+                "objective": objective,
                 "test_dir": test_dir,
                 "test_rows": int(xs.shape[0]),
                 "candidate": candidate,

@@ -23,6 +23,18 @@ import numpy as np
 from ..utils.glibc_random import RAND_MAX, GlibcRandom
 
 
+def output_head(kind: str) -> str:
+    """The output-layer nonlinearity of a model family: ANN sigmoid,
+    SNN softmax, LNN linear (the regression head, hpnn_tpu.ops.steps)."""
+    return {"SNN": "softmax", "LNN": "linear"}.get(kind, "sigmoid")
+
+
+def is_regression(kind: str) -> bool:
+    """Regression families score on MSE, not argmax-class error; drives
+    run_kernel's output grammar and the jobs auto-promote objective."""
+    return output_head(kind) == "linear"
+
+
 @dataclasses.dataclass
 class Kernel:
     """Host-side MLP parameter container.

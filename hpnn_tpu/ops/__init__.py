@@ -106,7 +106,9 @@ def select_train_epoch(dtype=None, donate=False, defer_stats=False,
         return fn, f"tile-{route}"
 
     on_tpu = jax.default_backend() == "tpu"
-    if _use_pallas(dtype):
+    # the per-sample Pallas program has no LNN head; the tiled engine
+    # (above) and the XLA scan both do, so LNN demotes Pallas to XLA here
+    if _use_pallas(dtype) and kind != LNN:
         from .convergence_pallas import (train_epoch_pallas,
                                          train_epoch_pallas_watchdog)
 
@@ -131,7 +133,7 @@ def select_train_epoch(dtype=None, donate=False, defer_stats=False,
     return base, "xla"
 
 
-def select_run_batch(dtype=None, parity="strict"):
+def select_run_batch(dtype=None, parity="strict", kind=None):
     """Pick the batched-inference implementation (run_kernel's eval path).
 
     Two-axis tiering:
@@ -152,11 +154,13 @@ def select_run_batch(dtype=None, parity="strict"):
       shape -- the serving registry exposes the trade-off per model.
 
     Returns ``(fn, name)`` with fn call-compatible with
-    ``run_batch(weights, xs, kind)``.
+    ``run_batch(weights, xs, kind)``.  ``kind`` (when known) gates kernels
+    that lack a head for it: the fused Pallas inference program has no
+    linear LNN head, so LNN falls through to the XLA/GEMM tiers.
     """
     if parity not in ("strict", "fast"):
         raise ValueError(f"parity must be 'strict' or 'fast': {parity!r}")
-    if _use_pallas(dtype):
+    if _use_pallas(dtype) and kind != LNN:
         from .pallas_kernels import batched_forward_pallas_jit
 
         return batched_forward_pallas_jit, "pallas"

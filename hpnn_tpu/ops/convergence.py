@@ -51,6 +51,7 @@ from jax import lax
 
 from .steps import (
     ANN,
+    LNN,
     SNN,
     DELTA_BP,
     DELTA_BPM,
@@ -128,7 +129,13 @@ def train_sample(weights, x, t, kind: str, momentum: bool,
                 w, dw, acts, x, t, kind, lr, alpha)
         else:
             w, acts, dep = train_step(w, acts, x, t, kind, lr)
-        is_ok_raw = jnp.argmax(acts[-1]) == p_trg
+        if kind == LNN:
+            # regression head: there is no class to match, so the argmax
+            # clause degenerates to True and the stop criterion reduces to
+            # dEp <= delta (past min_iter)
+            is_ok_raw = jnp.asarray(True)
+        else:
+            is_ok_raw = jnp.argmax(acts[-1]) == p_trg
         first_ok = jnp.where(it == 1, is_ok_raw, first_ok)
         return (w, dw, acts, it, dep, is_ok_raw, first_ok)
 

@@ -76,6 +76,7 @@ from .steps import (
     MAX_BPM_ITER,
     MIN_BP_ITER,
     MIN_BPM_ITER,
+    LNN,
     SNN,
     bp_learn_rate,
     bpm_learn_rate,
@@ -202,6 +203,10 @@ def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
             e = jnp.where(out_mask, jnp.exp(z - 1.0), 0.0).astype(dtype)
             dv = jnp.sum(e.astype(f32), axis=1, keepdims=True) + TINY
             return (e.astype(f32) / dv).astype(dtype)
+        if kind == LNN:
+            # linear regression head; padded lanes zeroed so err/deltas
+            # see clean zeros exactly like the activation heads
+            return jnp.where(out_mask, z, 0.0).astype(dtype)
         return ann_act(z)
 
     def fwd(getw):
@@ -288,7 +293,7 @@ def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
         it = it + 1
         ep = epr
         o = acts[-1]
-        if kind == SNN:
+        if kind in (SNN, LNN):
             d = t - o
         else:
             d = (t - o) * ann_dact(o)
@@ -323,7 +328,11 @@ def _group_loop(x, t, valid, w_refs, dw_refs, w0, *,
         new_acts = fwd(getw)
         new_epr = err(new_acts[-1])
         dep_new = ep - new_epr
-        okr = argmax_first(new_acts[-1]) == p_trg
+        if kind == LNN:
+            # regression: no class to match (see convergence.train_sample)
+            okr = jnp.ones((s, 1), jnp.bool_)
+        else:
+            okr = argmax_first(new_acts[-1]) == p_trg
         n_it = jnp.where(live, it, n_it)
         dep = jnp.where(live, dep_new, dep)
         ok_raw = jnp.where(live, okr, ok_raw)

@@ -69,6 +69,8 @@ def forward(weights, x, kind: str):
     ANN: every layer (hidden and output) applies ann_act (``ann.c:892-1242``).
     SNN: hidden layers apply ann_act, output applies softmax(x-1)
     (``snn.c:79-443``).
+    LNN: hidden layers apply ann_act, output stays linear (the regression
+    head the reference declares but never implements, ``libhpnn.c:975-978``).
     """
     acts = []
     v = x
@@ -77,6 +79,8 @@ def forward(weights, x, kind: str):
         z = w @ v
         if kind == SNN and i == n - 1:
             v = snn_softmax(z)
+        elif kind == LNN and i == n - 1:
+            v = z
         else:
             v = ann_act(z)
         acts.append(v)
@@ -96,6 +100,8 @@ def batched_forward(weights, xs, kind: str):
         z = v @ w.T
         if kind == SNN and i == n - 1:
             v = snn_softmax(z)
+        elif kind == LNN and i == n - 1:
+            v = z
         else:
             v = ann_act(z)
     return v
@@ -104,7 +110,7 @@ def batched_forward(weights, xs, kind: str):
 def error(out, t, kind: str):
     """Training error of one sample (scalar).
 
-    ANN: 0.5 * sum((t-o)^2)                        (``ann.c:1246-1275``)
+    ANN/LNN: 0.5 * sum((t-o)^2)                    (``ann.c:1246-1275``)
     SNN: -(1/N) * sum_{o>0} t*log(o + TINY)        (``snn.c:447-477``)
     The o>0 guard is the reference's serial-path behavior; softmax outputs
     are strictly positive so it only matters for pathological inputs.
@@ -121,11 +127,12 @@ def deltas(weights, acts, t, kind: str):
     """Back-propagated error terms per layer (``ann.c:1279-1592``,
     ``snn.c:481-796``).
 
-    Output layer: ANN d=(t-o)*dact(o); SNN d=(t-o).
+    Output layer: ANN d=(t-o)*dact(o); SNN d=(t-o); LNN d=(t-o) (linear
+    head, so the half-SSE gradient has no dact factor).
     Hidden l:     d_l = (W_{l+1}^T @ d_{l+1}) * dact(h_l).
     """
     out = acts[-1]
-    if kind == SNN:
+    if kind in (SNN, LNN):
         d = t - out
     else:
         d = (t - out) * ann_dact(out)

@@ -336,13 +336,22 @@ class ServeMetrics:
             self._depth_fns[name] = depth_fn
 
     def set_model_info(self, name: str, generation: int,
-                       loaded_at: float) -> None:
-        """Record a kernel's model generation + last-(re)load time."""
+                       loaded_at: float, kind: str | None = None,
+                       trainer: str | None = None) -> None:
+        """Record a kernel's model generation + last-(re)load time, and
+        (when given) its kernel ``type`` (ANN/SNN/LNN head) + trainer
+        labels.  ``kind``/``trainer`` MERGE-RETAIN: callers that only
+        refresh the generation (the jobs scheduler's per-epoch reload
+        bookkeeping) must not wipe labels a register/reload set."""
         with self._lock:
-            self._model_info[name] = {
-                "generation": int(generation),
-                "last_reload_ts": round(float(loaded_at), 3),
-            }
+            info = self._model_info.get(name, {})
+            info["generation"] = int(generation)
+            info["last_reload_ts"] = round(float(loaded_at), 3)
+            if kind is not None:
+                info["kind"] = str(kind)
+            if trainer is not None:
+                info["trainer"] = str(trainer)
+            self._model_info[name] = info
 
     def count_reload(self, ok: bool) -> None:
         with self._lock:
@@ -574,6 +583,18 @@ class ServeMetrics:
                 "hpnn_serve_model_last_reload_timestamp_seconds"
                 f'{{kernel="{_escape_label(name)}"}} '
                 f'{info["last_reload_ts"]}')
+        lines += [
+            "# HELP hpnn_serve_model_info Kernel output-head type and "
+            "trainer (value is always 1; labels carry the facts).",
+            "# TYPE hpnn_serve_model_info gauge",
+        ]
+        for name, info in sorted(snap["models"].items()):
+            lines.append(
+                "hpnn_serve_model_info"
+                f'{{kernel="{_escape_label(name)}",'
+                f'type="{_escape_label(info.get("kind", "unknown"))}",'
+                f'trainer="{_escape_label(info.get("trainer", "none"))}"'
+                "} 1")
         lines += [
             "# HELP hpnn_serve_generation_requests_total Requests "
             "routed per model generation (A/B pinning).",

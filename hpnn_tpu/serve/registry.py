@@ -155,15 +155,19 @@ class ServedModel:
     old weights."""
 
     def __init__(self, name: str, nn, registry: "ModelRegistry"):
-        from ..io.conf import NN_TYPE_ANN, NN_TYPE_SNN
+        from ..api import kernel_kind
+        from ..train import trainer_label
 
         self.name = name
         self.nn = nn                      # api.NNDef (conf + kernel)
         self.registry = registry
-        # LNN evaluates through the SNN branch, exactly like run_kernel
-        # (libhpnn.c:1455-1456)
-        self.kind = (NN_TYPE_SNN if nn.conf.type != NN_TYPE_ANN
-                     else NN_TYPE_ANN)
+        # default-mode LNN evaluates through the SNN branch exactly like
+        # run_kernel (libhpnn.c:1455-1456); a native-LNN conf serves the
+        # linear regression head (no softmax/sigmoid clamp).  The
+        # trainer label (bp/bpm/cg) rides /metrics + /healthz so fleet
+        # dashboards can split regression kernels from classifiers.
+        self.kind = kernel_kind(nn.conf)
+        self.trainer = trainer_label(nn.conf)
         self.n_inputs = nn.kernel.n_inputs
         self.n_outputs = nn.kernel.n_outputs
         self.generation = 1               # bumped by every swap_kernel
@@ -577,7 +581,8 @@ class ModelRegistry:
                 return None
             self._models[name] = model
         self.metrics.set_model_info(name, model.generation,
-                                    model.loaded_at)
+                                    model.loaded_at, kind=model.kind,
+                                    trainer=model.trainer)
         nn_out(f"serve: registered kernel '{name}' "
                f"({'x'.join(str(p) for p in model.topology)}, "
                f"{model.dtype_name}, {model.kind}, "
@@ -617,7 +622,8 @@ class ModelRegistry:
             result = model.swap_kernel(kernel, src,
                                        set_generation=set_generation)
         self.metrics.set_model_info(name, model.generation,
-                                    model.loaded_at)
+                                    model.loaded_at, kind=model.kind,
+                                    trainer=model.trainer)
         nn_out(f"serve: reloaded kernel '{name}' from {src} "
                f"(generation {result['generation']}"
                f"{', topology changed' if result['topology_changed'] else ''}"
@@ -729,7 +735,8 @@ class ModelRegistry:
             elif pinned:
                 run_batch_fn, path = ops.select_run_batch(
                     model.dtype,
-                    parity="fast" if tier == "fast" else "strict")
+                    parity="fast" if tier == "fast" else "strict",
+                    kind=kind)
 
                 # explicit-weights variant: the caller passes the pinned
                 # generation's tuple per dispatch (same shapes -> the
@@ -741,7 +748,8 @@ class ModelRegistry:
             else:
                 run_batch_fn, path = ops.select_run_batch(
                     model.dtype,
-                    parity="fast" if tier == "fast" else "strict")
+                    parity="fast" if tier == "fast" else "strict",
+                    kind=kind)
                 holder = model.weights_holder()
 
                 def fn(buf, _fn=run_batch_fn, _h=holder, _k=kind):

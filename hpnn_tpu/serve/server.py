@@ -1430,6 +1430,13 @@ class _Handler(BaseHTTPRequestHandler):
             jobs = self.app.jobs
             body = {"status": status,
                     "kernels": self.app.registry.names(),
+                    # per-kernel output-head type (ANN/SNN/LNN) and
+                    # trainer labels (regression-vs-classifier split
+                    # for probes that do not parse /metrics)
+                    "kernel_types": {
+                        n: {"type": m.kind, "trainer": m.trainer}
+                        for n in self.app.registry.names()
+                        if (m := self.app.registry.get(n)) is not None},
                     "parity": self.app.registry.parity,
                     "uptime_s": round(self.app.uptime_s(), 3),
                     "queue_depth": {name: b.depth() for name, b in

@@ -1,0 +1,122 @@
+"""Trainer registry: the dispatch surface for training algorithms.
+
+The reference hard-codes its trainer dispatch in ``nn_kernel_train``
+(``/root/reference/src/libhpnn.c:1193-1291``): BP and BPM run, CG and SPLX
+are declared but fall through an "unimplemented" warning
+(``libhpnn.c:1253-1257``).  This package keeps that surface byte-identical
+by DEFAULT -- the reference trainers stay on api.train_kernel's built-in
+routes -- and adds an opt-in registry hosting trainers the reference never
+implemented, starting with the batched nonlinear conjugate-gradient
+trainer (hpnn_tpu.train.cg, ROADMAP item 4).
+
+Registry entries carry:
+
+* ``native``: False for BP/BPM (api's reference dispatch handles them --
+  the entry exists so tooling can enumerate every trainer through ONE
+  surface), True for trainers that run through ``run_epoch``;
+* ``run_epoch(nn, weights, xs, ts, kind, dtype)``: one whole-corpus
+  training epoch; returns the updated weight arrays and leaves
+  ``nn.last_epoch_stats`` / ``nn.trainer_state`` refreshed.
+
+Activation is two-level, mirroring the native-LNN gate: the conf opts in
+(``[trainer] cg`` / ``--trainer cg``) or the environment does
+(``HPNN_TRAINER=cg``).  Without either, a ``[train] CG`` conf keeps the
+reference's untrainable fallthrough bytes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+from ..io.conf import (
+    NN_TRAIN_BP,
+    NN_TRAIN_BPM,
+    NN_TRAIN_CG,
+    NN_TYPE_LNN,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainerEntry:
+    name: str
+    train: str            # the [train] conf value this trainer serves
+    native: bool          # True: run_epoch drives the epoch
+    description: str
+    run_epoch: Callable | None = None
+
+
+_TRAINERS: dict[str, TrainerEntry] = {}
+
+
+def register_trainer(entry: TrainerEntry) -> None:
+    _TRAINERS[entry.name] = entry
+
+
+def get_trainer(name: str) -> TrainerEntry:
+    return _TRAINERS[name]
+
+
+def trainer_names() -> list[str]:
+    return sorted(_TRAINERS)
+
+
+def trainer_label(conf) -> str:
+    """The trainer label serve/metrics expose per kernel: the registry
+    name for the conf's [train] value ("none" when untrainable)."""
+    for entry in _TRAINERS.values():
+        if entry.train == conf.train:
+            return entry.name
+    return "none"
+
+
+def native_lnn(conf) -> bool:
+    """Native linear-output LNN opt-in: ``[lnn] native`` / ``--lnn
+    native`` or ``HPNN_LNN_NATIVE=1``.  Off, an LNN conf keeps the
+    reference's warn-and-SNN-fallthrough byte-for-byte."""
+    if conf.type != NN_TYPE_LNN:
+        return False
+    if getattr(conf, "lnn", "") == "native":
+        return True
+    return os.environ.get("HPNN_LNN_NATIVE", "") not in ("", "0")
+
+
+def native_trainer(conf) -> TrainerEntry | None:
+    """The native trainer entry driving this conf's training epochs, or
+    None when the reference dispatch applies.  Requires BOTH the conf's
+    [train] algorithm to have a native registry entry AND the opt-in
+    (conf.trainer / HPNN_TRAINER)."""
+    want = getattr(conf, "trainer", "") or os.environ.get("HPNN_TRAINER", "")
+    if not want or want == "0":
+        return None
+    entry = _TRAINERS.get(want if want != "native" else "cg")
+    if entry is None or not entry.native:
+        return None
+    return entry if entry.train == conf.train else None
+
+
+def _register_builtins() -> None:
+    from .cg import run_cg_epoch
+
+    register_trainer(TrainerEntry(
+        name="bp", train=NN_TRAIN_BP, native=False,
+        description="online per-sample backprop to convergence "
+                    "(reference dispatch, ann.c:2281-2372)"))
+    register_trainer(TrainerEntry(
+        name="bpm", train=NN_TRAIN_BPM, native=False,
+        description="per-sample backprop with momentum "
+                    "(reference dispatch, ann.c:2377-2466)"))
+    register_trainer(TrainerEntry(
+        name="cg", train=NN_TRAIN_CG, native=True,
+        description="batched nonlinear conjugate gradient "
+                    "(Polak-Ribiere + restart, on-device line search)",
+        run_epoch=run_cg_epoch))
+
+
+_register_builtins()
+
+__all__ = [
+    "TrainerEntry", "register_trainer", "get_trainer", "trainer_names",
+    "trainer_label", "native_lnn", "native_trainer",
+]

@@ -1,0 +1,272 @@
+"""Batched nonlinear conjugate-gradient trainer (the reference's missing CG).
+
+The reference declares ``NN_TRAIN_CG`` but never implements it
+(``/root/reference/src/libhpnn.c:1253-1257``); arXiv:1701.05130 races
+exactly this trainer family against per-sample BP.  This implementation is
+TPU-shaped end to end:
+
+* the objective is the WHOLE-corpUS mean of the per-sample training error
+  (``ops.steps.error`` over ``batched_forward``), so one loss/gradient
+  evaluation is a chain of (S, M) @ (M, N) GEMMs -- MXU work, not the
+  per-sample GEMV convergence loop BP runs;
+* the gradient is ``jax.value_and_grad`` of that same GEMM chain (an
+  honest gradient -- CG needs one; the reference BP quirks like the ANN
+  dact output factor belong to the per-sample trainers, not here);
+* the direction update is Polak-Ribiere with the standard guards:
+  ``beta = max(0, <g, g - g_prev> / <g_prev, g_prev>)`` and a restart to
+  steepest descent whenever the new direction is not a descent direction
+  (restart count carried in the snapshot state);
+* the step length comes from an on-device bracketing line search: halve
+  until the probe improves on the current loss, double while it keeps
+  improving, then a fixed-iteration ternary refine of the bracket -- all
+  inside the compiled epoch, zero host round-trips per iteration.
+
+One ``train_kernel`` epoch runs ``HPNN_CG_ITERS`` (default 8) CG
+iterations.  State across epochs -- direction, prior gradient, restart
+counter -- lives in ``nn.trainer_state`` as flat vectors so the checkpoint
+subsystem snapshots/restores it with the same verified-write guarantees as
+BPM momentum, and resume is bit-exact (pinned in tests/test_ckpt.py).
+
+Under a ``[batch]`` data-parallel conf the CG state rides the PR-12
+optimizer-state layout: flattened to ONE vector, zero-padded to the data
+axis and sharded P("data") (``parallel.mesh.flat_state_sharding``), each
+replica holding a contiguous 1/N slice.  All placement ops are
+value-preserving, so the sharded trajectory is bitwise the single-device
+one.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+import numpy as np
+
+from ..utils.nn_log import nn_out, nn_warn
+
+# line-search budget: max halvings/doublings while bracketing, then the
+# fixed ternary refine depth (2 loss evals per refine step)
+_LS_BRACKET_MAX = 24
+_LS_REFINE = 12
+
+_CG_ITERS_DEFAULT = 8
+
+_EPOCH_CACHE: dict = {}
+
+
+def cg_iters_per_epoch() -> int:
+    raw = os.environ.get("HPNN_CG_ITERS", "")
+    try:
+        n = int(raw) if raw else _CG_ITERS_DEFAULT
+    except ValueError:
+        nn_warn(f"HPNN_CG_ITERS={raw!r} is not an integer; "
+                f"using {_CG_ITERS_DEFAULT}\n")
+        return _CG_ITERS_DEFAULT
+    return max(1, n)
+
+
+def _line_search(loss, f, d, l0):
+    """Bracketing line search along ``d`` from ``f``: returns the step t
+    (0.0 when no probe improves on ``l0``)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    one = jnp.asarray(1.0, f.dtype)
+
+    def phi(t):
+        return loss(f + t * d)
+
+    # shrink: halve until the probe improves on l0
+    def s_cond(c):
+        _, ft, k = c
+        return (ft >= l0) & (k < _LS_BRACKET_MAX)
+
+    def s_body(c):
+        t, _, k = c
+        t = t * 0.5
+        return t, phi(t), k + 1
+
+    t, ft, _ = lax.while_loop(s_cond, s_body,
+                              (one, phi(one), jnp.int32(0)))
+
+    # grow: double while the doubled probe keeps improving
+    def g_cond(c):
+        _, ft, _, ft2, k = c
+        return (ft2 < ft) & (k < _LS_BRACKET_MAX)
+
+    def g_body(c):
+        _, _, t2, ft2, k = c
+        nt = t2 * 2.0
+        return t2, ft2, nt, phi(nt), k + 1
+
+    t, ft, t2, _, _ = lax.while_loop(
+        g_cond, g_body, (t, ft, t * 2.0, phi(t * 2.0), jnp.int32(0)))
+
+    # ternary refine of [0, t2] (unimodal along the bracket)
+    def r_body(_, ab):
+        a, b = ab
+        m1 = a + (b - a) / 3.0
+        m2 = b - (b - a) / 3.0
+        keep_lo = phi(m1) <= phi(m2)
+        return (jnp.where(keep_lo, a, m1), jnp.where(keep_lo, m2, b))
+
+    a, b = lax.fori_loop(0, _LS_REFINE, r_body,
+                         (jnp.zeros_like(t), t2))
+    t_star = 0.5 * (a + b)
+    ft_star = phi(t_star)
+    t_best = jnp.where(ft_star <= ft, t_star, t)
+    f_best = jnp.minimum(ft_star, ft)
+    return jnp.where(f_best < l0, t_best, jnp.zeros_like(t))
+
+
+def _compiled_epoch(shapes, kind, n_iters, dtype_name):
+    """The jitted CG epoch for one (topology, kind, iters, dtype)."""
+    key = (shapes, kind, n_iters, dtype_name)
+    fn = _EPOCH_CACHE.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops import TINY, batched_forward, error
+    from ..parallel.mesh import unflatten_state
+
+    def loss_of(xs, ts):
+        def loss(flat):
+            ws = unflatten_state(flat, shapes)
+            outs = batched_forward(ws, xs, kind)
+            return jnp.mean(error(outs, ts, kind))
+        return loss
+
+    def epoch(flat, d, g_prev, have, restarts, xs, ts):
+        loss = loss_of(xs, ts)
+        e0 = loss(flat)
+
+        def cg_step(carry, _):
+            f, d, g_prev, have, restarts = carry
+            l, g = jax.value_and_grad(loss)(f)
+            gg_prev = jnp.vdot(g_prev, g_prev)
+            beta = jnp.maximum(
+                0.0, jnp.vdot(g, g - g_prev)
+                / jnp.maximum(gg_prev, jnp.asarray(TINY, f.dtype)))
+            beta = jnp.where(have, beta, 0.0)
+            d_new = -g + beta * d
+            descent = jnp.vdot(d_new, g) < 0.0
+            d_new = jnp.where(descent, d_new, -g)
+            restarts = restarts + (have & ~descent).astype(jnp.int32)
+            t_step = _line_search(loss, f, d_new, l)
+            f_new = f + t_step * d_new
+            return (f_new, d_new, g, jnp.asarray(True), restarts), l
+
+        (f, d, g, _, restarts), _ = lax.scan(
+            cg_step, (flat, d, g_prev, have, restarts), None,
+            length=n_iters)
+        e1 = loss(f)
+        gn = jnp.sqrt(jnp.vdot(g, g))
+        return f, d, g, e0, e1, gn, restarts
+
+    fn = jax.jit(epoch)
+    _EPOCH_CACHE[key] = fn
+    return fn
+
+
+def _load_state(nn, total: int, pad_to: int, dtype):
+    """nn.trainer_state -> (d, g, have, restarts) padded flat arrays.
+    A size mismatch (topology changed under the snapshot) warns and
+    restarts CG from steepest descent."""
+    import jax.numpy as jnp
+
+    st = getattr(nn, "trainer_state", None)
+    zeros = jnp.zeros((total + (-total) % max(1, pad_to),), dtype)
+    if not st:
+        return zeros, zeros, False, 0
+    d = np.asarray(st.get("cg_d", ()), np.float64).reshape(-1)
+    g = np.asarray(st.get("cg_g", ()), np.float64).reshape(-1)
+    meta = np.asarray(st.get("cg_meta", (0, 0, 0)), np.int64).reshape(-1)
+    if d.size != total or g.size != total:
+        nn_warn("CG state size mismatch; restarting from steepest "
+                "descent\n")
+        return zeros, zeros, False, 0
+    pad = zeros.shape[0] - total
+    if pad:
+        d = np.concatenate([d, np.zeros((pad,), np.float64)])
+        g = np.concatenate([g, np.zeros((pad,), np.float64)])
+    return (jnp.asarray(d, dtype), jnp.asarray(g, dtype),
+            bool(meta[0]) if meta.size else False,
+            int(meta[1]) if meta.size > 1 else 0)
+
+
+def run_cg_epoch(nn, weights, xs, ts, kind, dtype):
+    """One CG training epoch over the staged corpus; returns the updated
+    weight tuple.  Refreshes ``nn.last_epoch_stats`` (mean corpus error
+    after the epoch, the manifest-trajectory hook) and
+    ``nn.trainer_state`` (direction / prior gradient / restart counter,
+    unpadded f64 -- the snapshot payload)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel.mesh import (flat_state_sharding, flatten_state,
+                                 make_mesh)
+
+    t0 = time.perf_counter()
+    conf = nn.conf
+    shapes = tuple(tuple(int(n) for n in w.shape) for w in weights)
+    total = int(sum(int(np.prod(sh)) for sh in shapes))
+    n_iters = cg_iters_per_epoch()
+
+    # [batch] DP route: shard the flat CG state P("data") (PR-12 layout)
+    n_data = 1
+    sharding = None
+    if getattr(conf, "batch", 0) > 0:
+        from ..api import _dp_device_count
+
+        n_data = _dp_device_count()
+        if n_data > 1:
+            mesh = make_mesh(n_data=n_data, n_model=1)
+            sharding = flat_state_sharding(mesh)
+
+    flat = flatten_state([jnp.asarray(w, dtype) for w in weights],
+                         pad_to=n_data)
+    d, g, have, restarts = _load_state(nn, total, n_data, dtype)
+    if sharding is not None:
+        flat = jax.device_put(flat, sharding)
+        d = jax.device_put(d, sharding)
+        g = jax.device_put(g, sharding)
+
+    fn = _compiled_epoch(shapes, kind, n_iters, jnp.dtype(dtype).name)
+    flat, d, g, e0, e1, gn, restarts = fn(
+        flat, d, g, jnp.asarray(bool(have)), jnp.int32(restarts),
+        jnp.asarray(xs, dtype), jnp.asarray(ts, dtype))
+
+    e0, e1, gn = float(e0), float(e1), float(gn)
+    n_restarts = int(restarts)
+    s = int(xs.shape[0])
+    dt = time.perf_counter() - t0
+    # one line per epoch (new-capability grammar -- deterministic, so the
+    # resume byte-parity pin covers it; wall time goes to DBG only)
+    nn_out(f"TRAINING CG\t samples={s:8d} iters={n_iters:4d} "
+           f"E0={e0:15.10f} E1={e1:15.10f} |g|={gn:15.10f} "
+           f"restarts={n_restarts:4d}\n")
+    from ..utils.nn_log import nn_dbg
+
+    nn_dbg(f"CG epoch wall {dt:.3f} s\n")
+
+    flat_h = np.asarray(flat, np.float64)[:total]
+    d_h = np.asarray(d, np.float64)[:total]
+    g_h = np.asarray(g, np.float64)[:total]
+    nn.trainer_state = {
+        "cg_d": d_h,
+        "cg_g": g_h,
+        "cg_meta": np.asarray([1, n_restarts, n_iters], np.int64),
+    }
+    nn.last_epoch_stats = {"samples": s, "success": 0,
+                           "mean_init": e0, "mean_final": e1}
+
+    lo, out = 0, []
+    for sh in shapes:
+        n = int(np.prod(sh))
+        out.append(jnp.asarray(flat_h[lo:lo + n].reshape(sh), dtype))
+        lo += n
+    return tuple(out)

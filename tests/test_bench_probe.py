@@ -281,3 +281,38 @@ def test_committed_mesh_bench_shed_and_autoscale_rows_hold_floors():
     assert asr["non_200"] == 0
     assert asr["spawns_total"] >= 2
     assert asr["retires_total"] >= 1
+
+
+def test_committed_trainers_bench_rows_hold_floors():
+    """The committed TRAINERS_BENCH.json race grid (make trainers-bench,
+    ISSUE 16) stays pinned in tier 1: every {BP, BPM, CG} x {ANN, SNN,
+    LNN} cell ran, each trajectory pairs an error with a wall time, and
+    the batched CG trainer beat per-sample BP on epochs-to-target in at
+    least one cell -- with the native-LNN regression cell actually
+    converging under CG."""
+    art = _load_artifact("TRAINERS_BENCH.json")
+    floors = art["floors"]
+    assert floors["ok"] is True
+    assert floors["cell_errors"] == []
+    assert len(floors["cg_beats_bp_cells"]) >= 1
+    grid = art["grid"]
+    assert set(grid) == {"ANN", "SNN", "LNN"}
+    for row in grid.values():
+        assert set(row) == {"bp", "bpm", "cg"}
+        for cell in row.values():
+            assert "error" not in cell
+            assert len(cell["errors"]) == len(cell["wall_s"]) >= 1
+            assert all(b >= a for a, b in zip(cell["wall_s"],
+                                              cell["wall_s"][1:]))
+    # the winner of every beaten cell really is recorded as cg
+    for t in floors["cg_beats_bp_cells"]:
+        cg = grid[t]["cg"]
+        assert cg["epochs_to_target"] is not None
+        bp_ett = grid[t]["bp"]["epochs_to_target"]
+        assert bp_ett is None or cg["epochs_to_target"] < bp_ett
+    # the regression flagship: native LNN under CG closed the gap and
+    # ended at least 100x below the per-sample BP trainer
+    lnn_cg = grid["LNN"]["cg"]
+    assert lnn_cg["epochs_to_target"] is not None
+    assert lnn_cg["final_error"] < lnn_cg["init_error"]
+    assert lnn_cg["final_error"] * 100 <= grid["LNN"]["bp"]["final_error"]

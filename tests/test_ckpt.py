@@ -702,3 +702,92 @@ def test_process_death_resume_e2e(tmp_path):
     assert (part / "kernel.opt").read_bytes() == \
         (full / "kernel.opt").read_bytes()
     assert "NN: EPOCH        2/       3\n" in r2.stdout
+
+# --- CG trainer state rides the bundle (ISSUE 16) --------------------------
+
+def _lnn_conf(tmp_path, seed=1234):
+    text = (
+        "[name] lnn\n[type] LNN\n[init] generate\n"
+        f"[seed] {seed}\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        "[train] CG\n[trainer] cg\n[lnn] native\n"
+        f"[sample_dir] {tmp_path}/samples\n[test_dir] {tmp_path}/tests\n")
+    path = tmp_path / "nn_cg.conf"
+    path.write_text(text)
+    return str(path)
+
+
+def test_cg_kill_and_resume_byte_parity(corpus, capsys):
+    """The BP/BPM resume contract extended to the CG trainer: the CG
+    carry (direction, prior gradient, restart counter) rides the bundle
+    as cg_* arrays, so kill-at-epoch-1 + --resume replays epochs 2..N
+    bit-exactly -- the Polak-Ribiere beta of the first resumed epoch
+    depends on the restored prior gradient, so a dropped carry would
+    diverge immediately."""
+    conf = _lnn_conf(corpus)
+    epochs = 3
+
+    os.makedirs("full")
+    os.chdir("full")
+    rc, out_full = _train([f"--epochs={epochs}", "--ckpt-every=1",
+                           "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    full_opt = open("kernel.opt", "rb").read()
+    os.chdir("..")
+
+    os.makedirs("part")
+    os.chdir("part")
+    rc, out_kill = _train([f"--epochs={epochs}", "--ckpt-every=1",
+                           "--ckpt-dir=ck", conf], capsys,
+                          env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0
+    assert f"CKPT: interrupted at epoch 1/{epochs}" in out_kill
+    # the bundle really carries the CG state
+    snap = ckpt.load_snapshot("ck")
+    assert snap.trainer_state is not None
+    assert set(snap.trainer_state) == {"cg_d", "cg_g", "cg_meta"}
+    n_params = N_HID * N_IN + N_OUT * N_HID
+    assert snap.trainer_state["cg_d"].shape == (n_params,)
+    assert snap.trainer_state["cg_g"].shape == (n_params,)
+
+    rc, out_res = _train([f"--epochs={epochs}", "--resume",
+                          "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    part_opt = open("kernel.opt", "rb").read()
+    os.chdir("..")
+
+    assert part_opt == full_opt
+    mark = f"NN: EPOCH        2/{epochs:8d}\n"
+    assert mark in out_full and mark in out_res
+    assert out_res[out_res.index(mark):] == out_full[out_full.index(mark):]
+
+
+def test_cg_state_size_mismatch_restarts_clean(corpus, capsys):
+    """A snapshot whose cg_* vectors no longer match the parameter count
+    must not crash or silently corrupt the direction: the trainer warns
+    and restarts from steepest descent."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.train.cg import run_cg_epoch
+
+    class NN:
+        pass
+
+    nn = NN()
+    nn.conf = type("C", (), {"batch": 0, "seed": 1})()
+    nn.trainer_state = {"cg_d": np.zeros(5), "cg_g": np.zeros(5),
+                        "cg_meta": np.asarray([1, 0, 8], np.int64)}
+    rng = np.random.default_rng(0)
+    weights = (rng.normal(size=(N_HID, N_IN)),
+               rng.normal(size=(N_OUT, N_HID)))
+    xs = rng.normal(size=(4, N_IN))
+    ts = rng.normal(size=(4, N_OUT))
+    nn_log.set_verbosity(1)
+    out = run_cg_epoch(nn, weights, xs, ts, "LNN", jnp.float64)
+    warn = capsys.readouterr().out  # nn_warn -> stdout at verbosity>0
+    nn_log.set_verbosity(0)
+    assert "CG state size mismatch" in warn
+    assert tuple(w.shape for w in out) == ((N_HID, N_IN), (N_OUT, N_HID))
+    # fresh, correctly-sized state was written back
+    assert nn.trainer_state["cg_d"].shape == (N_HID * N_IN
+                                              + N_OUT * N_HID,)

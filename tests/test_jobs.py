@@ -85,6 +85,20 @@ def _serve_conf(tmp_path, name="tiny", seed=1234):
     return str(conf), kpath
 
 
+def _lnn_serve_conf(tmp_path, name="liny", seed=1234):
+    """An opt-in native-LNN (linear output head) serving conf -- the
+    regression-kernel variant of _serve_conf (ISSUE 16)."""
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] LNN\n[lnn] native\n"
+                    f"[init] {kpath}\n[seed] 1\n[train] BP\n")
+    return str(conf), kpath
+
+
 def _train_conf(tmp_path, samples, train="BP", seed=77):
     """The OFFLINE train_nn conf semantically identical to what a job
     submit with the same params generates."""
@@ -195,9 +209,14 @@ def test_submit_validation_and_queue_full(tmp_path):
         assert st == 404
         st, body = serve_bench.http_json(url, {})
         assert st == 400 and "samples" in body["error"]
+        # SPLX is still declared-but-unimplemented (CG graduated to a
+        # real trainer in ISSUE 16 and now admits)
         st, body = serve_bench.http_json(
-            url, {"samples": str(corpus), "train": "CG"})
+            url, {"samples": str(corpus), "train": "SPLX"})
         assert st == 400 and "train" in body["error"]
+        st, body = serve_bench.http_json(
+            url, {"samples": str(corpus), "lnn": "turbo"})
+        assert st == 400 and "lnn" in body["error"]
         st, body = serve_bench.http_json(
             url, {"samples": str(corpus), "epochs": 0})
         assert st == 400
@@ -892,6 +911,87 @@ def test_auto_promote_skips_without_test_dir(tmp_path):
         assert rec["action"] == "skipped"
         assert "test dir" in rec["reason"]
         assert snap["finalized"] is None  # nothing was decided
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_auto_promote_uses_mse_for_regression_kernels(tmp_path):
+    """A native-LNN kernel's auto-promote decision is judged by MSE,
+    not argmax accuracy (a constant output would ace argmax on the
+    linear head), and the generated job conf inherits the [lnn]
+    native / [trainer] cg keywords so the candidate trains the same
+    regression head it will serve (ISSUE 16)."""
+    rng = np.random.default_rng(21)
+    corpus = tmp_path / "corpus"
+    tests = tmp_path / "tests"
+    _write_corpus(str(corpus), rng, N_SAMP)
+    _write_corpus(str(tests), np.random.default_rng(22), 6)
+    conf, _ = _lnn_serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    model = app.registry.get("liny")
+    assert model.kind == "LNN"  # the objective gate auto-promote reads
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1,
+                    auto_promote=True)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/liny/train",
+            {"samples": str(corpus), "test_samples": str(tests),
+             "epochs": 3, "seed": 3, "train": "CG", "ckpt_every": 0})
+        assert st == 202, job
+        # the generated conf carries the opt-in keywords, not just
+        # [type]/[train]: without them the candidate would train the
+        # reference's SNN fallthrough against an LNN serving head
+        conf_text = open(
+            app.jobs.store.get(job["job_id"]).conf_path).read()
+        assert "[type] LNN" in conf_text
+        assert "[lnn] native" in conf_text
+        assert "[trainer] cg" in conf_text
+        snap = _wait_terminal(base, job["job_id"])
+        assert snap["status"] == "done", snap
+        snap = _wait_auto_promote(base, job["job_id"])
+        rec = snap["auto_promote"]
+        assert rec["objective"] == "mse"
+        assert rec["action"] in ("auto_promoted", "auto_rolled_back")
+        # MSE decisions still follow the error comparison
+        if rec["candidate_err"] <= rec["baseline_err"]:
+            assert rec["action"] == "auto_promoted"
+        else:
+            assert rec["action"] == "auto_rolled_back"
+        assert rec["test_rows"] == 6
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_auto_promote_classifier_objective_is_accuracy(tmp_path):
+    """The ANN/SNN default stays argmax accuracy -- and the record now
+    says so explicitly."""
+    rng = np.random.default_rng(23)
+    corpus = tmp_path / "corpus"
+    tests = tmp_path / "tests"
+    _write_corpus(str(corpus), rng, N_SAMP)
+    _write_corpus(str(tests), np.random.default_rng(24), 6)
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1,
+                    auto_promote=True)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/tiny/train",
+            {"samples": str(corpus), "test_samples": str(tests),
+             "epochs": 2, "seed": 3, "ckpt_every": 0})
+        assert st == 202, job
+        snap = _wait_terminal(base, job["job_id"])
+        assert snap["status"] == "done", snap
+        rec = _wait_auto_promote(base, job["job_id"])["auto_promote"]
+        assert rec["objective"] == "accuracy"
     finally:
         httpd.shutdown()
         app.close(drain=True)

@@ -1,0 +1,311 @@
+"""Regression workloads: the native LNN kernel + trainer registry.
+
+Two contracts pinned here (ISSUE 16):
+
+* **Default-mode byte parity**: without ``--lnn native`` /
+  ``HPNN_LNN_NATIVE=1`` an ``[type] LNN`` conf behaves exactly like the
+  reference -- ``nn_error("unimplemented NN type!")`` to stderr at
+  kernel setup and at epoch end (libhpnn.c:1253-1257, 1453-1456), then
+  trains/evaluates THROUGH the SNN path: the stdout stream and the
+  dumped ``kernel.opt`` are byte-identical to the same conf with
+  ``[type] SNN``.
+* **Native mode**: opting in swaps the output head to linear (no
+  softmax), trains against the half-SSE/MSE objective -- per-sample BP
+  still converges, the batched CG trainer (``--trainer cg``) drives the
+  whole-corpus error down monotonically, and ``run_nn`` reports
+  per-file MSE instead of an argmax verdict.
+"""
+
+import io
+import os
+from contextlib import redirect_stderr, redirect_stdout
+
+import numpy as np
+import pytest
+
+from hpnn_tpu import cli
+from hpnn_tpu.io.conf import (NN_TRAIN_BPM, NN_TRAIN_CG, NN_TYPE_ANN,
+                              NN_TYPE_LNN, NN_TYPE_SNN, load_conf)
+from hpnn_tpu.models.kernel import is_regression, output_head
+from hpnn_tpu.utils import nn_log
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+def _write_corpus(dirpath, rng, n):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n")
+            fp.write(" ".join(f"{v:7.5f}" for v in x) + "\n")
+            fp.write(f"[output] {N_OUT}\n")
+            fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+@pytest.fixture()
+def corpus(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    _write_corpus(tmp_path / "samples", rng, N_SAMP)
+    _write_corpus(tmp_path / "tests", rng, N_SAMP)
+    monkeypatch.chdir(tmp_path)
+    yield tmp_path
+    nn_log.set_verbosity(0)
+
+
+def _conf(tmp_path, nn_type="LNN", extra="", name=None):
+    text = (
+        f"[name] {name or 'tiny'}\n[type] {nn_type}\n[init] generate\n"
+        "[seed] 1234\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        "[train] BP\n"
+        f"[sample_dir] {tmp_path}/samples\n[test_dir] {tmp_path}/tests\n"
+        + extra)
+    path = tmp_path / f"nn_{name or nn_type}.conf"
+    path.write_text(text)
+    return str(path)
+
+
+def _run(main, args, subdir, env=None):
+    """One in-process CLI run in a fresh subdir at verbosity 2,
+    returning (rc, stdout, stderr)."""
+    os.makedirs(subdir, exist_ok=True)
+    cwd = os.getcwd()
+    os.chdir(subdir)
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    nn_log.set_verbosity(0)
+    so, se = io.StringIO(), io.StringIO()
+    try:
+        with redirect_stdout(so), redirect_stderr(se):
+            rc = main(["-vv", *args])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        os.chdir(cwd)
+    return rc, so.getvalue(), se.getvalue()
+
+
+# --- the reference fallthrough, pinned byte-for-byte ----------------------
+
+def test_default_lnn_is_byte_identical_to_snn(corpus):
+    """The reference's LNN 'implementation' IS the SNN path plus two
+    stderr warnings; the rebuild must not drift from that without the
+    opt-in."""
+    rc_s, out_s, err_s = _run(cli.train_nn_main,
+                              [_conf(corpus, "SNN", name="tiny")], "snn")
+    rc_l, out_l, err_l = _run(cli.train_nn_main,
+                              [_conf(corpus, "LNN", name="tiny")], "lnn")
+    assert rc_s == 0 and rc_l == 0
+    assert out_l == out_s  # stdout byte parity
+    assert open("snn/kernel.opt", "rb").read() == \
+        open("lnn/kernel.opt", "rb").read()
+    # the warnings go to STDERR (nn_error), never the training stream
+    assert err_s == ""
+    assert err_l.count("NN(ERR): unimplemented NN type!") == 2
+
+
+def test_default_lnn_run_nn_matches_snn(corpus):
+    rc, _, _ = _run(cli.train_nn_main, [_conf(corpus, "LNN")], "tr")
+    assert rc == 0
+    kernel = os.path.abspath("tr/kernel.opt")
+    extra = f"[init] {kernel}\n"
+    conf_s = _conf(corpus, "SNN", extra=extra, name="run_s")
+    conf_l = _conf(corpus, "LNN", extra=extra, name="run_l")
+    rc_s, out_s, err_s = _run(cli.run_nn_main, [conf_s], "rs")
+    rc_l, out_l, err_l = _run(cli.run_nn_main, [conf_l], "rl")
+    assert rc_s == 0 and rc_l == 0
+    assert out_l == out_s
+    # the reference's unimplemented-type warnings are train-path only
+    # (libhpnn.c:1260, 1301); run_nn evaluates silently through SNN
+    assert err_s == "" and err_l == ""
+
+
+# --- native mode ----------------------------------------------------------
+
+def test_native_lnn_trains_per_sample_bp(corpus):
+    rc, out, err = _run(cli.train_nn_main,
+                        ["--lnn", "native", _conf(corpus)], "nat")
+    assert rc == 0
+    assert "unimplemented NN type!" not in err  # really implemented now
+    assert out.count("SUCCESS!") == N_SAMP  # every sample converged
+
+
+def test_native_lnn_env_equals_flag(corpus):
+    conf = _conf(corpus)
+    rc1, out1, _ = _run(cli.train_nn_main, ["--lnn", "native", conf], "a")
+    rc2, out2, _ = _run(cli.train_nn_main, [conf], "b",
+                        env={"HPNN_LNN_NATIVE": "1"})
+    assert rc1 == 0 and rc2 == 0
+    assert out1 == out2
+    assert open("a/kernel.opt", "rb").read() == \
+        open("b/kernel.opt", "rb").read()
+
+
+def test_native_lnn_run_nn_reports_mse(corpus):
+    rc, _, _ = _run(cli.train_nn_main,
+                    ["--lnn", "native", _conf(corpus)], "tr")
+    assert rc == 0
+    kernel = os.path.abspath("tr/kernel.opt")
+    conf = _conf(corpus, extra=f"[init] {kernel}\n", name="run")
+    rc, out, err = _run(cli.run_nn_main, ["--lnn", "native", conf], "rn")
+    assert rc == 0
+    assert "unimplemented NN type!" not in err
+    assert out.count(" MSE=") == N_SAMP
+    # a regression eval has no argmax verdict
+    assert "PASS!" not in out and "FAIL!" not in out
+
+
+def test_cg_trainer_reduces_corpus_error(corpus):
+    rc, out, err = _run(
+        cli.train_nn_main,
+        ["--lnn", "native", "--trainer", "cg", "--epochs=3",
+         _conf(corpus)], "cg")
+    assert rc == 0
+    assert "unimplemented NN type!" not in err
+    lines = [ln for ln in out.splitlines() if "TRAINING CG" in ln]
+    assert len(lines) == 3  # one line per epoch
+    import re
+
+    errs = []
+    for ln in lines:
+        m = re.search(r"E0=\s*([0-9.eE+-]+)\s+E1=\s*([0-9.eE+-]+)", ln)
+        errs.append((float(m.group(1)), float(m.group(2))))
+    # each epoch improves, and epochs chain (E0[k+1] == E1[k])
+    for e0, e1 in errs:
+        assert e1 < e0
+    for (_, e1), (n0, _) in zip(errs, errs[1:]):
+        assert abs(n0 - e1) < 1e-9
+
+
+def test_cg_trainer_runs_classifiers_too(corpus):
+    """The trainer registry is orthogonal to the kernel head: --trainer
+    cg drives ANN/SNN classifiers through the same batched epoch."""
+    rc, out, _ = _run(
+        cli.train_nn_main,
+        ["--trainer", "cg", "--epochs=2", _conf(corpus, "ANN")], "ann")
+    assert rc == 0
+    assert out.count("TRAINING CG") == 2
+
+
+def test_cg_iters_env_knob(corpus):
+    rc, out, _ = _run(
+        cli.train_nn_main,
+        ["--lnn", "native", "--trainer", "cg", _conf(corpus)], "it",
+        env={"HPNN_CG_ITERS": "3"})
+    assert rc == 0
+    assert "iters=   3" in out
+
+
+# --- conf grammar ---------------------------------------------------------
+
+def test_conf_keywords_parse(corpus, tmp_path):
+    conf = _conf(tmp_path, extra="[lnn] native\n[trainer] cg\n")
+    nn = load_conf(conf)
+    assert nn is not None
+    assert nn.lnn == "native"
+    assert nn.trainer == "cg"
+    assert nn.train == NN_TRAIN_CG  # [trainer] coerces the train type
+    conf2 = _conf(tmp_path, extra="[trainer] bpm\n", name="t2")
+    nn2 = load_conf(conf2)
+    assert nn2.trainer == "bpm" and nn2.train == NN_TRAIN_BPM
+
+
+def test_conf_rejects_bad_keyword_values(tmp_path, capsys):
+    assert load_conf(_conf(tmp_path, extra="[trainer] sgd\n")) is None
+    assert load_conf(_conf(tmp_path, extra="[lnn] turbo\n",
+                           name="t3")) is None
+    err = capsys.readouterr().err
+    assert "[trainer] value: sgd" in err
+    assert "[lnn] value: turbo" in err
+
+
+def test_cli_rejects_bad_choice_values(corpus, capsys):
+    with pytest.raises(SystemExit):
+        cli.train_nn_main(["--trainer", "sgd", _conf(corpus)])
+    with pytest.raises(SystemExit):
+        cli.train_nn_main(["--lnn", "turbo", _conf(corpus)])
+    assert "bad --trainer parameter" in capsys.readouterr().err
+    nn_log.set_verbosity(0)
+
+
+# --- registry + kind plumbing ---------------------------------------------
+
+def test_trainer_registry():
+    from hpnn_tpu.train import (get_trainer, native_trainer, trainer_label,
+                                trainer_names)
+
+    assert trainer_names() == ["bp", "bpm", "cg"]
+    assert get_trainer("cg").native and not get_trainer("bp").native
+
+    class C:
+        train = NN_TRAIN_CG
+        trainer = "cg"
+
+    entry = native_trainer(C())
+    assert entry is not None and entry.name == "cg"
+    assert trainer_label(C()) == "cg"
+
+    class B:  # conf.trainer unset, BPM train type: no native dispatch
+        train = NN_TRAIN_BPM
+        trainer = ""
+
+    assert native_trainer(B()) is None
+    assert trainer_label(B()) == "bpm"
+
+
+def test_kernel_kind_gate(corpus, monkeypatch):
+    from hpnn_tpu.api import kernel_kind
+
+    class C:
+        type = NN_TYPE_LNN
+        lnn = ""
+
+    monkeypatch.delenv("HPNN_LNN_NATIVE", raising=False)
+    assert kernel_kind(C()) == NN_TYPE_SNN  # the fallthrough
+    C.lnn = "native"
+    assert kernel_kind(C()) == NN_TYPE_LNN
+    C.lnn = ""
+    monkeypatch.setenv("HPNN_LNN_NATIVE", "1")
+    assert kernel_kind(C()) == NN_TYPE_LNN
+
+    class A:
+        type = NN_TYPE_ANN
+        lnn = ""
+
+    assert kernel_kind(A()) == NN_TYPE_ANN
+
+
+def test_output_head_helpers():
+    assert output_head("LNN") == "linear"
+    assert output_head("SNN") == "softmax"
+    assert output_head("ANN") == "sigmoid"
+    assert is_regression("LNN") and not is_regression("SNN")
+
+
+def test_lnn_forward_output_is_linear():
+    """The LNN head is the pre-activation: large positive logits leave
+    the sigmoid's [0, 1] range (they would clamp under SNN/ANN)."""
+    import jax.numpy as jnp
+
+    from hpnn_tpu.ops.steps import batched_forward, forward
+
+    w = (np.full((N_HID, N_IN), 0.5), np.full((N_OUT, N_HID), 4.0))
+    x = np.ones((N_IN,))
+    out = np.asarray(
+        forward(tuple(jnp.asarray(v) for v in w), x, "LNN")[-1])
+    assert out.shape == (N_OUT,)
+    assert np.all(out > 1.5)  # linear: unbounded above 1
+    bat = np.asarray(batched_forward(
+        tuple(jnp.asarray(v) for v in w), x[None, :], "LNN"))
+    np.testing.assert_allclose(bat[0], out)

@@ -379,7 +379,10 @@ def _populated_metrics():
     m.bucket_latency("tiny", 4).observe(0.012, trace_id="trace-2")
     # kernel name with every character the exposition format must escape
     evil = 'k"er\\nal\n2'
-    m.set_model_info(evil, 3, 1700000000.0)
+    m.set_model_info(evil, 3, 1700000000.0, kind="LNN", trainer="cg")
+    m.set_model_info("tiny", 2, 1700000000.0, kind="SNN", trainer="bp")
+    # a label-less refresh (the jobs scheduler's per-epoch generation
+    # bump) must MERGE-RETAIN, not wipe the type/trainer labels
     m.set_model_info("tiny", 2, 1700000000.0)
     m.count_reload(True)
     m.count_generation("tiny", 1)
@@ -407,8 +410,17 @@ def test_prometheus_exposition_lint_populated():
     for want in ("hpnn_serve_requests_total", "hpnn_serve_phase_seconds",
                  "hpnn_serve_bucket_latency_seconds_count",
                  "hpnn_jobs_total", "hpnn_serve_generation_requests_total",
-                 "hpnn_serve_model_generation"):
+                 "hpnn_serve_model_generation",
+                 "hpnn_serve_model_info"):
         assert want in names, want
+    # per-kernel type/trainer labels (ISSUE 16): present, escaped, and
+    # retained across a label-less generation refresh
+    info_labels = [dict(labels) for name, labels in series
+                   if name == "hpnn_serve_model_info"]
+    assert {"kernel": "tiny", "type": "SNN", "trainer": "bp"} \
+        in info_labels
+    assert any(d["type"] == "LNN" and d["trainer"] == "cg"
+               for d in info_labels)
     # the hostile kernel name survived escaping and re-parses exactly
     gen_labels = [dict(labels) for name, labels in series
                   if name == "hpnn_serve_model_generation"]
