@@ -31,12 +31,13 @@ OBS_TESTS = tests/test_obs.py tests/test_fleet_obs.py
 TRACE_TESTS = tests/test_trace_analytics.py
 AUTOSCALE_TESTS = tests/test_autoscale.py
 LNN_TESTS = tests/test_lnn.py
+TP_TESTS = tests/test_tp_engine.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
 	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(TRAIN_CHAOS_TESTS) \
 	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) $(TRACE_TESTS) \
-	    $(AUTOSCALE_TESTS) $(LNN_TESTS) -q
+	    $(AUTOSCALE_TESTS) $(LNN_TESTS) $(TP_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -219,6 +220,24 @@ trainers-bench:
 	env JAX_PLATFORMS=cpu python scripts/trainers_bench.py \
 	    --out TRAINERS_BENCH.json
 
+# giant-topology TP bench (ISSUE 17): overlapped ring allgather vs the
+# explicit gather schedule on the same engines (train + serve routes),
+# 1-D model mesh vs 2-D data x model composition, per-layer comm
+# fraction via a compute-only ablation; emits MODEL_BENCH.json, rc!=0
+# when a floor misses.  Default forces CPU + 8 virtual devices;
+# `make model-bench REAL=1` keeps the ambient platform (chips over ICI).
+# tests/test_bench_probe.py holds the committed artifact in tier 1
+model-bench:
+	python scripts/model_bench.py --out MODEL_BENCH.json \
+	    $(if $(REAL),--real)
+
+# TP parity tier (ISSUE 17): ring-engine unit parity ({ANN,SNN,LNN} x
+# {BP,BPM} x {f64,bf16} x {1-D, 2-D mesh}), overlap-vs-gather oracle,
+# pipeline-vs-restage byte parity, kill/--resume on the TP route, and
+# the over-budget train+serve acceptance drive
+tp-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(TP_TESTS) -q
+
 # fleet observability overhead (ISSUE 10 + 13): the same 2-worker mesh
 # load with tracing + metrics federation OFF vs ON vs SAMPLED
 # (--trace-sample 0.01, the fleet-QPS configuration; forced capture
@@ -232,4 +251,5 @@ obs-bench:
 .PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
     ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
     serve-bench io-bench epoch-bench dp-epoch-bench mfu-bench \
-    mesh-bench autoscale-check trace-check lnn-check trainers-bench
+    mesh-bench autoscale-check trace-check lnn-check trainers-bench \
+    model-bench tp-check

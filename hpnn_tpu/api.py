@@ -301,14 +301,16 @@ _prefetch_thread = None
 EPOCH_METRICS = {"epochs": 0, "h2d_bytes": 0, "stage_s": 0.0,
                  "shuffle_s": 0.0, "setup_h2d_bytes": 0, "setup_s": 0.0,
                  "mode": None, "opt_state_bytes_per_device": 0,
-                 "opt_state_replicated_bytes": 0, "dp_devices": 1}
+                 "opt_state_replicated_bytes": 0, "dp_devices": 1,
+                 "tp_devices": 1, "weight_bytes_per_device": 0}
 
 
 def reset_epoch_metrics() -> None:
     EPOCH_METRICS.update(epochs=0, h2d_bytes=0, stage_s=0.0, shuffle_s=0.0,
                          setup_h2d_bytes=0, setup_s=0.0, mode=None,
                          opt_state_bytes_per_device=0,
-                         opt_state_replicated_bytes=0, dp_devices=1)
+                         opt_state_replicated_bytes=0, dp_devices=1,
+                         tp_devices=1, weight_bytes_per_device=0)
 
 
 def _dp_device_count() -> int:
@@ -347,7 +349,8 @@ def _dp_banner_lines(s: int, bsz: int, n_batches: int, bsz_pad: int,
     """[batch] minibatch-route console banners -- like ``_dp_slot_map``,
     the ONE source for the restage and resident paths (the strings are
     a resident==restage byte-parity surface).  The hybrid-mesh banner
-    stays restage-only: the pipeline never takes the hybrid route."""
+    (``_hybrid_banner``) is prepended by BOTH routes when [model] rides
+    along (ISSUE 17: the pipeline takes the hybrid route too)."""
     lines = []
     if unsharded:
         lines.append("DP: one device visible; minibatch training runs "
@@ -358,6 +361,34 @@ def _dp_banner_lines(s: int, bsz: int, n_batches: int, bsz_pad: int,
                      f"(S={s}, batch={bsz} -> {bsz_pad} over {n_data} "
                      "data-shard(s))\n")
     return lines
+
+
+def _hybrid_banner(n_data: int, n_model: int) -> str:
+    """[batch]x[model] hybrid-mesh banner, shared restage/resident
+    (parity surface)."""
+    return (f"DP: hybrid mesh {n_data}x{n_model} "
+            "(batch rows over data, weight rows over model)\n")
+
+
+def _hybrid_model_axis(shards: int, ndev: int):
+    """``(n_model, warn_text_or_None)`` for [model] riding a [batch]
+    run: the largest divisor of the FULL device grid not exceeding the
+    request (stricter than ``_clamped_model_mesh``'s cap-at-ndev: the
+    hybrid mesh is a full ndev grid, so the model axis must divide it;
+    the TP route's 1xN mesh can use a device subset instead).  Shared
+    by the restage route and the epoch pipeline so the clamp warnings
+    stay byte-identical."""
+    if shards <= 1:
+        return 1, None
+    if ndev == 1:
+        return 1, f"[model] {shards} > 1 visible device(s); using 1\n"
+    n_model = min(shards, ndev)
+    while ndev % n_model:
+        n_model -= 1
+    if n_model != shards:
+        return n_model, (f"[model] {shards} clamped to {n_model} "
+                         f"(device count {ndev})\n")
+    return n_model, None
 
 
 def _dp_tiled_banner(group: int, pad_to: int, meshed: bool,
@@ -413,16 +444,23 @@ class _EpochPipeline:
     """
 
     def __init__(self, rc, dtype, wdtype, shard_rows: int,
-                 dp: str | None = None, mesh=None):
+                 dp: str | None = None, mesh=None, n_model: int = 1,
+                 tp: bool = False, tp_warn: str | None = None):
         self.rc = rc                      # ResidentCorpus (listing order)
         self.dtype = dtype
         self.wdtype = wdtype
         self.shard_rows = shard_rows
         self.dp = dp                      # None | "sgd" | "tiled"
-        self.mesh = mesh                  # data mesh ([batch] multi-device)
-        if dp:
+        self.mesh = mesh                  # data/(data x model)/model mesh
+        self.n_model = n_model            # model-axis width (hybrid route)
+        self.tp = tp                      # pure [model] per-sample route
+        self.tp_warn = tp_warn            # per-epoch clamp warning text
+        self._tp_orig = None              # unpadded row dims (TP carry)
+        if tp:
+            self.mode = "tp-resident"
+        elif dp:
             self.mode = "dp-tiled-resident" if dp == "tiled" \
-                else "dp-resident"
+                else ("dp-tp-resident" if n_model > 1 else "dp-resident")
         else:
             self.mode = "sharded" if shard_rows else "resident"
         self.weights = None               # device carry across epochs
@@ -478,14 +516,43 @@ class _EpochPipeline:
         dp = None
         mesh = None
         n_data = 1
+        n_model = 1
+        tp = False
+        tp_warn = None
+        shards = _model_shards(conf)
         if conf.batch > 0:
             dp = "tiled" if _tile_request(conf) else "sgd"
+            if dp == "tiled" and shards > 1:
+                # [tile]+[model] keeps the restage route (it warns and
+                # falls back to minibatch DP there); the pipeline would
+                # have to duplicate that fallback's console stream
+                return None
             ndev = _dp_device_count()
+            if shards > 1:
+                n_model, tp_warn = _hybrid_model_axis(shards, ndev)
             if ndev > 1:
                 from .parallel import make_mesh
 
-                mesh = make_mesh(n_data=ndev, n_model=1)
-                n_data = ndev
+                mesh = make_mesh(n_data=ndev // n_model, n_model=n_model)
+                n_data = ndev // n_model
+        elif shards > 1:
+            # pure [model]: the per-sample TP route rides the pipeline on
+            # a 1xN model mesh (even N==1 after clamping -- the engine is
+            # the same, which keeps kill/--resume byte-exact)
+            import jax
+
+            from .parallel import make_mesh
+
+            tp = True
+            ndev = jax.device_count()
+            k = min(shards, ndev)
+            if shards > ndev:
+                # _clamped_model_mesh's exact warning, re-emitted per
+                # epoch (the restage route warns every epoch)
+                tp_warn = (f"[model] {shards} > {ndev} visible "
+                           f"device(s); using {ndev}\n")
+            mesh = make_mesh(n_data=1, n_model=k)
+            n_model = k
         shard_rows = 0
         if os.environ.get("HPNN_EPOCH_SHARD_ROWS"):
             # a SET knob suppresses the budget check entirely (the
@@ -500,16 +567,19 @@ class _EpochPipeline:
             if budget and rc.n_rows * row_bytes // n_data > budget:
                 # two shards live at once (double buffering)
                 shard_rows = max(1, budget // row_bytes // 2)
-        if dp and shard_rows:
-            nn_dbg("epoch pipeline: [batch] corpus over the per-device "
-                   "budget (host-stream sharding is single-device "
-                   "machinery); restaging\n")
+        if shard_rows and (dp or tp):
+            nn_dbg("epoch pipeline: [batch]/[model] corpus over the "
+                   "per-device budget (host-stream sharding is "
+                   "single-device machinery); restaging\n")
             return None
-        pipe = cls(rc, dtype, wdtype, shard_rows, dp=dp, mesh=mesh)
+        pipe = cls(rc, dtype, wdtype, shard_rows, dp=dp, mesh=mesh,
+                   n_model=n_model, tp=tp, tp_warn=tp_warn)
         if not shard_rows:
             # the ONE corpus upload of the whole run (cast once on the
-            # way up -- elementwise, so identical to per-epoch casting)
-            if mesh is not None:
+            # way up -- elementwise, so identical to per-epoch casting).
+            # The pure-TP route keeps plain resident arrays: its epoch
+            # places replicated chunks itself (tp_train_epoch_resident).
+            if mesh is not None and dp:
                 import jax
 
                 from .parallel.mesh import batch_sharding
@@ -540,9 +610,11 @@ class _EpochPipeline:
             rc.release_rows()
         EPOCH_METRICS["setup_s"] += time.perf_counter() - t0
         EPOCH_METRICS["dp_devices"] = n_data
+        EPOCH_METRICS["tp_devices"] = n_model
         nn_dbg(f"epoch pipeline: {pipe.mode}, {rc.n_rows} row(s)"
                + (f", shard={shard_rows}" if shard_rows else "")
-               + (f", mesh={n_data}" if mesh is not None else "") + "\n")
+               + (f", mesh={n_data}x{n_model}" if mesh is not None
+                  else "") + "\n")
         return pipe
 
     # --- per-epoch --------------------------------------------------------
@@ -554,6 +626,8 @@ class _EpochPipeline:
 
         from . import ops
 
+        if self.tp:
+            return self._run_epoch_tp(nn, sel, kind, momentum)
         if self.dp == "sgd":
             return self._run_epoch_dp_sgd(nn, sel, kind, momentum)
         if self.dp == "tiled":
@@ -602,6 +676,52 @@ class _EpochPipeline:
         nn.last_epoch_stats = None        # real after join()
         return stats
 
+    # --- [model] TP epochs (ISSUE 17) -------------------------------------
+
+    def _run_epoch_tp(self, nn, sel, kind: str, momentum: bool):
+        """One per-sample TP epoch on the row-sharded resident carry:
+        the padded weight blocks stay on the model mesh across epochs,
+        only the int32 permutation crosses the host boundary
+        (``tp_train_epoch_resident``)."""
+        import jax.numpy as jnp
+
+        from .obs import trace as obs_trace
+        from .parallel import (per_device_bytes, tp_resident_carry,
+                               tp_train_epoch_resident)
+
+        t0 = time.perf_counter()
+        if self.tp_warn:
+            # the restage route warns every epoch, AFTER that epoch's
+            # banner -- ride the deferred queue to keep stream order
+            self.pending.append(("entries", [("warn", self.tp_warn)]))
+        if self.weights is None:
+            staged = tuple(jnp.asarray(w, dtype=self.wdtype)
+                           for w in nn.kernel.weights)
+            self.weights, self._tp_orig = tp_resident_carry(staged,
+                                                            self.mesh)
+            EPOCH_METRICS["setup_h2d_bytes"] += sum(
+                w.nbytes for w in staged)
+            EPOCH_METRICS["weight_bytes_per_device"] = \
+                per_device_bytes(self.weights)
+        with obs_trace.span("corpus_gather", rows=int(sel.size)):
+            sel_dev = jnp.asarray(sel)  # THE per-epoch H2D: int32 perm
+            xs = jnp.take(self.x_dev, sel_dev, axis=0)
+            ts = jnp.take(self.t_dev, sel_dev, axis=0)
+        self.h2d_last = sel.nbytes
+        self.stage_last = time.perf_counter() - t0
+        with obs_trace.span("device_launch", rows=int(sel.size),
+                            mode=self.mode):
+            new_w, stats = tp_train_epoch_resident(
+                self.weights, xs, ts, kind, momentum, self.mesh,
+                donate=True, alpha=0.2)
+        self.weights = new_w
+        fut = corpus_io.io_pool().submit(
+            _render_training_lines, self.events_last, stats, kind,
+            momentum, nn_log.get_verbosity())
+        self.pending.append(fut)
+        nn.last_epoch_stats = None        # real after join()
+        return stats
+
     # --- [batch] DP epochs (ISSUE 12) -------------------------------------
 
     def _dp_setup(self, nn, kind: str, momentum: bool):
@@ -625,19 +745,28 @@ class _EpochPipeline:
             else bsz
         banners = _dp_banner_lines(s, bsz, n_batches, bsz_pad, n_data,
                                    unsharded=self.mesh is None)
+        if self.n_model > 1:
+            banners = [_hybrid_banner(n_data, self.n_model)] + banners
         pos, mask = _dp_slot_map(s, bsz, n_batches, bsz_pad)
         mb_dev = jnp.asarray(mask, dtype=self.dtype)
         lr = ops.bpm_learn_rate(kind) if momentum \
             else ops.bp_learn_rate(kind)
+        # the flat 1/N master-vector trick is a pure-DP layout; on a
+        # hybrid mesh the TP engine carries f32 master row BLOCKS instead
         shard_master = (self.dtype == jnp.bfloat16
-                        and self.mesh is not None)
+                        and self.mesh is not None and self.n_model == 1)
         self.shapes = tuple(tuple(int(d) for d in w.shape)
                             for w in nn.kernel.weights)
         if self.weights is None:
             staged = tuple(jnp.asarray(w, dtype=self.wdtype)
                            for w in nn.kernel.weights)
-            self.weights = dp_resident_carry(staged, self.mesh,
-                                             shard_master)
+            if self.n_model > 1:
+                from .parallel import tp_dp_resident_carry
+
+                self.weights = tp_dp_resident_carry(staged, self.mesh)
+            else:
+                self.weights = dp_resident_carry(staged, self.mesh,
+                                                 shard_master)
             EPOCH_METRICS["setup_h2d_bytes"] += sum(
                 int(np.prod(sh)) for sh in self.shapes) \
                 * jnp.dtype(self.wdtype).itemsize
@@ -662,6 +791,10 @@ class _EpochPipeline:
         if self._dp_state is None:
             self._dp_setup(nn, kind, momentum)
         st = self._dp_state
+        if self.tp_warn:
+            # per-epoch clamp warning, deferred for stream order (the
+            # restage route warns before each epoch's banner lines)
+            self.pending.append(("entries", [("warn", self.tp_warn)]))
         for text in st["banners"]:
             self.pending.append(("out", text))
         # THE per-epoch H2D: the permutation scattered into batch slots
@@ -672,22 +805,37 @@ class _EpochPipeline:
         self.stage_last = time.perf_counter() - t0
         with obs_trace.span("device_launch", rows=int(sel.size),
                             mode=self.mode, n_data=st["n_data"]):
-            new_w, dw, errs = dp_train_epoch_resident(
-                self.weights, self.x_dev, self.t_dev, sel_dev,
-                st["mb_dev"], kind, momentum, st["lr"], alpha=0.2,
-                mesh=self.mesh, shard_master=st["shard_master"],
-                shapes=self.shapes, donate=True)
+            if self.n_model > 1:
+                from .parallel import tp_dp_train_epoch_resident
+
+                new_w, dw, errs = tp_dp_train_epoch_resident(
+                    self.weights, self.x_dev, self.t_dev, sel_dev,
+                    st["mb_dev"], kind, momentum, st["lr"], alpha=0.2,
+                    mesh=self.mesh, donate=True)
+            else:
+                new_w, dw, errs = dp_train_epoch_resident(
+                    self.weights, self.x_dev, self.t_dev, sel_dev,
+                    st["mb_dev"], kind, momentum, st["lr"], alpha=0.2,
+                    mesh=self.mesh, shard_master=st["shard_master"],
+                    shapes=self.shapes, donate=True)
         self.weights = new_w
         # measured (not by-construction) optimizer-state footprint
-        state_arrays = [a for a in (dw,) if a is not None]
+        state_arrays, n_state = [], 0
+        if dw is not None:
+            state_arrays += list(dw) if isinstance(dw, tuple) else [dw]
+            n_state += 1
         if st["shard_master"]:
             state_arrays.append(new_w)
+            n_state += 1
         params = sum(int(np.prod(sh)) for sh in self.shapes)
         itemsize = jnp.dtype(self.wdtype).itemsize
         EPOCH_METRICS["opt_state_bytes_per_device"] = \
             per_device_bytes(state_arrays)
         EPOCH_METRICS["opt_state_replicated_bytes"] = \
-            params * itemsize * len(state_arrays)
+            params * itemsize * n_state
+        if self.n_model > 1:
+            EPOCH_METRICS["weight_bytes_per_device"] = \
+                per_device_bytes(new_w.blocks)
         fut = corpus_io.io_pool().submit(
             _render_dp_lines, errs, st["s"], nn_log.get_verbosity())
         self.pending.append(fut)
@@ -836,7 +984,20 @@ class _EpochPipeline:
                 nn.last_epoch_stats = summary
         self.pending = []
         if self.weights is not None:
-            if self.dp == "sgd":
+            if self.tp or (self.dp == "sgd" and self.n_model > 1):
+                # TP carries live as padded row blocks on the model
+                # mesh; export replicates once, unpads, and drops back
+                # to the float64 host topology
+                from .parallel import tp_export_weights
+
+                if self.tp:
+                    blocks, orig = self.weights, self._tp_orig
+                else:
+                    blocks, orig = self.weights.blocks, self.weights.orig
+                nn.kernel.weights = [
+                    np.asarray(w, dtype=np.float64)
+                    for w in tp_export_weights(blocks, orig, self.mesh)]
+            elif self.dp == "sgd":
                 # the DP carry may live as the flat 1/N-sharded master
                 # vector (bf16 route); export re-materializes layers
                 from .parallel.dp import dp_export_weights
@@ -862,8 +1023,7 @@ def _pipeline_for(nn, conf):
     if (nn.shuffle_rng is not None                    # multi-epoch driver
             and conf.train in (NN_TRAIN_BP, NN_TRAIN_BPM)
             and conf.samples is not None
-            and not os.environ.get("HPNN_NO_EPOCH_PIPELINE")
-            and _model_shards(conf) <= 1):
+            and not os.environ.get("HPNN_NO_EPOCH_PIPELINE")):
         from .utils.trace import trace_enabled
 
         import jax
@@ -1440,28 +1600,15 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     n_batches = -(-s // bsz)
     dtype = _dtype_of(conf)
     ndev = _dp_device_count()
-    n_model = 1
-    if model_shards > 1 and ndev == 1:
-        nn_warn(f"[model] {model_shards} > 1 visible device(s); "
-                "using 1\n")
-    elif model_shards > 1:
-        # largest divisor of the device count not exceeding the request
-        # (stricter than _clamped_model_mesh's cap-at-ndev: the hybrid
-        # mesh is a FULL ndev grid, so the model axis must divide it; the
-        # TP route's 1xN mesh can use a device subset instead)
-        n_model = min(model_shards, ndev)
-        while ndev % n_model:
-            n_model -= 1
-        if n_model != model_shards:
-            nn_warn(f"[model] {model_shards} clamped to {n_model} "
-                    f"(device count {ndev})\n")
+    n_model, clamp_warn = _hybrid_model_axis(model_shards, ndev)
+    if clamp_warn:
+        nn_warn(clamp_warn)
     if ndev > 1:
         mesh = make_mesh(n_data=ndev // n_model, n_model=n_model)
     else:
         mesh = None
     if mesh is not None and n_model > 1:
-        nn_out(f"DP: hybrid mesh {ndev // n_model}x{n_model} "
-               "(batch rows over data, weight rows over model)\n")
+        nn_out(_hybrid_banner(ndev // n_model, n_model))
     n_data = mesh.shape[DATA_AXIS] if mesh is not None else 1
     bsz_pad = -(-bsz // n_data) * n_data if mesh is not None else bsz
     for line in _dp_banner_lines(s, bsz, n_batches, bsz_pad, n_data,
@@ -1665,10 +1812,10 @@ def run_kernel(nn: NNDef) -> None:
             # [model] N / -S N: row-sharded evaluation -- the reference's
             # run path splits the same rows across ranks/streams
             # (libhpnn.c:1426 -> ann.c:913-936)
-            from .parallel import tp_run_batch
+            from .parallel import tp_eval_batch
 
             mesh, _ = _clamped_model_mesh(model_shards)
-            outs = np.asarray(tp_run_batch(weights, xs_dev, kind, mesh),
+            outs = np.asarray(tp_eval_batch(weights, xs_dev, kind, mesh),
                               dtype=np.float64)
         else:
             run_batch_fn, _ = ops.select_run_batch(dtype, kind=kind)

@@ -90,6 +90,12 @@ def _help_text(name: str, train: bool) -> str:
             "\thttp://HOST:PORT of a mesh router); --resume restores",
             "\tfrom DEST when no local bundle survives.  Default:",
             "\t$HPNN_REPLICATE_TO.",
+            "--model-parallel N \tshard every layer's neuron rows over",
+            "\tN mesh devices (the reference's MPI_Allgather row split,",
+            "\toverlapped ring schedule); wins over the conf [model]",
+            "\tkeyword.  Composes with [batch] on a 2-D data x model",
+            "\tmesh; HPNN_NO_TP_OVERLAP=1 falls back to whole-layer",
+            "\tall-gathers.",
             "--trainer T \tselect the trainer from the registry:",
             "\t'cg' (batched nonlinear conjugate gradient,",
             "\tPolak-Ribiere + restart, on-device line search;",
@@ -126,7 +132,8 @@ _LONG_INT_OPTS = {"--epochs": ("epochs", 1),
                   "--ckpt-every": ("ckpt_every", 0),
                   "--ckpt-keep": ("ckpt_keep", 0),
                   "--corpus-cache-max-mb": ("corpus_cache_max_mb", 0),
-                  "--tile": ("tile", 0)}
+                  "--tile": ("tile", 0),
+                  "--model-parallel": ("model_parallel", 1)}
 _SHARED_INT_OPTS = frozenset(("--corpus-cache-max-mb",))
 
 
@@ -353,6 +360,9 @@ def _train_nn_body(filename: str, extras: dict) -> int:
         # --lnn native: opt into the native LNN regression head (wins
         # over a [lnn] conf keyword, like --tile over [tile])
         neural.conf.lnn = extras["lnn"]
+    if extras.get("model_parallel") is not None:
+        # --model-parallel N: row-sharding degree, wins over [model]
+        neural.conf.model = extras["model_parallel"]
     if extras.get("trainer"):
         # --trainer cg|bp|bpm: select a registry trainer; coerces the
         # conf [train] type so snapshots/serve report coherently

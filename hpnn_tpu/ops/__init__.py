@@ -133,10 +133,24 @@ def select_train_epoch(dtype=None, donate=False, defer_stats=False,
     return base, "xla"
 
 
-def select_run_batch(dtype=None, parity="strict", kind=None):
+def select_run_batch(dtype=None, parity="strict", kind=None,
+                     model_mesh=None):
     """Pick the batched-inference implementation (run_kernel's eval path).
 
-    Two-axis tiering:
+    ``model_mesh`` (ISSUE 17) overrides both tiers: a mesh whose
+    ``"model"`` axis is wider than 1 routes to the tensor-parallel ring
+    engine (``parallel.tp.tp_eval_batch``) -- weight ROW BLOCKS stay
+    sharded across the axis (the reference's MPI layout, ann.c:913-926)
+    and activations circulate via ``lax.ppermute`` overlapped with the
+    partial GEMMs, so a topology whose weights exceed one device's
+    memory still serves.  The returned fn stays call-compatible with
+    ``run_batch(weights, xs, kind)`` and also accepts an
+    already-resident ``TPCarry`` as ``weights`` (the serve registry
+    caches one per mesh).  Name reports the schedule actually taken:
+    ``"tp-ring"`` (overlapped) or ``"tp-gather"``
+    (``HPNN_NO_TP_OVERLAP=1`` -- the explicit all-gather oracle).
+
+    Two-axis tiering otherwise:
 
     * ``parity="strict"`` (default) -- the bit-parity tier.  The XLA
       ``run_batch`` (a scanned per-row GEMV chain -- row results
@@ -160,6 +174,17 @@ def select_run_batch(dtype=None, parity="strict", kind=None):
     """
     if parity not in ("strict", "fast"):
         raise ValueError(f"parity must be 'strict' or 'fast': {parity!r}")
+    if model_mesh is not None:
+        from ..parallel.mesh import MODEL_AXIS
+
+        if model_mesh.shape[MODEL_AXIS] > 1:
+            import functools
+
+            from ..parallel import tp_eval_batch, tp_overlap_enabled
+
+            fn = functools.partial(tp_eval_batch, mesh=model_mesh)
+            return fn, ("tp-ring" if tp_overlap_enabled()
+                        else "tp-gather")
     if _use_pallas(dtype) and kind != LNN:
         from .pallas_kernels import batched_forward_pallas_jit
 

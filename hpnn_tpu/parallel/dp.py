@@ -37,6 +37,7 @@ import numpy as np
 from ..ops import steps
 from .mesh import (
     DATA_AXIS,
+    MODEL_AXIS,
     batch_sharding,
     flat_state_sharding,
     flatten_state,
@@ -123,16 +124,31 @@ def _dp_epoch_scan(w_carry, xb, tb, mb, kind: str, momentum: bool, lr,
     flat master vector; returns ``((w_carry, dw_flat), errs)``.
     """
     n_data = mesh.shape[DATA_AXIS] if mesh is not None else 1
-    fs = flat_state_sharding(mesh) if mesh is not None else None
+    # the flat 1/N layout is PURE-DP machinery: on a 2-D (data x model)
+    # mesh this XLA's GSPMD miscompiles the flat domain -- both the
+    # P("data") constraint and the bare flatten/unflatten round-trip of
+    # grads descending from row-sharded weights come back with the
+    # model-axis contraction duplicates SUMMED into the result
+    # (measured: dw is n_model x too large after one step).  So with a
+    # model axis the momentum stays per-layer -- bitwise the same
+    # values, already 1/k-sharded over "model" wherever the layer is
+    # (api gates shard_master to n_model == 1, so the flat master
+    # vector never meets a 2-D mesh).
+    flat_mom = mesh is None or mesh.shape[MODEL_AXIS] == 1
+    fs = flat_state_sharding(mesh) if mesh is not None and flat_mom \
+        else None
 
     def cons(v):
         return lax.with_sharding_constraint(v, fs) if fs is not None else v
 
     if momentum:
-        total = sum(int(np.prod(sh)) for sh in shapes)
-        total += (-total) % n_data
         wdtype = w_carry.dtype if shard_master else w_carry[0].dtype
-        dw0 = cons(jnp.zeros((total,), wdtype))
+        if flat_mom:
+            total = sum(int(np.prod(sh)) for sh in shapes)
+            total += (-total) % n_data
+            dw0 = cons(jnp.zeros((total,), wdtype))
+        else:
+            dw0 = tuple(jnp.zeros(sh, wdtype) for sh in shapes)
     else:
         dw0 = ()
 
@@ -141,7 +157,7 @@ def _dp_epoch_scan(w_carry, xb, tb, mb, kind: str, momentum: bool, lr,
         ws = unflatten_state(wc, shapes) if shard_master else wc
         x, t, m = xtm
         grads, err = batched_grads(ws, x, t, kind, m)
-        if momentum:
+        if momentum and flat_mom:
             # reference order dw+=lr*g; W+=dw; dw*=alpha
             # (ann.c:1996-1999), in the flat domain
             dw = cons(dw + lr * flatten_state(grads, n_data))
@@ -151,6 +167,11 @@ def _dp_epoch_scan(w_carry, xb, tb, mb, kind: str, momentum: bool, lr,
                 dws = unflatten_state(dw, shapes)
                 wc = tuple(w + b for w, b in zip(wc, dws))
             dw = cons(alpha * dw)
+        elif momentum:
+            # same order on per-layer buffers (the 2-D mesh route)
+            dw = tuple(b + lr * g for b, g in zip(dw, grads))
+            wc = tuple(w + b for w, b in zip(wc, dw))
+            dw = tuple(alpha * b for b in dw)
         else:
             if shard_master:
                 wc = cons(wc + lr * flatten_state(grads, n_data))

@@ -48,6 +48,18 @@ def make_mesh(n_data: int | None = None, n_model: int = 1,
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
 
 
+def tp_device_count() -> int:
+    """Model-axis width requested by ``HPNN_TP_DEVICES`` -- the tensor-
+    parallel twin of ``HPNN_DP_DEVICES`` (the serve process reads it to
+    build the giant-topology eval mesh; training takes its width from
+    ``[model]``/``--model-parallel`` instead).  Capped to the visible
+    devices; 0/unset means no TP mesh."""
+    from ..utils.env import env_int
+
+    cap = env_int("HPNN_TP_DEVICES", 0)
+    return max(1, min(jax.device_count(), cap)) if cap > 0 else 1
+
+
 def data_mesh(n_devices: int | None = None) -> Mesh | None:
     """A pure-data mesh for batch-sharded serving/eval, or None when the
     request cannot shard (one device, or an explicit n_devices < 2).
@@ -178,7 +190,14 @@ def layer_sharding(w, mesh: Mesh) -> NamedSharding:
 
 def flat_state_sharding(mesh: Mesh) -> NamedSharding:
     """1-D sharding for a flattened optimizer-state vector: each
-    data-parallel replica owns a contiguous 1/N slice."""
+    data-parallel replica owns a contiguous 1/N slice.  ``P("data")``
+    names only the data axis; the constraint is applied on PURE-DP
+    (n_model == 1) meshes only -- on a 2-D (data x model) mesh this
+    XLA's GSPMD resolves it by summing the model-axis duplicates of the
+    gradient contraction into the shards (dp._dp_epoch_scan documents
+    the measurement), so the hybrid route carries its update state as
+    per-layer row blocks instead (parallel.tp, already 1/k over
+    "model" -- the ISSUE 17 composition)."""
     return NamedSharding(mesh, P(DATA_AXIS))
 
 

@@ -26,6 +26,8 @@ from __future__ import annotations
 
 import functools
 import inspect
+import os
+import typing
 
 import jax
 import jax.numpy as jnp
@@ -54,13 +56,39 @@ def shard_map(f, **kw):
 
 from ..ops import steps
 from .mesh import (
+    DATA_AXIS,
     MODEL_AXIS,
     global_array,
     layer_sharding,
     pad_topology,
     replicated,
+    row_sharding,
     unpad_topology,
 )
+
+
+def _apply_head(z, kind: str):
+    """Output-layer head for all three kernel families, the single
+    source ops.steps.forward uses: SNN softmax(x-1), LNN linear (the
+    native regression head, PR 16), ANN squash.  Every TP path routes
+    its output pre-activation through here so the LNN opt-in can never
+    silently pick up a tanh/sigmoid clamp on the sharded routes."""
+    from ..ops.activations import ann_act, snn_softmax
+
+    if kind == steps.SNN:
+        return snn_softmax(z)
+    if kind == steps.LNN:
+        return z
+    return ann_act(z)
+
+
+def tp_overlap_enabled() -> bool:
+    """Ring-overlap escape hatch: ``HPNN_NO_TP_OVERLAP=1`` swaps the
+    ppermute ring schedule for a plain all_gather-then-GEMM inside the
+    SAME shard_map engine (the apples-to-apples comparator the bench
+    races; also the conservative fallback if a backend's ppermute
+    lowering misbehaves)."""
+    return os.environ.get("HPNN_NO_TP_OVERLAP", "") != "1"
 
 
 def _place(x, sharding, mesh):
@@ -150,7 +178,8 @@ def tp_train_sample(weights, x, t, kind: str, momentum: bool, mesh, **kw):
 
 
 @functools.lru_cache(maxsize=64)
-def _tp_epoch_fn(kind: str, momentum: bool, shardings, rep, kw_items):
+def _tp_epoch_fn(kind: str, momentum: bool, shardings, rep, kw_items,
+                 donate: bool = False):
     """Cached jitted SPMD epoch: ``lax.scan`` of the per-sample convergence
     while-loop over the sample axis, weights sharded across the model axis
     for the WHOLE scan.  One dispatch per epoch -- the same shape as the
@@ -176,7 +205,8 @@ def _tp_epoch_fn(kind: str, momentum: bool, shardings, rep, kw_items):
     from ..ops.convergence import SampleStats
 
     stats_sh = SampleStats(*([rep] * len(SampleStats._fields)))
-    return jax.jit(epoch, out_shardings=(shardings, stats_sh))
+    return jax.jit(epoch, out_shardings=(shardings, stats_sh),
+                   donate_argnums=(0,) if donate else ())
 
 
 def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
@@ -199,10 +229,43 @@ def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
     with a leading S axis) -- the same stats shape as ``ops.train_epoch``.
     """
     sharded, orig = _shard_padded(weights, mesh)
+    sharded, stats = tp_train_epoch_resident(sharded, xs, ts, kind,
+                                             momentum, mesh, **kw)
+    # multi-process: the row shards live on other hosts; replicate through
+    # the cached identity (an all-gather over the model axis -- the
+    # reference's post-update weight Allgather, ann.c:1636-1642) and read
+    # the local replica
+    return tp_export_weights(sharded, orig, mesh), stats
+
+
+def tp_resident_carry(weights, mesh):
+    """Pad + shard the epoch-to-epoch TP weight carry (the epoch
+    pipeline's resident layout).  Returns ``(sharded, orig_row_dims)`` --
+    feed ``sharded`` to :func:`tp_train_epoch_resident` and export with
+    :func:`tp_export_weights`."""
+    return _shard_padded(weights, mesh)
+
+
+def tp_export_weights(sharded, orig, mesh):
+    """Sharded carry -> unpadded host-readable weights (the replicating
+    identity is the reference's post-update weight Allgather,
+    ann.c:1636-1642)."""
+    final = _localize(_replicate_fn(replicated(mesh))(sharded))
+    return unpad_topology(final, orig)
+
+
+def tp_train_epoch_resident(sharded, xs, ts, kind: str, momentum: bool,
+                            mesh, donate: bool = False, **kw):
+    """``tp_train_epoch`` on an ALREADY-sharded weight carry: the body
+    between the pad/shard staging and the final gather, so the epoch
+    pipeline can keep the carry mesh-resident across epochs (donated
+    launch-to-launch off-CPU) and gather only at snapshot joins.
+    Returns ``(sharded', stats)``; stats are host-localized."""
     shardings = tuple(layer_sharding(w, mesh) for w in sharded)
     rep = replicated(mesh)
     fn = _tp_epoch_fn(kind, momentum, shardings, rep,
-                      tuple(sorted(kw.items())))
+                      tuple(sorted(kw.items())),
+                      donate=donate and jax.default_backend() != "cpu")
     # bounded launches on TPU (the ~60 s execution watchdog --
     # ops.convergence.EPOCH_CHUNK); weights stay sharded-resident
     # between chunks, so this adds only a few dispatches per epoch.
@@ -249,7 +312,7 @@ def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
             return float(sum(np.sum(p.n_iter) for p in pend))
 
         parts = _adaptive_launches(
-            _get_chunker([w.shape for w in weights], kind, momentum,
+            _get_chunker([w.shape for w in sharded], kind, momentum,
                          route="tp"),
             s, launch, read_iters, localize=_localize)
         if len(parts) == 1:
@@ -258,12 +321,7 @@ def tp_train_epoch(weights, xs, ts, kind: str, momentum: bool, mesh, **kw):
             stats = SampleStats(
                 *(np.concatenate([getattr(p, f) for p in parts])
                   for f in SampleStats._fields))
-    # multi-process: the row shards live on other hosts; replicate through
-    # the cached identity (an all-gather over the model axis -- the
-    # reference's post-update weight Allgather, ann.c:1636-1642) and read
-    # the local replica
-    final = _localize(_replicate_fn(rep)(sharded))
-    return unpad_topology(final, orig), stats
+    return sharded, stats
 
 
 @functools.lru_cache(maxsize=64)
@@ -294,6 +352,325 @@ def tp_run_batch(weights, xs, kind: str, mesh):
     return _localize(fn(sharded, _place(jnp.asarray(xs), rep, mesh)))
 
 
+# --- overlapped ring engine (ISSUE 17 tentpole) -----------------------------
+# The GSPMD paths above let XLA place a whole-vector all-gather before each
+# layer's GEMM: the collective and the matmul serialize, which is exactly
+# the comm/compute ratio both scaling studies blame for the reference's
+# ceiling (arXiv:1701.05130, arXiv:1810.11112).  The ring engine instead
+# walks the k activation blocks with lax.ppermute while each resident block
+# multiplies against the matching column slice of the local weight rows --
+# the classic tensor-parallel allgather/GEMM overlap: the transfer for
+# block s+1 is issued BEFORE block s's partial GEMM, so the compiler may
+# run the collective concurrently with the matmul.  The GSPMD route stays
+# as the parity oracle; ``HPNN_NO_TP_OVERLAP=1`` swaps in an explicit
+# all_gather-then-GEMM inside the SAME shard_map engine (the
+# apples-to-apples comparator MODEL_BENCH races).
+
+
+def _ring_perm(k: int):
+    """Ring schedule: device s sends its resident block to device s-1, so
+    after step s device mi holds activation block (mi + s) % k."""
+    return [(s, (s - 1) % k) for s in range(k)]
+
+
+def _ring_canon(parts, mi):
+    """Per-step results -> canonical block order: parts[s] came from block
+    j = (mi + s) % k, so canon[j] = parts[(j - mi) % k] -- a roll by the
+    (traced) model rank."""
+    return jnp.roll(jnp.stack(parts), mi, axis=0)
+
+
+def _ring_layer(h_blk, w_blk, k: int, mi, collect: bool = False):
+    """One hidden layer's pre-activation row block via the overlapped ring.
+
+    ``h_blk`` (..., c) is this device's block of the previous activation;
+    ``w_blk`` (r, k*c) its row block of the layer's weights.  Each of the
+    k steps multiplies the currently-resident activation block against the
+    matching column slice while the next block is already in flight.
+    ``collect=True`` additionally reassembles the FULL previous activation
+    (..., k*c) in canonical order -- the training engine consumes it in
+    the d^T h gradient contraction.  Returns ``(z_blk, full_or_None)``.
+    """
+    c = h_blk.shape[-1]
+    perm = _ring_perm(k)
+    blk, acc, parts = h_blk, None, []
+    for s in range(k):
+        # issue the transfer for the NEXT block before this step's GEMM so
+        # the two can overlap (program order is the only scheduling hint)
+        nxt = lax.ppermute(blk, MODEL_AXIS, perm) if s < k - 1 else None
+        j = (mi + s) % k
+        if collect:
+            parts.append(blk)
+        cols = lax.dynamic_slice_in_dim(w_blk, j * c, c, axis=1)
+        part = blk @ cols.T
+        acc = part if acc is None else acc + part
+        if nxt is not None:
+            blk = nxt
+    full = None
+    if collect:
+        canon = _ring_canon(parts, mi)
+        full = jnp.moveaxis(canon, 0, -2).reshape(*h_blk.shape[:-1], k * c)
+    return acc, full
+
+
+def _ring_out(h_blk, w_full, k: int, mi, collect: bool = False):
+    """Output layer via the ring: the head weights are REPLICATED (the
+    unpadded output layer, mesh.pad_topology never pads it), so each step
+    computes a partial (..., n_out) product against the matching column
+    slice and the k partials sum in CANONICAL block order -- every model
+    rank reduces in the same order, so the replicated output really is
+    bitwise identical across ranks (shard_map's replication check is off;
+    nothing else would enforce it).  Returns ``(z, full_prev_or_None)``."""
+    c = h_blk.shape[-1]
+    perm = _ring_perm(k)
+    blk, parts, gemms = h_blk, [], []
+    for s in range(k):
+        nxt = lax.ppermute(blk, MODEL_AXIS, perm) if s < k - 1 else None
+        j = (mi + s) % k
+        if collect:
+            parts.append(blk)
+        cols = lax.dynamic_slice_in_dim(w_full, j * c, c, axis=1)
+        gemms.append(blk @ cols.T)
+        if nxt is not None:
+            blk = nxt
+    z = jnp.sum(_ring_canon(gemms, mi), axis=0)
+    full = None
+    if collect:
+        canon = _ring_canon(parts, mi)
+        full = jnp.moveaxis(canon, 0, -2).reshape(*h_blk.shape[:-1], k * c)
+    return z, full
+
+
+class TPCarry(typing.NamedTuple):
+    """Mesh-resident engine weights: padded per-layer blocks (hidden rows
+    ``P('model', None)``, output replicated) plus the original row dims
+    needed to unpad at export time."""
+
+    blocks: tuple
+    orig: tuple
+
+
+def tp_engine_carry(weights, mesh) -> TPCarry:
+    """Pad + place weights in the ring engine's layout.  Unlike
+    ``layer_sharding`` the output layer is ALWAYS replicated (even when
+    its row count happens to divide the axis): the engine's output stage
+    contracts every device's activation block against the full head."""
+    k = mesh.shape[MODEL_AXIS]
+    padded, orig = pad_topology(weights, k)
+    rs, rep = row_sharding(mesh), replicated(mesh)
+    n = len(padded)
+    blocks = tuple(_place(w, rs if i < n - 1 else rep, mesh)
+                   for i, w in enumerate(padded))
+    return TPCarry(blocks, tuple(orig))
+
+
+@functools.lru_cache(maxsize=64)
+def _tp_eval_batch_fn(kind: str, mesh, n_layers: int, overlap: bool):
+    """Cached jitted batched TP forward through the ring engine.  Batch
+    rows shard over ``data`` (replicated on a 1xN serve mesh), weight row
+    blocks over ``model``; hidden layers run the overlapped ring (or the
+    explicit gather under ``HPNN_NO_TP_OVERLAP=1``)."""
+    k = mesh.shape[MODEL_AXIS]
+    w_specs = tuple(
+        P(MODEL_AXIS, None) if i < n_layers - 1 else P(None, None)
+        for i in range(n_layers))
+
+    def fwd(ws, xb):
+        from ..ops.activations import ann_act
+
+        mi = lax.axis_index(MODEL_AXIS)
+        if n_layers == 1:
+            return _apply_head(xb @ ws[0].T, kind)
+        h_blk = ann_act(xb @ ws[0].T)
+        for l in range(1, n_layers - 1):
+            if overlap:
+                z_blk, _ = _ring_layer(h_blk, ws[l], k, mi)
+            else:
+                full = lax.all_gather(h_blk, MODEL_AXIS, axis=1, tiled=True)
+                z_blk = full @ ws[l].T
+            h_blk = ann_act(z_blk)
+        if overlap:
+            z, _ = _ring_out(h_blk, ws[-1], k, mi)
+        else:
+            full = lax.all_gather(h_blk, MODEL_AXIS, axis=1, tiled=True)
+            z = full @ ws[-1].T
+        return _apply_head(z, kind)
+
+    f = shard_map(fwd, mesh=mesh,
+                  in_specs=(w_specs, P(DATA_AXIS, None)),
+                  out_specs=P(DATA_AXIS, None), check_vma=False)
+    return jax.jit(f)
+
+
+def tp_eval_batch(weights, xs, kind: str, mesh, overlap=None):
+    """Batched TP evaluation through the ring engine: the serve-route and
+    ``run_kernel`` entry for topologies too big to replicate.  ``weights``
+    may be raw host weights or an already-resident :class:`TPCarry` (the
+    serve registry caches one per mesh).  The batch pads up to a multiple
+    of the data axis and the output slices back; the feature dim needs no
+    slicing (the output layer is never padded).  ``overlap=None`` reads
+    the ``HPNN_NO_TP_OVERLAP`` gate."""
+    if overlap is None:
+        overlap = tp_overlap_enabled()
+    carry = (weights if isinstance(weights, TPCarry)
+             else tp_engine_carry(weights, mesh))
+    xs = jnp.asarray(xs)
+    n_data = mesh.shape[DATA_AXIS]
+    b = xs.shape[0]
+    pad = (-b) % n_data
+    if pad:
+        xs = jnp.concatenate(
+            [xs, jnp.zeros((pad,) + xs.shape[1:], xs.dtype)])
+    fn = _tp_eval_batch_fn(kind, mesh, len(carry.blocks), bool(overlap))
+    xb = _place(xs, NamedSharding(mesh, P(DATA_AXIS, None)), mesh)
+    out = fn(carry.blocks, xb)
+    return out[:b] if pad else out
+
+
+@functools.lru_cache(maxsize=64)
+def _tp_dp_epoch_fn(kind: str, momentum: bool, mesh, n_layers: int,
+                    overlap: bool, donate: bool):
+    """Cached jitted 2-D (data x model) minibatch epoch: the scan shape of
+    ``dp._dp_epoch_scan`` with every GEMM running through the ring engine
+    on row-sharded weight blocks.  Gradients allreduce over ``data`` (the
+    DP axis) and the backward ``W^T d`` reassembles over ``model`` -- the
+    composition ISSUE 17 names.  BPM momentum lives as per-layer row
+    blocks (already 1/k-sharded over model), zeroed each call -- the
+    per-epoch lifecycle ``_dp_epoch_scan`` pins."""
+    k = mesh.shape[MODEL_AXIS]
+    w_specs = tuple(
+        P(MODEL_AXIS, None) if i < n_layers - 1 else P(None, None)
+        for i in range(n_layers))
+    from ..ops.activations import ann_act, ann_dact
+
+    def engine(ws, xb, tb, mb, lr, alpha):
+        mi = lax.axis_index(MODEL_AXIS)
+        dw0 = tuple(jnp.zeros_like(w) for w in ws) if momentum else ()
+
+        def grad_of(d, h, den):
+            # mirror dp.batched_grads' discipline: contract in the native
+            # dtype, allreduce over data, divide in at-least-f32, cast
+            # back to the weight-update dtype
+            acc = jnp.promote_types(d.dtype, jnp.float32)
+            g = lax.psum(d.T @ h, DATA_AXIS)
+            return (g.astype(acc) / den).astype(d.dtype)
+
+        def step(carry, xtm):
+            ws, dws = carry
+            x, t, m = xtm
+            # forward, saving each layer's post-activation row block and
+            # the canonical full activations (backward consumes both)
+            blks, fulls = [], [x]
+            if n_layers == 1:
+                out = _apply_head(x @ ws[0].T, kind)
+            else:
+                h_blk = ann_act(x @ ws[0].T)
+                blks.append(h_blk)
+                for l in range(1, n_layers - 1):
+                    if overlap:
+                        z_blk, full = _ring_layer(h_blk, ws[l], k, mi,
+                                                  collect=True)
+                    else:
+                        full = lax.all_gather(h_blk, MODEL_AXIS, axis=1,
+                                              tiled=True)
+                        z_blk = full @ ws[l].T
+                    fulls.append(full)
+                    h_blk = ann_act(z_blk)
+                    blks.append(h_blk)
+                if overlap:
+                    z, full = _ring_out(h_blk, ws[-1], k, mi, collect=True)
+                else:
+                    full = lax.all_gather(h_blk, MODEL_AXIS, axis=1,
+                                          tiled=True)
+                    z = full @ ws[-1].T
+                fulls.append(full)
+                out = _apply_head(z, kind)
+            errs = steps.error(out, t, kind)
+            acc = jnp.promote_types(errs.dtype, jnp.float32)
+            mf = m.astype(acc)
+            den = jnp.maximum(lax.psum(jnp.sum(mf), DATA_AXIS),
+                              jnp.asarray(1.0, acc))
+            err = (lax.psum(jnp.sum(errs.astype(acc) * mf), DATA_AXIS)
+                   / den).astype(errs.dtype)
+            # output delta (ops.steps.deltas); masking it zeroes the whole
+            # backward chain for padded rows, so hidden deltas need none
+            if kind in (steps.SNN, steps.LNN):
+                d = t - out
+            else:
+                d = (t - out) * ann_dact(out)
+            d = d * m[:, None].astype(d.dtype)
+            grads = [None] * n_layers
+            grads[-1] = grad_of(d, fulls[-1], den)
+            if n_layers > 1:
+                pre = d @ ws[-1]  # replicated along model by construction
+                for l in range(n_layers - 2, -1, -1):
+                    c = blks[l].shape[-1]
+                    d_blk = (lax.dynamic_slice_in_dim(pre, mi * c, c,
+                                                      axis=1)
+                             * ann_dact(blks[l]))
+                    grads[l] = grad_of(d_blk, fulls[l], den)
+                    if l > 0:
+                        pre = lax.psum(d_blk @ ws[l], MODEL_AXIS)
+            grads = tuple(grads)
+            if momentum:
+                # reference order dw+=lr*g; W+=dw; dw*=alpha
+                # (ann.c:1996-1999), on the row blocks
+                dws = tuple(b + lr * g for b, g in zip(dws, grads))
+                ws = tuple(w + b for w, b in zip(ws, dws))
+                dws = tuple(alpha * b for b in dws)
+            else:
+                ws = tuple(w + lr * g for w, g in zip(ws, grads))
+            return (ws, dws), err
+
+        (ws, dws), errs = lax.scan(step, (ws, dw0), (xb, tb, mb))
+        return ws, dws, errs
+
+    eng = shard_map(
+        engine, mesh=mesh,
+        in_specs=(w_specs, P(None, DATA_AXIS, None),
+                  P(None, DATA_AXIS, None), P(None, DATA_AXIS), P(), P()),
+        out_specs=(w_specs, (w_specs if momentum else ()), P(None)),
+        check_vma=False)
+
+    def epoch(ws, x_res, t_res, sel, mb, lr, alpha):
+        nb, bp = mb.shape
+        xb = jnp.take(x_res, sel, axis=0).reshape(nb, bp, x_res.shape[1])
+        tb = jnp.take(t_res, sel, axis=0).reshape(nb, bp, t_res.shape[1])
+        bsh = NamedSharding(mesh, P(None, DATA_AXIS, None))
+        xb = lax.with_sharding_constraint(xb, bsh)
+        tb = lax.with_sharding_constraint(tb, bsh)
+        mb = lax.with_sharding_constraint(
+            mb, NamedSharding(mesh, P(None, DATA_AXIS)))
+        return eng(ws, xb, tb, mb, lr, alpha)
+
+    return jax.jit(epoch, donate_argnums=(0,) if donate else ())
+
+
+def tp_dp_resident_carry(weights, mesh) -> TPCarry:
+    """Hybrid-route weight carry: the engine layout on the 2-D mesh.
+    ``P('model', None)`` mentions no data axis, so the blocks replicate
+    along ``data`` by construction -- each data replica holds the same
+    1/k row shard."""
+    return tp_engine_carry(weights, mesh)
+
+
+def tp_dp_train_epoch_resident(carry, x_res, t_res, sel, mb, kind: str,
+                               momentum: bool, lr, alpha=0.2, *, mesh,
+                               overlap=None, donate=False):
+    """One zero-restage minibatch epoch on the 2-D mesh (the
+    ``[batch]`` x ``[model]`` composition).  Same contract as
+    ``dp.dp_train_epoch_resident``: resident corpus + int32 permutation
+    in, ``(carry', dw_blocks_or_None, errs)`` out; the weight carry is
+    donated launch-to-launch off-CPU."""
+    if overlap is None:
+        overlap = tp_overlap_enabled()
+    fn = _tp_dp_epoch_fn(kind, momentum, mesh, len(carry.blocks),
+                         bool(overlap),
+                         bool(donate) and jax.default_backend() != "cpu")
+    ws, dws, errs = fn(carry.blocks, x_res, t_res, sel, mb, lr, alpha)
+    return TPCarry(ws, carry.orig), (dws if momentum else None), errs
+
+
 def _pad_rows(w, k: int):
     n = w.shape[0]
     pad = (-n) % k
@@ -319,7 +696,7 @@ def tp_forward_explicit(weights, x, kind: str, mesh):
         # manifest analysis cannot see that through the [:n_real] slice
         check_vma=False)
     def run(ws, v):
-        from ..ops.activations import ann_act, snn_softmax
+        from ..ops.activations import ann_act
 
         for i, (w_block, n_real) in enumerate(zip(ws, real_ns)):
             z = w_block @ v  # local row block (N_pad/k,)
@@ -328,8 +705,8 @@ def tp_forward_explicit(weights, x, kind: str, mesh):
             # softmax denominator (an MPI_Allreduce in the reference,
             # snn.c:303) comes for free on the gathered vector
             h = lax.all_gather(z, MODEL_AXIS, tiled=True)[:n_real]
-            if kind == steps.SNN and i == n_layers - 1:
-                v = snn_softmax(h)
+            if i == n_layers - 1:
+                v = _apply_head(h, kind)
             else:
                 v = ann_act(h)
         return v
@@ -362,10 +739,10 @@ def tp_forward_colsharded(weights, x, kind: str, mesh):
         return lax.psum(w_blk @ x_blk, MODEL_AXIS)
 
     z0 = first_layer(w0, x)
-    from ..ops.activations import ann_act, snn_softmax
+    from ..ops.activations import ann_act
 
     if len(weights) == 1:  # single layer: z0 is the output pre-activation
-        return snn_softmax(z0) if kind == steps.SNN else ann_act(z0)
+        return _apply_head(z0, kind)
     return steps.forward(tuple(weights[1:]), ann_act(z0), kind)[-1]
 
 
@@ -403,12 +780,13 @@ def _colsharded_batch_fn(kind: str, mesh):
             MODEL_AXIS)
 
     def fwd(w0, rest, xs):
-        from ..ops.activations import ann_act, snn_softmax
+        from ..ops.activations import ann_act
 
         z0 = first_layer(w0, xs)
         if not rest:
-            # snn_softmax works on the last axis: batch-safe as-is
-            return snn_softmax(z0) if kind == steps.SNN else ann_act(z0)
+            # snn_softmax works on the last axis: batch-safe as-is; the
+            # LNN head is the identity (single source: _apply_head)
+            return _apply_head(z0, kind)
         return steps.batched_forward(rest, ann_act(z0), kind)
 
     return jax.jit(fwd)

@@ -337,12 +337,17 @@ class ServeMetrics:
 
     def set_model_info(self, name: str, generation: int,
                        loaded_at: float, kind: str | None = None,
-                       trainer: str | None = None) -> None:
+                       trainer: str | None = None,
+                       route: str | None = None) -> None:
         """Record a kernel's model generation + last-(re)load time, and
-        (when given) its kernel ``type`` (ANN/SNN/LNN head) + trainer
-        labels.  ``kind``/``trainer`` MERGE-RETAIN: callers that only
-        refresh the generation (the jobs scheduler's per-epoch reload
-        bookkeeping) must not wipe labels a register/reload set."""
+        (when given) its kernel ``type`` (ANN/SNN/LNN head), trainer and
+        serving ``route`` labels (``route`` is the eval engine the
+        registry picked -- "strict"/"fast", or "tp@K" when the kernel's
+        weights exceed the per-device budget and serve row-sharded over
+        a K-wide model axis, ISSUE 17).  ``kind``/``trainer``/``route``
+        MERGE-RETAIN: callers that only refresh the generation (the jobs
+        scheduler's per-epoch reload bookkeeping) must not wipe labels a
+        register/reload set."""
         with self._lock:
             info = self._model_info.get(name, {})
             info["generation"] = int(generation)
@@ -351,6 +356,8 @@ class ServeMetrics:
                 info["kind"] = str(kind)
             if trainer is not None:
                 info["trainer"] = str(trainer)
+            if route is not None:
+                info["route"] = str(route)
             self._model_info[name] = info
 
     def count_reload(self, ok: bool) -> None:
@@ -584,8 +591,9 @@ class ServeMetrics:
                 f'{{kernel="{_escape_label(name)}"}} '
                 f'{info["last_reload_ts"]}')
         lines += [
-            "# HELP hpnn_serve_model_info Kernel output-head type and "
-            "trainer (value is always 1; labels carry the facts).",
+            "# HELP hpnn_serve_model_info Kernel output-head type, "
+            "trainer and serving route (value is always 1; labels "
+            "carry the facts).",
             "# TYPE hpnn_serve_model_info gauge",
         ]
         for name, info in sorted(snap["models"].items()):
@@ -593,7 +601,8 @@ class ServeMetrics:
                 "hpnn_serve_model_info"
                 f'{{kernel="{_escape_label(name)}",'
                 f'type="{_escape_label(info.get("kind", "unknown"))}",'
-                f'trainer="{_escape_label(info.get("trainer", "none"))}"'
+                f'trainer="{_escape_label(info.get("trainer", "none"))}",'
+                f'route="{_escape_label(info.get("route", "strict"))}"'
                 "} 1")
         lines += [
             "# HELP hpnn_serve_generation_requests_total Requests "

@@ -183,6 +183,7 @@ class ServedModel:
         # weights until the purge removes them.
         self._holder: list | None = None  # [cast weights tuple]
         self._mesh_weights = {}           # mesh -> replicated device copies
+        self._tp_weights = {}             # mesh -> row-sharded TPCarry
         # --- A/B generation pinning (jobs subsystem) -------------------
         # retained PREVIOUS generations: cast device weights (pinned
         # dispatch) + host kernels (rollback), pruned to the registry's
@@ -235,6 +236,22 @@ class ServedModel:
                 rep = replicated(mesh)
                 cached = self._mesh_weights[mesh] = tuple(
                     jax.device_put(w, rep) for w in self.weights_nolock())
+            return cached
+
+    def tp_weights(self, mesh):
+        """Row-sharded :class:`parallel.TPCarry` on ``mesh`` (the
+        giant-topology route, ISSUE 17): padded + placed once per mesh
+        and kept resident -- each model rank holds 1/k of every hidden
+        layer's rows, the whole point when the full weights exceed one
+        device's budget.  Cached like :meth:`mesh_weights`; swap_kernel
+        rebuilds/evicts the carries the same way."""
+        with self._lock:
+            cached = self._tp_weights.get(mesh)
+            if cached is None:
+                from ..parallel import tp_engine_carry
+
+                cached = self._tp_weights[mesh] = tp_engine_carry(
+                    self.weights_nolock(), mesh)
             return cached
 
     def weights_nolock(self):
@@ -295,6 +312,12 @@ class ServedModel:
             mesh: tuple(jax.device_put(w, replicated(mesh)) for w in new_w)
             for mesh in list(self._mesh_weights)
         }
+        new_tp = {}
+        if self._tp_weights:
+            from ..parallel import tp_engine_carry
+
+            new_tp = {mesh: tp_engine_carry(new_w, mesh)
+                      for mesh in list(self._tp_weights)}
         with self._lock:
             old_kernel = self.nn.kernel
             self.nn.kernel = kernel
@@ -304,6 +327,7 @@ class ServedModel:
                 # in-flight work on shape-consistent old weights
                 self._holder = [new_w]
                 self._mesh_weights = new_mesh
+                self._tp_weights = new_tp
                 # old-shape generations cannot serve the new geometry
                 self._gen_weights.clear()
                 self._gen_kernels.clear()
@@ -341,6 +365,13 @@ class ServedModel:
                     del self._mesh_weights[mesh]
                 for mesh, rep in new_mesh.items():
                     self._mesh_weights[mesh] = rep
+                # same race for the TP carries: a concurrently-placed
+                # mesh still shards the OLD weights -- evict, re-place
+                for mesh in [m for m in self._tp_weights
+                             if m not in new_tp]:
+                    del self._tp_weights[mesh]
+                for mesh, carry in new_tp.items():
+                    self._tp_weights[mesh] = carry
             if changed:
                 if kernel.n_inputs != self.n_inputs:
                     self._pool = None  # scratch width no longer fits
@@ -502,7 +533,7 @@ class ModelRegistry:
 
     def __init__(self, metrics: ServeMetrics | None = None,
                  max_batch: int = 64, parity: str = "strict",
-                 fast_threshold: int = 256, mesh=None,
+                 fast_threshold: int = 256, mesh=None, tp_mesh=None,
                  ab_fraction: float = 0.0, gen_keep: int = 2):
         assert max_batch >= 1
         if not 0.0 <= float(ab_fraction) <= 1.0:
@@ -535,6 +566,13 @@ class ModelRegistry:
                     "strict (raise -b/--max-batch or lower "
                     "--fast-threshold)\n")
         self.mesh = mesh  # jax.sharding.Mesh with a "data" axis, or None
+        # giant-topology route (ISSUE 17): a mesh with a "model" axis
+        # wider than 1.  A registered kernel whose cast weights exceed
+        # the per-device budget (HPNN_EPOCH_DEVICE_BUDGET_MB) serves
+        # row-sharded over it through the ring engine -- EVERY bucket,
+        # both parities: when the weights do not fit on one device there
+        # is no replicated tier to fall back to.
+        self.tp_mesh = tp_mesh
         # A/B generation pinning policy: during a hot swap this fraction
         # of unpinned traffic keeps routing to the previous generation
         # until a promote/rollback finalizes; gen_keep bounds how many
@@ -580,13 +618,14 @@ class ModelRegistry:
                          "registered!\n")
                 return None
             self._models[name] = model
+        route = self.route_for(model)
         self.metrics.set_model_info(name, model.generation,
                                     model.loaded_at, kind=model.kind,
-                                    trainer=model.trainer)
+                                    trainer=model.trainer, route=route)
         nn_out(f"serve: registered kernel '{name}' "
                f"({'x'.join(str(p) for p in model.topology)}, "
                f"{model.dtype_name}, {model.kind}, "
-               f"parity={self.parity})\n")
+               f"parity={self.parity}, route={route})\n")
         return model
 
     def get(self, name: str) -> ServedModel | None:
@@ -623,7 +662,8 @@ class ModelRegistry:
                                        set_generation=set_generation)
         self.metrics.set_model_info(name, model.generation,
                                     model.loaded_at, kind=model.kind,
-                                    trainer=model.trainer)
+                                    trainer=model.trainer,
+                                    route=self.route_for(model))
         nn_out(f"serve: reloaded kernel '{name}' from {src} "
                f"(generation {result['generation']}"
                f"{', topology changed' if result['topology_changed'] else ''}"
@@ -645,6 +685,35 @@ class ModelRegistry:
             return sorted(self._models)
 
     # --- tier selection -------------------------------------------------
+    def tp_shards(self, model: ServedModel) -> int:
+        """Model-axis width ``model`` serves over, or 0 for the
+        replicated tiers.  TP engages only when BOTH hold: the registry
+        has a tp_mesh (HPNN_TP_DEVICES > 1 at server start) AND the
+        kernel's cast weights exceed the per-device budget
+        (``HPNN_EPOCH_DEVICE_BUDGET_MB`` -- the same knob the trainer's
+        epoch pipeline budgets corpus residency against).  A kernel that
+        fits replicates: the ring schedule's ppermute hops would be pure
+        overhead there."""
+        if self.tp_mesh is None:
+            return 0
+        from ..parallel.mesh import MODEL_AXIS
+        from ..utils.env import env_int
+
+        k = self.tp_mesh.shape[MODEL_AXIS]
+        if k <= 1:
+            return 0
+        budget = env_int("HPNN_EPOCH_DEVICE_BUDGET_MB", 4096) << 20
+        itemsize = np.dtype(model.dtype).itemsize
+        wbytes = sum(int(np.prod(w.shape)) * itemsize
+                     for w in model.nn.kernel.weights)
+        return k if wbytes > budget else 0
+
+    def route_for(self, model: ServedModel) -> str:
+        """The /metrics model-info route label: 'tp@K' when the
+        giant-topology route serves this kernel, else the parity."""
+        k = self.tp_shards(model)
+        return f"tp@{k}" if k else self.parity
+
     def tier_for(self, bucket: int) -> str:
         """Which tier a bucket dispatches through under this registry's
         policy: 'strict', 'fast', or 'fast@meshN' (sharded)."""
@@ -688,7 +757,13 @@ class ModelRegistry:
         mesh copies, and a pin is a correctness request, not a
         throughput one.
         """
-        tier = self.tier_for(bucket)
+        tpk = self.tp_shards(model)
+        # the TP route is per-MODEL (weights too big for one device),
+        # not per-bucket -- every bucket of an over-budget kernel
+        # shards, including pinned dispatch: retained generations share
+        # the topology, so a replicated fallback would not fit either
+        # (the pinned variant builds its carry per call, uncached)
+        tier = f"tp@{tpk}" if tpk else self.tier_for(bucket)
         if pinned and tier.startswith("fast@mesh"):
             tier = "fast"
         # the MODEL is part of the key: entries bind the model's device
@@ -715,7 +790,36 @@ class ModelRegistry:
             # and what keeps a topology-CHANGING swap from feeding
             # new-shape weights to an in-flight old-shape dispatch
             # (the old holder object stays with the old callables)
-            if tier.startswith("fast@mesh"):
+            if tier.startswith("tp@"):
+                # giant-topology dispatch: weight row blocks stay
+                # 1/k-sharded on the tp_mesh (parallel.TPCarry, cached
+                # per mesh like _mesh_weights); activations circulate
+                # via the ring engine.  select_run_batch hands back the
+                # schedule actually taken (tp-ring, or tp-gather under
+                # HPNN_NO_TP_OVERLAP=1)
+                run_batch_fn, path = ops.select_run_batch(
+                    model.dtype, parity=self.parity, kind=kind,
+                    model_mesh=self.tp_mesh)
+                mesh = self.tp_mesh
+                if pinned:
+                    # explicit-weights variant: shard the pinned
+                    # generation's tuple per call (same shapes -> the
+                    # jitted engine is shared with the live entry)
+                    def fn(buf, w, _fn=run_batch_fn, _k=kind):
+                        import jax.numpy as jnp
+
+                        return _fn(w, jnp.asarray(buf), _k)
+                else:
+                    model.tp_weights(mesh)  # place + cache the carry now
+                    tp_dict = model._tp_weights  # captured, see above
+
+                    def fn(buf, _mo=model, _k=kind, _m=mesh,
+                           _fn=run_batch_fn, _td=tp_dict):
+                        import jax.numpy as jnp
+
+                        w = _td.get(_m) or _mo.tp_weights(_m)
+                        return _fn(w, jnp.asarray(buf), _k)
+            elif tier.startswith("fast@mesh"):
                 from ..parallel.dp import dp_eval_batch
 
                 mesh = self.mesh

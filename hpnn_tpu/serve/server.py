@@ -335,11 +335,23 @@ class ServeApp:
             nn_warn("serve: --mesh is inert under parity=strict (the "
                     "bit-parity GEMV scan never shards); pass "
                     "--parity fast to enable sharded serving\n")
+        # giant-topology serving mesh (ISSUE 17): HPNN_TP_DEVICES > 1
+        # builds a 1xK (data x model) mesh; kernels whose weights exceed
+        # the per-device budget serve row-sharded through the ring
+        # engine (registry.tp_shards decides per kernel)
+        from ..parallel.mesh import make_mesh, tp_device_count
+
+        tpk = tp_device_count()
+        tp_mesh = make_mesh(n_data=1, n_model=tpk) if tpk > 1 else None
+        if tp_mesh is not None:
+            nn_out(f"serve: TP mesh 1x{tpk} ready (over-budget kernels "
+                   "serve row-sharded)\n")
         self.registry = ModelRegistry(metrics=self.metrics,
                                       max_batch=max_batch,
                                       parity=parity,
                                       fast_threshold=fast_threshold,
                                       mesh=mesh,
+                                      tp_mesh=tp_mesh,
                                       ab_fraction=ab_fraction)
         self.batchers: dict[str, MicroBatcher] = {}
         self.max_queue_rows = int(max_queue_rows)

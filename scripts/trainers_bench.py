@@ -66,7 +66,8 @@ def _write_corpus(dirpath: str, rng) -> None:
             fp.write(" ".join(f"{v:.1f}" for v in t) + "\n")
 
 
-def _conf_text(nn_type: str, trainer: str, sample_dir: str) -> str:
+def _conf_text(nn_type: str, trainer: str, sample_dir: str,
+               extra: str = "") -> str:
     train = {"bp": "BP", "bpm": "BPM", "cg": "CG"}[trainer]
     text = (f"[name] race\n[type] {nn_type}\n[init] generate\n"
             f"[seed] {SEED}\n"
@@ -76,6 +77,7 @@ def _conf_text(nn_type: str, trainer: str, sample_dir: str) -> str:
         text += "[trainer] cg\n"
     if nn_type == "LNN":
         text += "[lnn] native\n"
+    text += extra
     text += f"[sample_dir] {sample_dir}\n[test_dir] {sample_dir}\n"
     return text
 
@@ -96,36 +98,89 @@ def _corpus_error(neural, xs, ts) -> float:
 
 
 def run_cell(nn_type: str, trainer: str, sample_dir: str, xs, ts,
-             epochs_cap: int, workdir: str) -> dict:
+             epochs_cap: int, workdir: str, extra_conf: str = "",
+             env: dict | None = None, tag: str = "") -> dict:
     from hpnn_tpu import api
     from hpnn_tpu.utils import nn_log
 
-    conf_path = os.path.join(workdir, f"{nn_type}_{trainer}.conf")
+    conf_path = os.path.join(workdir, f"{nn_type}_{trainer}{tag}.conf")
     with open(conf_path, "w") as fp:
-        fp.write(_conf_text(nn_type, trainer, sample_dir))
-    nn_log.set_verbosity(0)  # the trajectory IS the output
-    neural = api.configure(conf_path)
-    if neural is None:
-        return {"error": "configure failed"}
-    init_error = _corpus_error(neural, xs, ts)
-    errors: list[float] = []
-    walls: list[float] = []
-    wall = 0.0
-    for epoch in range(1, epochs_cap + 1):
-        t0 = time.perf_counter()
-        ok = api.train_kernel(neural)
-        wall += time.perf_counter() - t0
-        if not ok:
-            return {"error": f"train_kernel failed at epoch {epoch}",
-                    "init_error": init_error, "errors": errors}
-        errors.append(round(_corpus_error(neural, xs, ts), 10))
-        walls.append(round(wall, 4))
+        fp.write(_conf_text(nn_type, trainer, sample_dir, extra_conf))
+    old_env = {}
+    for k, v in (env or {}).items():
+        old_env[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        nn_log.set_verbosity(0)  # the trajectory IS the output
+        neural = api.configure(conf_path)
+        if neural is None:
+            return {"error": "configure failed"}
+        init_error = _corpus_error(neural, xs, ts)
+        errors: list[float] = []
+        walls: list[float] = []
+        wall = 0.0
+        for epoch in range(1, epochs_cap + 1):
+            t0 = time.perf_counter()
+            ok = api.train_kernel(neural)
+            wall += time.perf_counter() - t0
+            if not ok:
+                return {"error": f"train_kernel failed at epoch {epoch}",
+                        "init_error": init_error, "errors": errors}
+            errors.append(round(_corpus_error(neural, xs, ts), 10))
+            walls.append(round(wall, 4))
+    finally:
+        for k, v in old_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
     return {
         "init_error": round(init_error, 10),
         "errors": errors,             # error-vs-wall trajectory:
         "wall_s": walls,              # errors[k] reached at wall_s[k]
         "final_error": errors[-1],
     }
+
+
+def run_meshed_cg(sample_dir: str, xs, ts, epochs_cap: int,
+                  workdir: str, n_dev: int) -> dict:
+    """The ``[batch]``-route CG row on an ACTUAL multi-device mesh: with
+    ``[batch]`` set and ``HPNN_DP_DEVICES=n_dev`` the flat CG state
+    (direction / prior gradient / weights) shards ``P("data")`` over the
+    data axis (the PR-12 layout, ``train/cg.py``) instead of living
+    replicated.  Sharding the state is a value-preserving relayout, so
+    the floor is PARITY: the meshed trajectory must match the
+    single-device run of the same cell, epoch by epoch, and the mesh
+    must really have been multi-device -- a row that silently fell back
+    to one device is a miss, not a pass."""
+    import jax
+
+    avail = jax.device_count()
+    extra = f"[batch] {N_SAMP}\n"
+    meshed = run_cell("ANN", "cg", sample_dir, xs, ts, epochs_cap,
+                      workdir, extra_conf=extra,
+                      env={"HPNN_DP_DEVICES": str(n_dev)}, tag="_mesh")
+    single = run_cell("ANN", "cg", sample_dir, xs, ts, epochs_cap,
+                      workdir, extra_conf=extra,
+                      env={"HPNN_DP_DEVICES": "1"}, tag="_1dev")
+    section: dict = {
+        "devices_visible": avail,
+        "dp_devices": min(n_dev, avail),
+        "meshed": meshed,
+        "single_device": single,
+    }
+    if meshed.get("error") or single.get("error"):
+        section["ok"] = False
+        return section
+    diffs = [abs(a - b) for a, b in zip(meshed["errors"],
+                                        single["errors"])]
+    section["traj_max_abs_diff"] = max(diffs) if diffs else None
+    section["parity_tol"] = 1e-9
+    section["ok"] = (section["dp_devices"] >= 2
+                     and len(diffs) == epochs_cap
+                     and section["traj_max_abs_diff"] <= 1e-9
+                     and meshed["final_error"] < meshed["init_error"])
+    return section
 
 
 def _score_row(row: dict, target_frac: float) -> None:
@@ -169,9 +224,19 @@ def main() -> int:
     ap.add_argument("--target-frac", type=float, default=0.05,
                     help="target = this fraction of the initial corpus "
                     "error (default 0.05)")
+    ap.add_argument("--mesh-devices", type=int, default=8,
+                    help="data-axis width for the meshed [batch]-route "
+                    "CG row (default 8; CPU hosts get virtual devices)")
     args = ap.parse_args()
 
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the meshed CG row needs a real multi-device grid: on a CPU host,
+    # virtual devices -- set BEFORE jax initializes
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count="
+            f"{args.mesh_devices}").strip()
     import jax
 
     jax.config.update("jax_enable_x64", True)
@@ -199,6 +264,12 @@ def main() -> int:
                     cell = {"error": f"{type(exc).__name__}: {exc}"}
                 grid[nn_type][trainer] = cell
             _score_row(grid[nn_type], args.target_frac)
+        try:
+            meshed_cg = run_meshed_cg(sample_dir, xs, ts, args.epochs,
+                                      tmp, args.mesh_devices)
+        except Exception as exc:  # noqa: BLE001 -- honesty rule
+            meshed_cg = {"error": f"{type(exc).__name__}: {exc}",
+                         "ok": False}
 
     winners = {t: _winner(grid[t]) for t in TYPES}
     # the floor: CG strictly beats BP on epochs-to-target somewhere
@@ -223,10 +294,13 @@ def main() -> int:
         "target_frac": args.target_frac,
         "grid": grid,
         "winners": winners,
+        "meshed_cg": meshed_cg,
         "floors": {
             "cg_beats_bp_cells": cg_beats_bp,
             "cell_errors": cell_errors,
-            "ok": bool(cg_beats_bp) and not cell_errors,
+            "meshed_cg_ok": bool(meshed_cg.get("ok")),
+            "ok": (bool(cg_beats_bp) and not cell_errors
+                   and bool(meshed_cg.get("ok"))),
         },
         "wall_s_total": round(time.perf_counter() - t_run, 3),
     }

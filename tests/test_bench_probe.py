@@ -316,3 +316,52 @@ def test_committed_trainers_bench_rows_hold_floors():
     assert lnn_cg["epochs_to_target"] is not None
     assert lnn_cg["final_error"] < lnn_cg["init_error"]
     assert lnn_cg["final_error"] * 100 <= grid["LNN"]["bp"]["final_error"]
+
+
+def test_committed_model_bench_rows_hold_floors():
+    """The committed MODEL_BENCH.json (make model-bench, ISSUE 17) stays
+    pinned in tier 1: both meshes (1-D model, 2-D data x model) ran the
+    ring engines, the overlapped schedule regressed nowhere (>= 0.95x
+    gather) and won somewhere (>= 1.0x), the two schedules agree to the
+    f64 envelope, per-layer comm fractions were measured, and the
+    sharded carry really holds a fraction of the replicated bytes."""
+    art = _load_artifact("MODEL_BENCH.json")
+    floors = art["floors"]
+    assert floors["ok"] is True
+    assert floors["errors"] == []
+    assert floors["overlap_ratio_min"] >= 0.95
+    assert floors["overlap_ratio_max"] >= 1.0
+    meshes = art["meshes"]
+    assert "model_1d" in meshes
+    assert any(k.startswith("hybrid_2d") for k in meshes)
+    for row in meshes.values():
+        assert "error" not in row
+        assert row["eval"]["overlap_rows_per_s"] > 0
+        assert row["train"]["overlap_samples_per_s"] > 0
+        assert row["eval"]["schedules_max_abs_diff"] <= 1e-9
+        fracs = [r["comm_fraction"]
+                 for r in row["comm_fraction_per_layer"]]
+        assert fracs and all(0.0 <= f < 1.0 for f in fracs)
+        assert row["weight_bytes_per_device"] \
+            <= 0.6 * row["weight_bytes_replicated"]
+    # the 2-D grid really composed both axes
+    grid_2d = next(v["grid"] for k, v in meshes.items()
+                   if k.startswith("hybrid_2d"))
+    assert grid_2d[0] > 1 and grid_2d[1] > 1
+
+
+def test_committed_trainers_bench_meshed_cg_row_holds_floors():
+    """The committed TRAINERS_BENCH.json meshed_cg row (ISSUE 17) stays
+    pinned in tier 1: the [batch]-route CG trainer ran on an ACTUAL
+    multi-device mesh (flat CG state sharded P("data"), PR-12 layout),
+    its trajectory matched the single-device run epoch for epoch, and
+    it really trained (final < init)."""
+    art = _load_artifact("TRAINERS_BENCH.json")
+    assert art["floors"]["meshed_cg_ok"] is True
+    m = art["meshed_cg"]
+    assert m["ok"] is True
+    assert m["dp_devices"] >= 2
+    assert m["traj_max_abs_diff"] <= m["parity_tol"] <= 1e-9
+    meshed, single = m["meshed"], m["single_device"]
+    assert len(meshed["errors"]) == len(single["errors"]) >= 1
+    assert meshed["final_error"] < meshed["init_error"]

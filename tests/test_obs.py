@@ -417,8 +417,8 @@ def test_prometheus_exposition_lint_populated():
     # retained across a label-less generation refresh
     info_labels = [dict(labels) for name, labels in series
                    if name == "hpnn_serve_model_info"]
-    assert {"kernel": "tiny", "type": "SNN", "trainer": "bp"} \
-        in info_labels
+    assert {"kernel": "tiny", "type": "SNN", "trainer": "bp",
+            "route": "strict"} in info_labels
     assert any(d["type"] == "LNN" and d["trainer"] == "cg"
                for d in info_labels)
     # the hostile kernel name survived escaping and re-parses exactly

@@ -1,0 +1,388 @@
+"""Giant-topology tensor parallelism (ISSUE 17): parity pins + units.
+
+The contract stack, strongest first:
+
+* Pipeline == restage, BYTE for byte: a multi-epoch ``[model]`` (pure
+  TP) or ``[batch]`` x ``[model]`` (hybrid 2-D) run's console stream
+  (-vv, stdout AND stderr) and ``kernel.opt`` are identical with the
+  device-resident epoch pipeline on vs ``HPNN_NO_EPOCH_PIPELINE=1`` --
+  on the forced 8-device CPU mesh, for BP and BPM, and across a
+  kill-at-epoch-k ``--resume`` (the sharded row-block carry restores
+  exactly from the snapshot's f64 weights).
+* Overlap vs gather: the lax.ppermute ring schedule and the explicit
+  ``HPNN_NO_TP_OVERLAP=1`` all-gather oracle associate the contraction
+  differently -- k partial sums in canonical block order vs one full
+  GEMM -- so they agree to a dtype-ULP envelope, not bitwise (at k=8
+  the 8-6-3 net already shows 1-ULP flips; MODEL_BENCH.json pins the
+  production-width envelope, see test_bench_probe).  Each schedule IS
+  bitwise-replicated across ranks, which is what the serve/export
+  contracts need.
+* The row-sharded engines track the replicated single-device engines
+  inside the repo's established envelopes (1e-12 f64 / bf16-ULP), for
+  every {ANN, SNN, LNN} x {BP, BPM} x {f64, bf16} x {1-D, 2-D mesh}
+  cell the route serves.
+* Over-budget topologies TRAIN and SERVE: with
+  ``HPNN_EPOCH_DEVICE_BUDGET_MB`` forced tiny, the serve registry
+  routes the kernel to the ``tp@K`` tier (budget-gated per model, not
+  per bucket) and the answers match the replicated strict tier.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import hpnn_tpu.api as api
+from hpnn_tpu import cli, ops
+from hpnn_tpu.io import samples
+from hpnn_tpu.models.kernel import generate_kernel
+from hpnn_tpu.parallel import (
+    make_mesh,
+    tp_dp_resident_carry,
+    tp_dp_train_epoch_resident,
+    tp_engine_carry,
+    tp_eval_batch,
+    tp_export_weights,
+)
+from hpnn_tpu.parallel.dp import dp_resident_carry, dp_train_epoch_resident
+from hpnn_tpu.parallel.mesh import batch_sharding
+from hpnn_tpu.utils import nn_log
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+N_SAMP = 9
+
+
+# --- unit tier: the ring engine against the replicated engines -------------
+
+def _problem(seed, s=12, dtype=jnp.float64, kind="ANN"):
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    ws = tuple(jnp.asarray(w, dtype) for w in kern.weights)
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(-1, 1, (s, N_IN))
+    if kind == "LNN":
+        ts = rng.uniform(-1, 1, (s, N_OUT))
+    else:
+        ts = -np.ones((s, N_OUT))
+        ts[np.arange(s), rng.integers(0, N_OUT, s)] = 1.0
+    return ws, xs, ts
+
+
+def _geometry(s, bsz, n_data):
+    n_batches = -(-s // bsz)
+    bsz_pad = -(-bsz // n_data) * n_data
+    pos = (np.arange(s) // bsz) * bsz_pad + np.arange(s) % bsz
+    sel = np.zeros(n_batches * bsz_pad, np.int32)
+    sel[pos] = np.arange(s, dtype=np.int32)
+    mask = np.zeros((n_batches, bsz_pad))
+    mask.reshape(-1)[pos] = 1.0
+    return n_batches, bsz_pad, sel, mask
+
+
+def _resident(xs, ts, mesh, dtype):
+    n_data = mesh.shape["data"] if mesh is not None else 1
+    pad = (-xs.shape[0]) % n_data
+    if pad:
+        xs = np.concatenate([xs, np.zeros((pad, xs.shape[1]))])
+        ts = np.concatenate([ts, np.zeros((pad, ts.shape[1]))])
+    x = jnp.asarray(xs, dtype)
+    t = jnp.asarray(ts, dtype)
+    if mesh is not None:
+        bs = batch_sharding(mesh)
+        x, t = jax.device_put(x, bs), jax.device_put(t, bs)
+    return x, t
+
+
+MESH_GRIDS = [(1, 8), (4, 2)]          # 1-D model-only and 2-D data x model
+KINDS = ["ANN", "SNN", "LNN"]
+DTYPES = [jnp.float64, jnp.bfloat16]
+
+
+@pytest.mark.parametrize("grid", MESH_GRIDS, ids=["1d", "2d"])
+@pytest.mark.parametrize("dtype", DTYPES, ids=["f64", "bf16"])
+@pytest.mark.parametrize("kind", KINDS)
+def test_eval_ring_matches_gather_and_replicated(kind, dtype, grid):
+    """tp_eval_batch: overlapped ring vs explicit-gather oracle inside
+    the dtype-ULP envelope (contraction association differs, module
+    doc), and both track the replicated run_batch.  The batch (12 rows)
+    pads to the data axis and slices back."""
+    ws, xs, _ = _problem(11, dtype=dtype, kind=kind)
+    mesh = make_mesh(n_data=grid[0], n_model=grid[1])
+    carry = tp_engine_carry(ws, mesh)
+    ring = np.asarray(tp_eval_batch(carry, jnp.asarray(xs, dtype), kind,
+                                    mesh, overlap=True), np.float64)
+    gath = np.asarray(tp_eval_batch(carry, jnp.asarray(xs, dtype), kind,
+                                    mesh, overlap=False), np.float64)
+    atol = 1e-13 if dtype == jnp.float64 else 2 ** -6
+    np.testing.assert_allclose(ring, gath, atol=atol)
+    ref = np.asarray(ops.run_batch(ws, jnp.asarray(xs, dtype), kind),
+                     np.float64)
+    atol = 1e-12 if dtype == jnp.float64 else 2 ** -6
+    np.testing.assert_allclose(ring, ref, atol=atol)
+    assert ring.shape == (xs.shape[0], N_OUT)
+
+
+@pytest.mark.parametrize("grid", MESH_GRIDS, ids=["1d", "2d"])
+@pytest.mark.parametrize("dtype", DTYPES, ids=["f64", "bf16"])
+@pytest.mark.parametrize("momentum", [False, True], ids=["bp", "bpm"])
+@pytest.mark.parametrize("kind", KINDS)
+def test_train_grid_sharded_vs_single_device(kind, momentum, dtype, grid):
+    """The ISSUE 17 acceptance grid: every {ANN,SNN,LNN} x {BP,BPM} x
+    {f64,bf16} x {1-D,2-D} cell of the 2-D minibatch engine tracks the
+    replicated single-device engine inside the repo's DP envelope
+    (1e-12 f64, bf16-ULP for bf16 -- bitwise across device counts is
+    not available on this backend, see test_dp_pipeline)."""
+    ws, xs, ts = _problem(13, dtype=dtype, kind=kind)
+    s, bsz = xs.shape[0], 5
+    mesh = make_mesh(n_data=grid[0], n_model=grid[1])
+    nb, bp, sel, mask = _geometry(s, bsz, grid[0])
+    mb = jnp.asarray(mask, dtype)
+    x_res, t_res = _resident(xs, ts, mesh, dtype)
+    carry = tp_dp_resident_carry(ws, mesh)
+    carry2, dw, errs = tp_dp_train_epoch_resident(
+        carry, x_res, t_res, jnp.asarray(sel), mb, kind, momentum, 0.01,
+        alpha=0.2, mesh=mesh)
+    w_tp = tp_export_weights(carry2.blocks, carry2.orig, mesh)
+    x1, t1 = _resident(xs, ts, None, dtype)
+    w1, _, e1 = dp_train_epoch_resident(
+        dp_resident_carry(ws, None, False), x1, t1, jnp.asarray(sel),
+        mb, kind, momentum, 0.01, alpha=0.2, mesh=None)
+    atol = 1e-12 if dtype == jnp.float64 else 2 ** -6
+    np.testing.assert_allclose(np.asarray(errs, np.float64),
+                               np.asarray(e1, np.float64), atol=atol)
+    for a, b in zip(w_tp, w1):
+        np.testing.assert_allclose(np.asarray(a, np.float64),
+                                   np.asarray(b, np.float64), atol=atol)
+    if momentum:
+        assert dw is not None
+
+
+def test_train_ring_matches_gather_oracle_bitwise():
+    """The 2-D train engine under the ring schedule == the explicit
+    gather oracle, bitwise, at these block widths -- the overlap is a
+    pure reschedule of the same contractions."""
+    ws, xs, ts = _problem(17)
+    s, bsz = xs.shape[0], 5
+    mesh = make_mesh(n_data=4, n_model=2)
+    nb, bp, sel, mask = _geometry(s, bsz, 4)
+    mb = jnp.asarray(mask)
+    x_res, t_res = _resident(xs, ts, mesh, jnp.float64)
+    outs = {}
+    for ov in (True, False):
+        c, _, errs = tp_dp_train_epoch_resident(
+            tp_dp_resident_carry(ws, mesh), x_res, t_res,
+            jnp.asarray(sel), mb, "ANN", True, 0.01, alpha=0.2,
+            mesh=mesh, overlap=ov)
+        outs[ov] = (tp_export_weights(c.blocks, c.orig, mesh),
+                    np.asarray(errs))
+    np.testing.assert_array_equal(outs[True][1], outs[False][1])
+    for a, b in zip(outs[True][0], outs[False][0]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_engine_carry_layout():
+    """Hidden rows live 1/k per device along ``model``; the output head
+    is ALWAYS replicated (the engine's output stage contracts every
+    block against the full head) and never padded."""
+    ws, _, _ = _problem(19)
+    mesh = make_mesh(n_data=1, n_model=8)
+    carry = tp_engine_carry(ws, mesh)
+    assert carry.blocks[0].shape[0] % 8 == 0
+    specs = [c.sharding.spec for c in carry.blocks]
+    assert specs[0][0] == "model"
+    assert all(ax is None for ax in specs[-1])   # head fully replicated
+    assert carry.blocks[-1].shape[0] == N_OUT        # head unpadded
+    out = tp_export_weights(carry.blocks, carry.orig, mesh)
+    for a, b in zip(out, ws):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# --- CLI tier: byte parity through the real driver -------------------------
+
+def _write_corpus(dirpath, rng, n, with_skips=True):
+    os.makedirs(dirpath, exist_ok=True)
+    for i in range(n):
+        cls = i % N_OUT
+        x = rng.uniform(-1, 1, N_IN)
+        x[cls] += 2.0
+        t = -np.ones(N_OUT)
+        t[cls] = 1.0
+        with open(os.path.join(dirpath, f"s{i:03d}"), "w") as fp:
+            fp.write(f"[input] {N_IN}\n"
+                     + " ".join(f"{v:7.5f}" for v in x) + "\n"
+                     + f"[output] {N_OUT}\n"
+                     + " ".join(f"{v:.1f}" for v in t) + "\n")
+
+
+@pytest.fixture()
+def corpus_dir(tmp_path, monkeypatch):
+    rng = np.random.default_rng(7)
+    _write_corpus(str(tmp_path / "samples"), rng, N_SAMP)
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.setattr(samples, "_native_warned", True)
+    yield tmp_path
+    nn_log.set_verbosity(0)
+
+
+def _conf(tmp_path, train="BP", extra="[model] 4\n", name="nn"):
+    path = tmp_path / f"{name}_{train}.conf"
+    path.write_text(
+        f"[name] tiny\n[type] ANN\n[init] generate\n[seed] 1234\n"
+        f"[input] {N_IN}\n[hidden] {N_HID}\n[output] {N_OUT}\n"
+        f"[train] {train}\n{extra}"
+        f"[sample_dir] {tmp_path}/samples\n")
+    return str(path)
+
+
+def _train(args, capsys, env=None):
+    nn_log.set_verbosity(0)
+    old = {}
+    for k, v in (env or {}).items():
+        old[k] = os.environ.get(k)
+        os.environ[k] = v
+    try:
+        rc = cli.train_nn_main(["-vv", *args])
+    finally:
+        for k, v in old.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    cap = capsys.readouterr()
+    opt = b""
+    if os.path.exists("kernel.opt"):
+        with open("kernel.opt", "rb") as fp:
+            opt = fp.read()
+    return rc, cap.out, cap.err, opt
+
+
+@pytest.mark.parametrize("train", ["BP", "BPM"])
+def test_tp_multi_epoch_byte_parity_on_off(corpus_dir, capsys, train):
+    """The pure-TP acceptance pin: ``[model]`` resident epochs on the
+    8-device mesh == the restaging route, byte for byte (stream AND
+    kernel.opt), for BP and BPM."""
+    conf = _conf(corpus_dir, train=train)
+    args = ["--epochs=3", conf]
+    base = _train(args, capsys, env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert base[0] == 0
+    on = _train(args, capsys)
+    assert on[0] == 0
+    assert on[1] == base[1], "stdout diverges"
+    assert on[2] == base[2], "stderr diverges"
+    assert on[3] == base[3], "kernel.opt diverges"
+
+
+def test_hybrid_byte_parity_and_metrics(corpus_dir, capsys):
+    """The 2-D composition pin: ``[batch] 4`` x ``[model] 2`` rides the
+    same resident pipeline byte for byte, and the epoch metrics name
+    the hybrid mode with both axis extents."""
+    conf = _conf(corpus_dir, train="BPM", extra="[batch] 4\n[model] 2\n")
+    args = ["--epochs=3", conf]
+    base = _train(args, capsys, env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert base[0] == 0
+    api.reset_epoch_metrics()
+    on = _train(args, capsys)
+    assert on[0] == 0
+    assert on[1] == base[1] and on[2] == base[2] and on[3] == base[3]
+    m = dict(api.EPOCH_METRICS)
+    assert m["mode"] == "dp-tp-resident"
+    assert m["tp_devices"] == 2 and m["dp_devices"] == 4
+
+
+def test_tp_pipeline_metrics_and_sharded_bytes(corpus_dir, capsys):
+    conf = _conf(corpus_dir, train="BPM")
+    api.reset_epoch_metrics()
+    rc, *_ = _train(["--epochs=2", conf], capsys)
+    assert rc == 0
+    m = dict(api.EPOCH_METRICS)
+    assert m["mode"] == "tp-resident"
+    assert m["tp_devices"] == 4
+    assert m["weight_bytes_per_device"] > 0
+
+
+def test_tp_kill_resume_restores_sharded_carry(corpus_dir, capsys):
+    """TP pipeline killed-and-resumed == TP restage uninterrupted, byte
+    for byte: the snapshot join gathers the row blocks once and the f64
+    weights rebuild the sharded carry exactly on --resume."""
+    conf = _conf(corpus_dir, train="BPM")
+    os.makedirs("off")
+    os.chdir("off")
+    rc, o_off, _, k_off = _train(
+        ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf], capsys,
+        env={"HPNN_NO_EPOCH_PIPELINE": "1"})
+    assert rc == 0
+    os.chdir("..")
+    os.makedirs("part")
+    os.chdir("part")
+    rc, o_kill, _, _ = _train(
+        ["--epochs=3", "--ckpt-every=1", "--ckpt-dir=ck", conf], capsys,
+        env={"HPNN_CKPT_KILL_AT_EPOCH": "1"})
+    assert rc == 0
+    assert "CKPT: interrupted at epoch 1/3" in o_kill
+    rc, o_res, _, k_res = _train(
+        ["--epochs=3", "--resume", "--ckpt-dir=ck", conf], capsys)
+    assert rc == 0
+    os.chdir("..")
+    assert k_res == k_off
+    mark = "NN: EPOCH        2/       3\n"
+    assert o_res[o_res.index(mark):] == o_off[o_off.index(mark):]
+
+
+def test_model_parallel_flag_equals_conf_keyword(corpus_dir, capsys):
+    """``--model-parallel=4`` is the ``[model] 4`` conf keyword --
+    identical kernel.opt from either spelling."""
+    c_plain = _conf(corpus_dir, extra="", name="plain")
+    c_model = _conf(corpus_dir, name="model")
+    a = _train(["--epochs=2", "--model-parallel=4", c_plain], capsys)
+    b = _train(["--epochs=2", c_model], capsys)
+    assert a[0] == 0 and b[0] == 0
+    assert a[3] == b[3], "--model-parallel != [model] kernel.opt"
+
+
+# --- acceptance drive: over-budget topology trains AND serves --------------
+
+def test_over_budget_topology_trains_and_serves(corpus_dir, capsys,
+                                                monkeypatch):
+    """The ISSUE 17 acceptance drive on the 8-device CPU mesh: with the
+    per-device budget forced to zero every kernel is 'too big to
+    replicate' -- the [model] route trains it, the serve registry
+    routes it to the ``tp@4`` tier (budget-gated per MODEL), the
+    sharded answers match the replicated strict tier, and the route
+    lands on the /metrics model_info line."""
+    from hpnn_tpu.serve.registry import ModelRegistry
+
+    conf = _conf(corpus_dir, train="BPM", extra="[model] 2\n")
+    rc, *_ = _train(["--epochs=2", conf], capsys)
+    assert rc == 0                      # over-budget topology TRAINS
+
+    monkeypatch.setenv("HPNN_EPOCH_DEVICE_BUDGET_MB", "0")
+    tp_mesh = make_mesh(n_data=1, n_model=4)
+    reg_tp = ModelRegistry(max_batch=16, tp_mesh=tp_mesh)
+    m = reg_tp.register_conf(conf, name="tiny")
+    assert m is not None
+    assert reg_tp.tp_shards(m) == 4
+    assert reg_tp.route_for(m) == "tp@4"
+
+    reg_plain = ModelRegistry(max_batch=16)
+    m2 = reg_plain.register_conf(conf, name="tiny")
+    assert reg_plain.tp_shards(m2) == 0
+    assert reg_plain.route_for(m2) == "strict"
+
+    rng = np.random.default_rng(3)
+    xs = rng.uniform(-1, 1, (5, N_IN))
+    h = reg_tp.dispatch(m, xs)
+    assert h.tier == "tp@4"
+    out_tp = np.asarray(reg_tp.collect(h), np.float64)
+    out_strict = np.asarray(reg_plain.forward(m2, xs), np.float64)
+    np.testing.assert_allclose(out_tp, out_strict, rtol=1e-12,
+                               atol=1e-12)
+
+    # the budget gate is per model: a sane budget keeps the strict tier
+    monkeypatch.setenv("HPNN_EPOCH_DEVICE_BUDGET_MB", "4096")
+    reg3 = ModelRegistry(max_batch=16, tp_mesh=tp_mesh)
+    m3 = reg3.register_conf(conf, name="tiny")
+    assert reg3.tp_shards(m3) == 0 and reg3.route_for(m3) == "strict"
+
+    assert 'route="tp@4"' in reg_tp.metrics.render_prometheus()
