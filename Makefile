@@ -180,6 +180,19 @@ dp-epoch-bench:
 	python scripts/epoch_bench.py --dp 256 --rows 10000 \
 	    --out EPOCH_BENCH.json $(if $(REAL),--real)
 
+# cross-host zero-restage rows (ISSUE 18): TWO real coordinated CPU
+# processes (gloo collectives) -- per-host resident row-range shards vs
+# per-epoch restage (floor: restage moves >=100x the bytes per epoch,
+# byte-identical kernels), the snapshot barrier's wall cost, and a
+# kill-one-rank + coordinated --resume byte-exactness drill.  Merges a
+# "multi_process" section into EPOCH_BENCH.json (other sections
+# preserved); rc!=0 when a floor misses.  tests/test_bench_probe.py
+# holds the committed artifact to the same floors in `make check` tier 1
+dp-host-bench:
+	python scripts/epoch_bench.py --hosts 2 --dp 250 --rows 2000 \
+	    --n-in 64 --hidden 32 --n-out 8 --epochs 3 \
+	    --out EPOCH_BENCH.json
+
 # batched-tile epoch MFU sweep (ISSUE 6): {tile} x {storage} x {route}
 # cells + per-sample baseline + convergence-trajectory envelope; emits
 # MFU_BENCH.json, rc!=0 when the winner misses the >=5x-over-r05 floor.
@@ -250,6 +263,7 @@ obs-bench:
 
 .PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
     ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
-    serve-bench io-bench epoch-bench dp-epoch-bench mfu-bench \
+    serve-bench io-bench epoch-bench dp-epoch-bench dp-host-bench \
+    mfu-bench \
     mesh-bench autoscale-check trace-check lnn-check trainers-bench \
     model-bench tp-check

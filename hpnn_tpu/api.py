@@ -498,15 +498,22 @@ class _EpochPipeline:
         from .obs import trace as obs_trace
         from .utils.env import env_int
 
+        import jax
+
         names = list_sample_dir(conf.samples)
         if not names:
             return None
         t0 = time.perf_counter()
+        procs = jax.process_count()
         with obs_trace.span("corpus_load", samples=conf.samples,
                             files=len(names)):
+            # multi-process: keep the rows pack-backed (memmap) so the
+            # per-rank shard feeds below touch only this host's row
+            # range -- no rank materializes the full corpus
             rc = corpus_io.load_resident(conf.samples, names,
                                          nn.kernel.n_inputs,
-                                         nn.kernel.n_outputs)
+                                         nn.kernel.n_outputs,
+                                         prefer_mmap=procs > 1)
         if rc is None or rc.n_rows == 0:
             return None
         dtype = _dtype_of(conf)
@@ -588,22 +595,55 @@ class _EpochPipeline:
                 # divides; the permutation indexes real rows only, so
                 # the padding is never gathered
                 pad = (-rc.n_rows) % n_data
-                X, T = rc.X, rc.T
-                if pad:
-                    X = np.concatenate(
-                        [X, np.zeros((pad, X.shape[1]), X.dtype)])
-                    T = np.concatenate(
-                        [T, np.zeros((pad, T.shape[1]), T.dtype)])
+                total = rc.n_rows + pad
                 bs = batch_sharding(mesh)
-                pipe.x_dev = jax.device_put(jnp.asarray(X, dtype=dtype),
-                                            bs)
-                pipe.t_dev = jax.device_put(jnp.asarray(T, dtype=dtype),
-                                            bs)
+                if procs > 1:
+                    # ISSUE 18: each rank feeds ONLY the row ranges its
+                    # addressable devices own, sliced straight out of
+                    # the pack memmap -- the corpus uploads once per
+                    # host per run and no host ever holds a full copy
+                    def _shard_feed(which):
+                        def cb(idx):
+                            rows = idx[0]
+                            lo = rows.start or 0
+                            hi = total if rows.stop is None \
+                                else rows.stop
+                            block = rc.padded_row_block(which, lo, hi,
+                                                        total)
+                            # cast exactly like the restage stager
+                            # (elementwise, so gather/cast order and
+                            # block boundaries cannot change bytes)
+                            return np.asarray(
+                                jnp.asarray(block, dtype=dtype))
+                        return cb
+
+                    pipe.x_dev = jax.make_array_from_callback(
+                        (total, rc.X.shape[1]), bs, _shard_feed("x"))
+                    pipe.t_dev = jax.make_array_from_callback(
+                        (total, rc.T.shape[1]), bs, _shard_feed("t"))
+                else:
+                    X, T = rc.X, rc.T
+                    if pad:
+                        X = np.concatenate(
+                            [X, np.zeros((pad, X.shape[1]), X.dtype)])
+                        T = np.concatenate(
+                            [T, np.zeros((pad, T.shape[1]), T.dtype)])
+                    pipe.x_dev = jax.device_put(
+                        jnp.asarray(X, dtype=dtype), bs)
+                    pipe.t_dev = jax.device_put(
+                        jnp.asarray(T, dtype=dtype), bs)
             else:
                 pipe.x_dev = jnp.asarray(rc.X, dtype=dtype)
                 pipe.t_dev = jnp.asarray(rc.T, dtype=dtype)
-            EPOCH_METRICS["setup_h2d_bytes"] += (pipe.x_dev.nbytes
-                                                 + pipe.t_dev.nbytes)
+            if procs > 1:
+                # count THIS host's upload, not the global array size
+                EPOCH_METRICS["setup_h2d_bytes"] += sum(
+                    sh.data.nbytes
+                    for arr in (pipe.x_dev, pipe.t_dev)
+                    for sh in arr.addressable_shards)
+            else:
+                EPOCH_METRICS["setup_h2d_bytes"] += (pipe.x_dev.nbytes
+                                                     + pipe.t_dev.nbytes)
             # nothing reads the host rows again on this route (events
             # come from names/status) -- drop the float64 copy instead
             # of keeping ~2x the corpus in RSS for the whole run
@@ -748,13 +788,29 @@ class _EpochPipeline:
         if self.n_model > 1:
             banners = [_hybrid_banner(n_data, self.n_model)] + banners
         pos, mask = _dp_slot_map(s, bsz, n_batches, bsz_pad)
-        mb_dev = jnp.asarray(mask, dtype=self.dtype)
+        import jax
+
+        if jax.process_count() > 1:
+            # multi-process inputs must be global arrays; stage the mask
+            # exactly like the restage route does (P(None, "data"))
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from .parallel.mesh import global_array
+
+            mb_dev = global_array(
+                np.asarray(jnp.asarray(mask, dtype=self.dtype)),
+                NamedSharding(self.mesh, P(None, DATA_AXIS)))
+        else:
+            mb_dev = jnp.asarray(mask, dtype=self.dtype)
         lr = ops.bpm_learn_rate(kind) if momentum \
             else ops.bp_learn_rate(kind)
         # the flat 1/N master-vector trick is a pure-DP layout; on a
-        # hybrid mesh the TP engine carries f32 master row BLOCKS instead
+        # hybrid mesh the TP engine carries f32 master row BLOCKS
+        # instead.  Cross-process it would also strand the export on a
+        # non-addressable flat vector -- masters stay replicated there.
         shard_master = (self.dtype == jnp.bfloat16
-                        and self.mesh is not None and self.n_model == 1)
+                        and self.mesh is not None and self.n_model == 1
+                        and jax.process_count() == 1)
         self.shapes = tuple(tuple(int(d) for d in w.shape)
                             for w in nn.kernel.weights)
         if self.weights is None:
@@ -800,7 +856,18 @@ class _EpochPipeline:
         # THE per-epoch H2D: the permutation scattered into batch slots
         flat = np.zeros(st["n_batches"] * st["bsz_pad"], np.int32)
         flat[st["pos"]] = sel
-        sel_dev = jnp.asarray(flat)
+        import jax
+
+        if jax.process_count() > 1:
+            # every rank computed the SAME slot map (the glibc shuffle
+            # is replicated by RNG-state construction, asserted by the
+            # crc32 agreement gate in _train_kernel_pipelined) -- stage
+            # it as a replicated global array
+            from .parallel.mesh import global_array, replicated
+
+            sel_dev = global_array(flat, replicated(self.mesh))
+        else:
+            sel_dev = jnp.asarray(flat)
         self.h2d_last = flat.nbytes
         self.stage_last = time.perf_counter() - t0
         with obs_trace.span("device_launch", rows=int(sel.size),
@@ -1028,8 +1095,17 @@ def _pipeline_for(nn, conf):
 
         import jax
 
-        if not trace_enabled() and jax.process_count() == 1:
-            pipe = _EpochPipeline.build(nn, conf)
+        if not trace_enabled():
+            if jax.process_count() == 1:
+                pipe = _EpochPipeline.build(nn, conf)
+            elif (conf.batch > 0 and _model_shards(conf) <= 1
+                    and not _tile_request(conf)):
+                # cross-host zero-restage (ISSUE 18): the pure-DP
+                # [batch] route rides the pipeline across process
+                # boundaries -- per-rank shard feeds, replicated slot
+                # map.  Hybrid/[tile]/per-sample keep the restage route
+                # (their engines are single-controller or warn there).
+                pipe = _EpochPipeline.build(nn, conf)
     nn._epoch_pipeline = pipe if pipe is not None else False
     return pipe
 
@@ -1083,8 +1159,17 @@ def _train_kernel_pipelined(nn, pipe: _EpochPipeline, kind: str,
     events, sel = pipe.rc.epoch_events(order)
     events_s = time.perf_counter() - t1
     pipe.events_last = events
+    # the replicated-shuffle assertion (ISSUE 18): every rank's glibc
+    # stream must have produced the SAME epoch permutation -- a crc32 of
+    # the gather indices rides the existing agreement gate, so a
+    # diverged RNG state aborts loudly instead of training on silently
+    # different slot maps
+    import zlib
+
     if not agree_all(True, (int(sel.size), nn.kernel.n_inputs,
-                            nn.kernel.n_outputs)):
+                            nn.kernel.n_outputs,
+                            zlib.crc32(np.ascontiguousarray(sel)
+                                       .tobytes()))):
         return False
     # test-dir prefetch, exactly like the restaging epoch
     global _prefetch_thread
@@ -1583,8 +1668,12 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     conf = nn.conf
     if _tile_request(conf):
         if jax.process_count() > 1:
-            nn_warn("[tile] engine is single-controller; multi-process "
-                    "[batch] runs keep minibatch DP\n")
+            # once per process, not per epoch: train_kernel re-enters
+            # here every epoch of a multi-epoch run
+            if not getattr(nn, "_tile_mp_warned", False):
+                nn._tile_mp_warned = True
+                nn_warn("[tile] engine is single-controller; "
+                        "multi-process [batch] runs keep minibatch DP\n")
         elif model_shards > 1:
             nn_warn("[tile] + [model] hybrid is not supported; minibatch "
                     "DP keeps the hybrid mesh\n")

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..io.conf import NN_TRAIN_BPM
 from ..obs import trace as obs_trace
+from ..parallel import coord
 from ..utils import nn_log
 from ..utils.nn_log import nn_out
 from . import snapshot as snap
@@ -109,6 +110,9 @@ class CheckpointManager:
                                nn.trainer_state.items()}
                               if getattr(nn, "trainer_state", None)
                               else None),
+            # coherent-global-step stamp (ISSUE 18): bundles record the
+            # world size that agreed on them behind the barrier
+            "world_size": coord.world_size(),
         }
 
     # --- saving -----------------------------------------------------------
@@ -118,6 +122,23 @@ class CheckpointManager:
             self.save(nn, epoch)
 
     def save(self, nn, epoch: int, sync: bool = False) -> None:
+        if coord.world_size() > 1:
+            # the coherent global step (ISSUE 18): every rank reaches
+            # this point at the same epoch (the training loop is
+            # deterministic and stop flags are agreed at epoch
+            # boundaries); the barrier proves it, then rank 0 alone
+            # writes the bundle -- N ranks racing os.replace on one
+            # shared checkpoint dir was the alternative.  The barrier
+            # runs HERE, on the training thread, never on the async
+            # writer (a pool-thread collective would race the next
+            # epoch's device collectives).
+            if not coord.snapshot_barrier(epoch):
+                raise OSError(
+                    f"snapshot barrier failed at epoch {epoch}: ranks "
+                    "disagree on the bundle epoch (no bundle written)")
+            if coord.process_index() != 0:
+                self.last_saved_epoch = int(epoch)
+                return
         job = self._capture(nn, epoch)
         self.last_saved_epoch = int(epoch)
         # the one console line, emitted HERE (deterministic position in
@@ -181,7 +202,8 @@ class CheckpointManager:
             seed=job["seed"], errors=job["errors"], name=job["name"],
             train=job["train"], dtype=job["dtype"],
             target_epochs=job["target_epochs"],
-            trainer_state=job.get("trainer_state"))
+            trainer_state=job.get("trainer_state"),
+            world_size=job.get("world_size", 1))
         snap.publish_snapshot(self.ckpt_dir, entry, seed=job["seed"],
                               errors=job["errors"],
                               keep_last=self.keep_last)
@@ -233,5 +255,7 @@ class CheckpointManager:
         Pending replica ships are joined here too -- the run's end is
         the one place waiting on the destination is correct."""
         self.flush()
-        snap.record_final_kernel(self.ckpt_dir, kernel_path)
+        if coord.process_index() == 0:
+            # rank 0 owns the shared manifest, same as the bundles
+            snap.record_final_kernel(self.ckpt_dir, kernel_path)
         self.drain_replication()

@@ -105,6 +105,11 @@ class SnapshotState:
     # native-trainer carry (hpnn_tpu.train): flat f64 arrays keyed
     # cg_d/cg_g/cg_meta for the CG trainer (None for BP/BPM)
     trainer_state: dict | None = None
+    # process count of the writing run (ISSUE 18): a resume at a
+    # DIFFERENT world size is refused loudly -- the shuffle stream is
+    # world-size independent but the run's collectives are not, and a
+    # silent mismatch would diverge rank state.  1 for legacy bundles.
+    world_size: int = 1
 
     @property
     def topology(self) -> list[int]:
@@ -180,7 +185,8 @@ def _verify_staged(path: str, data: bytes) -> None:
 def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
                    rng_state, seed: int, errors, name: str = "(null)",
                    train: str = "", dtype: str = "f64",
-                   target_epochs: int = 0, trainer_state=None) -> dict:
+                   target_epochs: int = 0, trainer_state=None,
+                   world_size: int = 1) -> dict:
     """Write one atomic bundle for ``epoch``; returns its index entry
     (tag/epoch/mean_err/fingerprint) for the manifest.  Every staged
     file is read back and byte-verified before the directory rename;
@@ -217,6 +223,11 @@ def write_snapshot(ckpt_dir: str, epoch: int, *, weights, momentum,
         "momentum": momentum is not None,
         "trainer_state": bool(trainer_state),
         "target_epochs": int(target_epochs),
+        # the coherent-global-step stamp (ISSUE 18): how many processes
+        # agreed (behind coord.snapshot_barrier) that this epoch is the
+        # bundle -- resume refuses a different world size
+        "world_size": int(world_size),
+        "barrier_epoch": int(epoch) if int(world_size) > 1 else None,
         "created": time.time(),
     }
     meta_bytes = (json.dumps(meta, indent=1) + "\n").encode()
@@ -524,7 +535,8 @@ def _load_bundle_state(bundle: str) -> SnapshotState | None:
                          errors=errors, tag=os.path.basename(bundle),
                          path=bundle, fingerprint=fp_actual,
                          target_epochs=int(meta.get("target_epochs", 0)),
-                         trainer_state=trainer_state)
+                         trainer_state=trainer_state,
+                         world_size=int(meta.get("world_size", 1)))
 
 
 def load_snapshot(path: str, verify: bool = True) -> SnapshotState | None:

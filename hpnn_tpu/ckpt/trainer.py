@@ -30,6 +30,7 @@ import signal
 import threading
 import time
 
+from ..parallel import coord
 from ..utils.glibc_random import GlibcRandom
 from ..utils.nn_log import nn_out
 from .manager import CheckpointManager
@@ -118,6 +119,7 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
     from ..utils.env import env_int
 
     kill_at = env_int("HPNN_CKPT_KILL_AT_EPOCH", 0)
+    world = coord.world_size()
     banner = epochs > 1 or start_epoch > 0
     if stop is None:
         stop = threading.Event()
@@ -157,6 +159,16 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
                 if not train_kernel(nn):
                     drain()
                     return False, False
+                # coordinated stop (ISSUE 18): a SIGTERM/cancel caught
+                # by ONE rank latches the stop on EVERY rank at this
+                # epoch boundary, so nobody runs ahead into the next
+                # epoch's collectives alone and the final snapshot's
+                # barrier sees all ranks.  Single-process: a plain read.
+                stopping = stop.is_set()
+                if world > 1:
+                    stopping = coord.any_flag(stopping)
+                    if stopping:
+                        stop.set()
                 if pipeline_active(nn):
                     pending.append(epoch)
                     # join only where the unpipelined loop would need
@@ -179,7 +191,11 @@ def train_loop(nn, epochs: int, manager: CheckpointManager | None = None,
                 # exercise the REAL signal path at a deterministic
                 # boundary (test hook; see module docstring)
                 os.kill(os.getpid(), signal.SIGTERM)
-            if stop.is_set() and epoch < epochs:
+            # multi-process: only the AGREED stop may enter the
+            # interrupt path (save's barrier needs every rank); a
+            # late local signal waits one epoch for agreement
+            if (stopping if world > 1 else stop.is_set()) \
+                    and epoch < epochs:
                 interrupted = True
                 drain()  # a signal may land between the join check and
                 # here: the final snapshot below must see synced weights

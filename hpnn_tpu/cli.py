@@ -413,6 +413,22 @@ def _train_nn_body(filename: str, extras: dict) -> int:
                 f"{list(neural.kernel.params)}! (ABORTING)\n")
             runtime.deinit_all()
             return -1
+        from .parallel import coord
+
+        if snap.world_size != coord.world_size():
+            # ISSUE 18: the bundle is bit-exact only along the world
+            # size that wrote it -- the shuffle stream is world-size
+            # independent, but a resumed run's collectives, snapshot
+            # barrier and rank-0 write discipline are not.  Refuse
+            # loudly on EVERY rank instead of silently diverging.
+            sys.stderr.write(
+                f"FAILED to resume: snapshot {snap.tag} was written by "
+                f"a {snap.world_size}-process run, but this run has "
+                f"{coord.world_size()} process(es)! Relaunch with the "
+                "matching HPNN_NUM_PROCESSES (or retrain). "
+                "(ABORTING)\n")
+            runtime.deinit_all()
+            return -1
         # bit-exact restore: float64 weights from state.npz (NOT the
         # quantized text), the effective seed, and the epoch counter;
         # the shuffle-RNG words go to train_loop below.  BPM momentum

@@ -629,6 +629,272 @@ def _save_pack(dirpath, names, n_in, n_out, results, stats) -> bool:
     return True
 
 
+# --- chunked streaming ingest (ISSUE 18 rung 2) -----------------------------
+
+_CHUNK_MAGIC = b"HPNNCK01"
+
+
+def _read_chunk(path: str):
+    """(header dict, data offset) of one chunk file, verified against
+    its own sha256 trailer; None on any structural or integrity
+    problem.  Chunks are small (one upload body), so the verify pass
+    streams the file once."""
+    try:
+        with open(path, "rb") as fp:
+            if fp.read(8) != _CHUNK_MAGIC:
+                return None
+            raw = fp.read(8)
+            if len(raw) != 8:
+                return None
+            (hlen,) = struct.unpack("<Q", raw)
+            if hlen > 1 << 30:
+                return None
+            blob = fp.read(hlen)
+            if len(blob) != hlen:
+                return None
+            hdr = json.loads(blob.decode("utf-8"))
+            if not isinstance(hdr, dict) \
+                    or hdr.get("version") != _PACK_VERSION:
+                return None
+            data_off = _aligned(16 + hlen)
+            n_rows = hdr.get("n_rows")
+            n_in, n_out = hdr.get("n_in"), hdr.get("n_out")
+            if not all(isinstance(v, int)
+                       for v in (n_rows, n_in, n_out)):
+                return None
+            data_end = data_off + n_rows * (n_in + n_out) * 8
+            fp.seek(data_end)
+            trailer = fp.read(8 + 32)
+            if trailer[:8] != _PACK_TRAILER_MAGIC or len(trailer) != 40:
+                return None
+            fp.seek(0)
+            h = hashlib.sha256()
+            remaining = data_end
+            while remaining > 0:
+                piece = fp.read(min(1 << 20, remaining))
+                if not piece:
+                    return None
+                h.update(piece)
+                remaining -= len(piece)
+            if h.digest() != trailer[8:]:
+                return None
+    except (OSError, ValueError, UnicodeDecodeError):
+        return None
+    return hdr, data_off
+
+
+class ChunkedPackWriter:
+    """Incremental pack build: a corpus enters the packed-cache format
+    one chunk at a time (ISSUE 18 rung 2 -- the jobs chunked upload
+    appends each body's rows while later chunks are still in flight).
+
+    Each :meth:`add_chunk` writes a self-contained chunk file next to
+    the final pack path, carrying its own header and sha256 trailer, so
+    a torn or bit-rotted chunk is detected at :meth:`finalize` before a
+    single row reaches the assembled pack.  ``finalize`` streams the
+    verified chunks into the standard ``HPNNPK01`` layout (all X rows,
+    then all T rows, content trailer, atomic replace) -- the result is
+    indistinguishable from a :func:`_save_pack` of the whole dir, so
+    the warm-load path needs no changes.
+    """
+
+    def __init__(self, dirpath: str, n_in: int, n_out: int):
+        self.dirpath = dirpath
+        self.n_in = int(n_in)
+        self.n_out = int(n_out)
+        self._pack = pack_path(dirpath)
+        self._chunks: list[str] = []
+        self._names: list[str] = []
+        self._n_rows = 0
+        self._broken = False
+
+    @property
+    def n_rows(self) -> int:
+        return self._n_rows
+
+    @property
+    def n_chunks(self) -> int:
+        return len(self._chunks)
+
+    def add_chunk(self, names, status, X, T) -> bool:
+        """Append one chunk: ``status`` maps each of ``names`` to a row
+        index LOCAL to this chunk (>= 0) or a skip class (< 0); ``X``/
+        ``T`` hold the chunk's loaded rows.  Returns False (and poisons
+        the writer) on any write failure -- the corpus still trains
+        from its source files, it just doesn't get the warm pack."""
+        if self._broken:
+            return False
+        n_rows = 0 if X is None else int(X.shape[0])
+        hdr = {"version": _PACK_VERSION, "seq": len(self._chunks),
+               "n_in": self.n_in, "n_out": self.n_out,
+               "n_rows": n_rows, "names": list(names),
+               "status": [int(s) for s in status]}
+        blob = json.dumps(hdr, separators=(",", ":")).encode("utf-8")
+        path = f"{self._pack}.chunk{len(self._chunks):05d}"
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            digest = hashlib.sha256()
+            with open(path, "wb") as fp:
+                head = (_CHUNK_MAGIC + struct.pack("<Q", len(blob))
+                        + blob
+                        + b"\0" * (_aligned(16 + len(blob))
+                                   - 16 - len(blob)))
+                fp.write(head)
+                digest.update(head)
+                if n_rows:
+                    xb = np.ascontiguousarray(
+                        X[:, :self.n_in], np.float64).tobytes()
+                    tb = np.ascontiguousarray(
+                        T[:, :self.n_out], np.float64).tobytes()
+                    fp.write(xb)
+                    fp.write(tb)
+                    digest.update(xb)
+                    digest.update(tb)
+                fp.write(_PACK_TRAILER_MAGIC)
+                fp.write(digest.digest())
+        except OSError as exc:
+            nn_dbg(f"corpus cache: chunk write failed ({exc})\n")
+            self._broken = True
+            return False
+        self._chunks.append(path)
+        self._names.extend(names)
+        self._n_rows += n_rows
+        return True
+
+    def add_sample_files(self, names) -> bool:
+        """Read ``names`` (relative to the writer's dir) with the normal
+        corpus readers, classify their diagnostics, and append them as
+        one chunk.  False when any file's diagnostics are
+        non-replayable (dir can't be packed) or the chunk write fails."""
+        if self._broken:
+            return False
+        results, _mode = _read_results(self.dirpath, list(names),
+                                       self.n_in, self.n_out)
+        classified = _classify_results(self.dirpath, list(names),
+                                       self.n_in, self.n_out, results)
+        if classified is None:
+            self._broken = True
+            return False
+        status, X, T = classified
+        return self.add_chunk(names, status, X, T)
+
+    def finalize(self) -> bool:
+        """Verify every chunk's sha256 trailer and assemble the standard
+        pack (atomic replace; chunk files removed on success).
+
+        The pack format stores rows in the dir's READDIR listing order
+        (the reference's shuffle substrate), which is unknowable while
+        chunks are still arriving -- so assembly reorders: the dir is
+        listed NOW, every listed name is located in its chunk, and rows
+        are streamed out in listing order (per-row reads from the chunk
+        files, never a full in-memory corpus).  A listing that does not
+        match the uploaded set -- a file added or removed behind the
+        writer's back -- refuses the pack instead of baking a stale
+        one.  The fingerprint (sizes/mtimes) is stat'd now too:
+        uploaded files are immutable once written, and any later touch
+        invalidates the pack exactly like _save_pack."""
+        if self._broken or not self._chunks:
+            self.abort()
+            return False
+        listing = samples.list_sample_dir(self.dirpath)
+        if listing is None or sorted(listing) != sorted(self._names):
+            nn_dbg("corpus cache: dir listing does not match the "
+                   "uploaded chunks; chunked pack skipped\n")
+            self.abort()
+            return False
+        stats = _stat_listing(self.dirpath, listing)
+        if stats is None:
+            self.abort()
+            return False
+        heads = []
+        for path in self._chunks:
+            got = _read_chunk(path)
+            if got is None:
+                nn_warn(f"corpus cache: chunk {os.path.basename(path)} "
+                        "failed its sha256; chunked pack abandoned\n")
+                self.abort()
+                return False
+            heads.append(got)
+        # name -> (skip class | local row, chunk index, data offset)
+        where: dict = {}
+        for ci, (chdr, data_off) in enumerate(heads):
+            for name, st in zip(chdr["names"], chdr["status"]):
+                where[name] = (int(st), ci, data_off)
+        status, plan = [], []
+        for name in listing:
+            st, ci, data_off = where[name]
+            if st >= 0:
+                status.append(len(plan))
+                plan.append((ci, data_off, st))
+            else:
+                status.append(st)
+        sizes, mtimes = stats
+        hdr = {"version": _PACK_VERSION, "n_in": self.n_in,
+               "n_out": self.n_out, "n_rows": len(plan),
+               "names": listing, "sizes": sizes, "mtimes": mtimes,
+               "status": status}
+        blob = json.dumps(hdr, separators=(",", ":")).encode("utf-8")
+        tmp = f"{self._pack}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as out:
+                out.write(_PACK_MAGIC)
+                out.write(struct.pack("<Q", len(blob)))
+                out.write(blob)
+                out.write(b"\0" * (_aligned(16 + len(blob))
+                                   - 16 - len(blob)))
+                # pack layout is all-X-then-all-T in listing order: two
+                # row-granular passes over the chunk files
+                for region in ("x", "t"):
+                    row_b = 8 * (self.n_in if region == "x"
+                                 else self.n_out)
+                    fps = {}
+                    try:
+                        for ci, data_off, local_row in plan:
+                            fp = fps.get(ci)
+                            if fp is None:
+                                fp = fps[ci] = open(self._chunks[ci],
+                                                    "rb")
+                            skip = (heads[ci][0]["n_rows"] * self.n_in
+                                    * 8 if region == "t" else 0)
+                            fp.seek(data_off + skip + local_row * row_b)
+                            piece = fp.read(row_b)
+                            if len(piece) != row_b:
+                                raise OSError(
+                                    f"chunk {self._chunks[ci]} "
+                                    "truncated")
+                            out.write(piece)
+                    finally:
+                        for fp in fps.values():
+                            with contextlib.suppress(OSError):
+                                fp.close()
+            digest = hashlib.sha256()
+            with open(tmp, "rb") as fp:
+                for piece in iter(lambda: fp.read(1 << 20), b""):
+                    digest.update(piece)
+            with open(tmp, "ab") as fp:
+                fp.write(_PACK_TRAILER_MAGIC)
+                fp.write(digest.digest())
+            os.replace(tmp, self._pack)
+        except OSError as exc:
+            nn_dbg(f"corpus cache: chunked pack assembly failed "
+                   f"({exc})\n")
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+            self.abort()
+            return False
+        self.abort()  # chunk files are spent either way
+        _note_active(self._pack)
+        gc_cache(protect=(self._pack,))
+        return True
+
+    def abort(self) -> None:
+        """Remove the chunk files (idempotent)."""
+        for path in self._chunks:
+            with contextlib.suppress(OSError):
+                os.unlink(path)
+        self._chunks = []
+
+
 # --- the loader entry points ------------------------------------------------
 
 def load_ordered(dirpath: str, names: list[str], order: list[int],
@@ -724,6 +990,28 @@ class ResidentCorpus:
         return _order_events(self.dirpath, self.names, order, self.header,
                              self.status, lines=self._lines)
 
+    def padded_row_block(self, which: str, lo: int, hi: int,
+                         total_rows: int):
+        """Rows ``[lo, hi)`` of X (``which='x'``) or T as a contiguous
+        f64 block, with zero rows standing in past ``n_rows`` (the DP
+        pad up to ``total_rows``).  This is the per-host shard feed for
+        the cross-process resident upload (ISSUE 18): when the rows are
+        pack-backed memmaps, only the requested row-range's pages are
+        ever touched -- no host materializes the full corpus."""
+        src = self.X if which == "x" else self.T
+        if not 0 <= lo <= hi <= total_rows:
+            raise ValueError(f"row block [{lo}, {hi}) outside "
+                             f"[0, {total_rows})")
+        width = int(src.shape[1]) if src is not None else 0
+        real_hi = min(hi, self._n_rows)
+        if lo >= real_hi:  # pure padding block
+            return np.zeros((hi - lo, width), np.float64)
+        block = np.ascontiguousarray(src[lo:real_hi], np.float64)
+        if hi > real_hi:
+            block = np.concatenate(
+                [block, np.zeros((hi - real_hi, width), np.float64)])
+        return block
+
 
 def _classify_results(dirpath, names, n_in, n_out, results):
     """(status, X, T) in listing order from fresh read results, or None
@@ -747,7 +1035,8 @@ def _classify_results(dirpath, names, n_in, n_out, results):
 
 
 def load_resident(dirpath: str, names: list[str], n_in: int,
-                  n_out: int, header: str = "TRAINING"):
+                  n_out: int, header: str = "TRAINING",
+                  prefer_mmap: bool = False):
     """Load a corpus ONCE in listing order for device residency.
 
     Pack-cache fast path first (mmap, no file walk); a cold load reads
@@ -759,6 +1048,11 @@ def load_resident(dirpath: str, names: list[str], n_in: int,
     diagnostics verbatim).  Emits NO console output of its own beyond a
     dbg summary -- the per-epoch skip diagnostics are reconstructed by
     ``epoch_events`` each epoch, exactly like a warm pack load.
+
+    ``prefer_mmap=True`` (the multi-process resident path) swaps a cold
+    load's in-memory rows for the freshly written pack's memmaps, so a
+    rank that had to build the pack still serves its device shard feeds
+    from pack pages instead of a full host copy.
     """
     if n_in <= 0 or n_out <= 0:
         return None
@@ -780,7 +1074,12 @@ def load_resident(dirpath: str, names: list[str], n_in: int,
                            "falling back to per-epoch loads\n")
                     return None
                 if cache_enabled() and stats is not None:
-                    _save_pack(dirpath, names, n_in, n_out, results, stats)
+                    if (_save_pack(dirpath, names, n_in, n_out, results,
+                                   stats) and prefer_mmap):
+                        reloaded = _try_load_pack(dirpath, names,
+                                                  n_in, n_out)
+                        if reloaded is not None:
+                            classified = reloaded
                 got = classified
     status, X, T = got
     rc = ResidentCorpus(dirpath, names, status, X, T, header=header)

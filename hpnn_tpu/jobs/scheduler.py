@@ -30,6 +30,7 @@ interleave without either preempting a launch.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import threading
 import time
@@ -52,6 +53,13 @@ __all__ = ["JobScheduler", "JobQueueFull", "JobError"]
 _TRAINERS = ("BP", "BPM", "CG")
 _DTYPES = ("f64", "f32", "bf16")
 _TYPES = ("ANN", "SNN", "LNN")
+
+# chunked streaming upload (ISSUE 18 rung 2): a job submitted on its
+# FIRST corpus chunk carries this marker in its job dir until the last
+# chunk lands -- the runner holds training (bounded by
+# HPNN_JOBS_UPLOAD_WAIT_S) while queue admission, conf generation,
+# queue dwell and the incremental pack build all overlap the upload
+JOB_UPLOAD_MARKER = ".upload-incomplete"
 
 # console.log prefixes per captured nn_log level (replay-equivalent at
 # the verbosity the entries were captured under)
@@ -113,6 +121,13 @@ class JobScheduler:
         self._resume_due: dict[str, float] = {}
         self._resume_last_scan = 0.0
         self._mu = threading.Lock()
+        # in-flight chunked uploads: job_id -> {"writer", "chunks",
+        # "deadline"} (guarded by _mu; sessions die with the process --
+        # the on-disk marker alone decides whether a job may train)
+        self._uploads: dict[str, dict] = {}
+        self.upload_chunks_total = 0
+        self.upload_wait_s = env_float("HPNN_JOBS_UPLOAD_WAIT_S",
+                                       120.0, lo=1.0)
         self._current: JobState | None = None
         self._current_stop: threading.Event | None = None
         self._cancel_requested = False
@@ -127,11 +142,17 @@ class JobScheduler:
 
     # --- submission ------------------------------------------------------
     def submit(self, kernel: str, params: dict,
-               corpus_files: list[tuple[str, bytes]] | None = None
-               ) -> JobState:
+               corpus_files: list[tuple[str, bytes]] | None = None,
+               upload_incomplete: bool = False) -> JobState:
         """Validate, materialize the job dir (conf + uploaded corpus) and
         enqueue.  Raises :class:`JobError` (HTTP 400) on bad parameters,
-        :class:`JobQueueFull` (429) when the queue is at capacity."""
+        :class:`JobQueueFull` (429) when the queue is at capacity.
+
+        ``upload_incomplete`` (chunked uploads): the job enters the
+        queue with only its first corpus chunk on disk and a marker
+        that holds the runner until :meth:`upload_chunk` sees the last
+        chunk -- the marker is written BEFORE the queue submit so an
+        instantly-scheduled job can never train on a partial corpus."""
         model = self.app.registry.get(kernel)
         if model is None:
             raise JobError(f"unknown kernel '{kernel}'")
@@ -155,6 +176,10 @@ class JobScheduler:
                     with open(os.path.join(cdir, base), "wb") as fp:
                         fp.write(data)
                 clean["samples"] = cdir
+            if upload_incomplete:
+                with open(os.path.join(job.path, JOB_UPLOAD_MARKER),
+                          "w") as fp:
+                    fp.write(f"{int(time.time())}\n")
             job.epochs = clean["epochs"]
             job.start_epoch = clean.get("start_epoch", 0)
             job.epoch = job.start_epoch
@@ -171,6 +196,131 @@ class JobScheduler:
         nn_out(f"jobs: {job.job_id} queued for kernel '{kernel}' "
                f"({clean['epochs']} epoch(s), train={clean['train']})\n")
         return job
+
+    # --- chunked streaming upload (ISSUE 18 rung 2) -----------------------
+    def submit_chunked(self, kernel: str, params: dict,
+                       first_chunk: list[tuple[str, bytes]]) -> JobState:
+        """Admit a job on its FIRST corpus chunk: the job is queued
+        immediately (conf written, marker held), the chunk's rows enter
+        an incremental pack build, and later :meth:`upload_chunk` calls
+        append the rest -- training starts the moment the final chunk
+        lands (or the runner reaches the job, whichever is later)."""
+        if not first_chunk:
+            raise JobError("chunk 1 must carry at least one corpus file")
+        model = self.app.registry.get(kernel)
+        if model is None:
+            raise JobError(f"unknown kernel '{kernel}'")
+        job = self.submit(kernel, params, corpus_files=first_chunk,
+                          upload_incomplete=True)
+        from ..io.corpus import ChunkedPackWriter
+
+        writer = ChunkedPackWriter(os.path.join(job.path, JOB_CORPUS),
+                                   model.n_inputs, model.n_outputs)
+        writer.add_sample_files(
+            [os.path.basename(n) for n, _ in first_chunk])
+        with self._mu:
+            self._uploads[job.job_id] = {
+                "writer": writer, "chunks": 1,
+                "deadline": time.monotonic() + self.upload_wait_s}
+            self.upload_chunks_total += 1
+        return job
+
+    def upload_chunk(self, job_id: str,
+                     corpus_files: list[tuple[str, bytes]],
+                     final: bool) -> dict:
+        """Append one corpus chunk to a job admitted by
+        :meth:`submit_chunked`.  The final chunk (which may be empty --
+        a bare close) finalizes the incremental pack and releases the
+        runner's upload hold."""
+        with self._mu:
+            sess = self._uploads.get(job_id)
+        if sess is None:
+            job = self.store.get(job_id)
+            if job is None:
+                raise JobError(f"unknown job '{job_id}'")
+            raise JobError(f"job '{job_id}' has no open chunked upload")
+        job = self.store.get(job_id)
+        if job is None or job.status in TERMINAL_STATES:
+            self._drop_upload(job_id, aborted=True)
+            raise JobError(f"job '{job_id}' is no longer accepting "
+                           "corpus chunks")
+        cdir = os.path.join(job.path, JOB_CORPUS)
+        names = []
+        for name, data in corpus_files:
+            base = os.path.basename(name)
+            if not base or base.startswith("."):
+                raise JobError(f"bad corpus file name {name!r}")
+            path = os.path.join(cdir, base)
+            if os.path.exists(path):
+                raise JobError(f"duplicate corpus file {base!r}")
+            with open(path, "wb") as fp:
+                fp.write(data)
+            names.append(base)
+        if names:
+            sess["writer"].add_sample_files(names)
+        with self._mu:
+            sess["chunks"] += 1
+            self.upload_chunks_total += 1
+            chunks = sess["chunks"]
+        if final:
+            # assemble the warm pack BEFORE releasing the hold: the
+            # runner's cold load then replays the pack instead of
+            # re-reading every uploaded file (best-effort -- a refused
+            # pack still trains from the source files)
+            sess["writer"].finalize()
+            self._drop_upload(job_id, aborted=False)
+            with contextlib.suppress(OSError):
+                os.unlink(os.path.join(job.path, JOB_UPLOAD_MARKER))
+        return {"job": job_id, "chunks": chunks,
+                "complete": bool(final)}
+
+    def _drop_upload(self, job_id: str, aborted: bool) -> None:
+        with self._mu:
+            sess = self._uploads.pop(job_id, None)
+        if sess is not None and aborted:
+            sess["writer"].abort()
+
+    def _await_upload(self, job: JobState,
+                      stop: threading.Event) -> bool:
+        """Hold the runner until the job's corpus upload completes (the
+        on-disk marker disappears).  Returns False -- with the job's
+        terminal status already recorded -- when the hold ends in
+        cancellation or times out."""
+        marker = os.path.join(job.path, JOB_UPLOAD_MARKER)
+        if not os.path.exists(marker):
+            return True
+        with self._mu:
+            sess = self._uploads.get(job.job_id)
+        deadline = (sess["deadline"] if sess is not None
+                    else time.monotonic() + self.upload_wait_s)
+        self.store.update(job, status="running", started=time.time(),
+                          lease_expires=(time.time()
+                                         + self.upload_wait_s
+                                         + self.lease_s))
+        while os.path.exists(marker):
+            if stop.is_set():
+                self._drop_upload(job.job_id, aborted=True)
+                status = ("cancelled" if self._cancel_requested
+                          else "interrupted")
+                self.store.update(job, status=status,
+                                  error="stopped during corpus upload",
+                                  finished=time.time(),
+                                  lease_expires=0.0)
+                nn_out(f"jobs: {job.job_id} {status} during corpus "
+                       "upload\n")
+                return False
+            if time.monotonic() > deadline:
+                self._drop_upload(job.job_id, aborted=True)
+                self.store.update(
+                    job, status="failed",
+                    error=f"corpus upload incomplete after "
+                          f"{self.upload_wait_s:.0f}s",
+                    finished=time.time(), lease_expires=0.0)
+                nn_out(f"jobs: {job.job_id} failed: corpus upload "
+                       f"incomplete after {self.upload_wait_s:.0f}s\n")
+                return False
+            time.sleep(0.05)
+        return True
 
     def _sanitize(self, model, params: dict,
                   corpus_files) -> dict:
@@ -480,6 +630,11 @@ class JobScheduler:
                         stop: threading.Event) -> None:
         from ..api import train_job
 
+        # chunked upload in flight: hold training until the last chunk
+        # lands (the queue dwell already overlapped the upload; any
+        # remaining wait is bounded by HPNN_JOBS_UPLOAD_WAIT_S)
+        if not self._await_upload(job, stop):
+            return
         model = self.app.registry.get(job.kernel)
         if self.auto_promote and model is not None:
             # pin the pre-job serving generation NOW: per-epoch swaps
@@ -820,6 +975,12 @@ class JobScheduler:
             self._draining = True
             if self._current_stop is not None:
                 self._current_stop.set()
+            open_uploads = list(self._uploads)
+        for job_id in open_uploads:
+            # open chunked uploads die with the server: chunk litter is
+            # swept; the marker stays, so a recovered job re-queues and
+            # fails its bounded upload wait instead of training partial
+            self._drop_upload(job_id, aborted=True)
         self.queue.close()
         self._closed = True
         self._thread.join(timeout=timeout_s)
@@ -855,4 +1016,5 @@ class JobScheduler:
             "by_status": self.store.by_status(),
             "trained_epochs_total": self.store.trained_epochs(),
             "auto_resumes_total": self.auto_resumes_total,
+            "upload_chunks_total": self.upload_chunks_total,
         }

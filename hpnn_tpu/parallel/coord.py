@@ -65,3 +65,87 @@ def agree_all(ok: bool, fingerprint=()) -> bool:
                  f"(fingerprints {gathered.tolist()})\n")
         return False
     return True
+
+
+def world_size() -> int:
+    """Process count of this run -- 1 without HPNN_DISTRIBUTED (no jax
+    import on the pure-IO paths that stamp snapshots)."""
+    import os
+
+    if not os.environ.get("HPNN_DISTRIBUTED"):
+        return 1
+    import jax
+
+    return jax.process_count()
+
+
+def process_index() -> int:
+    """This process's 0-based rank -- 0 without HPNN_DISTRIBUTED."""
+    import os
+
+    if not os.environ.get("HPNN_DISTRIBUTED"):
+        return 0
+    import jax
+
+    return jax.process_index()
+
+
+def any_flag(flag: bool) -> bool:
+    """OR-reduce a local flag across processes (collective; every rank
+    must call at the same point).  The coordinated-stop primitive: one
+    rank catching SIGTERM latches the stop on EVERY rank at the next
+    epoch boundary, so nobody runs ahead into a collective alone.
+    Single-process: returns ``flag`` untouched."""
+    if world_size() == 1:
+        return bool(flag)
+    import jax
+    from jax.experimental import multihost_utils
+
+    vec = np.asarray([1 if flag else 0], np.int64)
+    try:
+        gathered = np.asarray(multihost_utils.process_allgather(vec))
+    except Exception as exc:  # pragma: no cover - coordination failure
+        nn_error(f"process flag agreement failed: {exc}\n")
+        return True  # fail towards stopping together
+    return bool((gathered != 0).any())
+
+
+def snapshot_barrier(epoch: int, timeout_s: float = 120.0) -> bool:
+    """The coherent-global-step gate: all ranks agree on the epoch being
+    bundled before rank 0 writes the snapshot.
+
+    Two layers: a client-server barrier over jax.distributed's
+    coordination service (so rank 0's write cannot race ahead of a rank
+    still finishing the epoch), then an epoch all-gather that PROVES the
+    ranks are bundling the same epoch -- a divergent epoch means the
+    ranks' training loops have already split and a bundle written now
+    would be incoherent.  Single-process: True, no collectives.
+    """
+    if world_size() == 1:
+        return True
+    import jax
+
+    try:
+        from jax._src import distributed as _dist
+
+        client = getattr(_dist.global_state, "client", None)
+        if client is not None:
+            client.wait_at_barrier(
+                f"hpnn_snapshot_ep{int(epoch)}", int(timeout_s * 1000))
+    except Exception as exc:
+        # the allgather below is itself a barrier; losing the named
+        # coordination-service barrier only loses the nicer timeout
+        nn_error(f"snapshot barrier degraded to allgather: {exc}\n")
+    from jax.experimental import multihost_utils
+
+    vec = np.asarray([int(epoch)], np.int64)
+    try:
+        gathered = np.asarray(multihost_utils.process_allgather(vec))
+    except Exception as exc:  # pragma: no cover - coordination failure
+        nn_error(f"snapshot barrier failed: {exc}\n")
+        return False
+    if not (gathered == int(epoch)).all():
+        nn_error("aborting snapshot: ranks disagree on the bundle epoch "
+                 f"(epochs {gathered.ravel().tolist()})\n")
+        return False
+    return True

@@ -41,6 +41,7 @@ from .mesh import (
     batch_sharding,
     flat_state_sharding,
     flatten_state,
+    global_array,
     replicated,
     unflatten_state,
 )
@@ -280,6 +281,12 @@ def dp_resident_carry(weights, mesh=None, shard_master=False):
         return jax.device_put(flat, flat_state_sharding(mesh))
     if mesh is not None:
         rep = replicated(mesh)
+        if jax.process_count() > 1:
+            # device_put cannot target a cross-process sharding from a
+            # host-local array; build the replicated global arrays from
+            # every rank's (identical) host copy instead
+            return tuple(global_array(np.asarray(w), rep)
+                         for w in weights)
         return tuple(jax.device_put(w, rep) for w in weights)
     return tuple(weights)
 

@@ -169,6 +169,18 @@ def init_all(init_verbose: int = 0) -> int:
                     num_processes=int(os.environ["HPNN_NUM_PROCESSES"]),
                     process_id=int(os.environ["HPNN_PROCESS_ID"]),
                 )
+            try:
+                # the CPU client only wires cross-process collectives
+                # when a collectives implementation is selected BEFORE
+                # the backend comes up; without it every multi-process
+                # jit dies with "Multiprocess computations aren't
+                # implemented on the CPU backend".  TPU/GPU ignore the
+                # flag, and jaxlibs without gloo raise -- they keep the
+                # single-host behaviour they had.
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+            except Exception:
+                pass
             jax.distributed.initialize(**kwargs)
         devs = jax.devices()
         lib_runtime.n_devices = len(devs)

@@ -633,6 +633,11 @@ class ServeMetrics:
                 "# TYPE hpnn_jobs_trained_epochs_total counter",
                 f"hpnn_jobs_trained_epochs_total "
                 f"{j['trained_epochs_total']}",
+                "# HELP hpnn_jobs_upload_chunks_total Corpus chunks "
+                "accepted by the chunked upload endpoints.",
+                "# TYPE hpnn_jobs_upload_chunks_total counter",
+                f"hpnn_jobs_upload_chunks_total "
+                f"{j.get('upload_chunks_total', 0)}",
             ]
             if running:
                 lines += [

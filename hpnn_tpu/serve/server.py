@@ -169,6 +169,8 @@ from .registry import ModelRegistry
 _INFER_RE = re.compile(r"^/v1/kernels/([^/]+)/infer$")
 _RELOAD_RE = re.compile(r"^/v1/kernels/([^/]+)/reload$")
 _TRAIN_RE = re.compile(r"^/v1/kernels/([^/]+)/train$")
+_TRAIN_CHUNKED_RE = re.compile(r"^/v1/kernels/([^/]+)/train/chunked$")
+_JOB_CORPUS_RE = re.compile(r"^/v1/jobs/([^/]+)/corpus$")
 _JOB_RE = re.compile(r"^/v1/jobs/([^/]+)$")
 _JOB_EVENTS_RE = re.compile(r"^/v1/jobs/([^/]+)/events$")
 _JOB_ACTION_RE = re.compile(
@@ -183,6 +185,26 @@ class _HTTPError(Exception):
         self.status = status
         self.outcome = outcome
         self.retry_after = retry_after  # seconds; 429s render the header
+
+
+def _jobs_body_cap_bytes() -> int:
+    """Upload body cap for the jobs endpoints (ISSUE 18 rung 2): one
+    POST -- a single-shot train submit or one corpus chunk -- may carry
+    at most HPNN_JOBS_MAX_BODY_MB (0 disables).  Oversized single-shot
+    submits get a 413 pointing at the chunked endpoint, and the cap is
+    enforced from the Content-Length, BEFORE the body is buffered."""
+    from ..utils.env import env_int
+
+    return env_int("HPNN_JOBS_MAX_BODY_MB", 64, lo=0) << 20
+
+
+def _read_spool(path: str | None) -> bytes:
+    """Read back a request body spooled to disk by ``_spool_body`` (cap
+    already enforced from Content-Length, so one read is bounded)."""
+    if not path:
+        return b""
+    with open(path, "rb") as fp:
+        return fp.read()
 
 
 def _parse_multipart(body: bytes,
@@ -1280,6 +1302,70 @@ class ServeApp:
             raise _HTTPError(400, "bad_request", msg)
         return job.to_dict()
 
+    def handle_train_chunked(self, name: str, spool: str | None,
+                             content_type: str = "") -> dict:
+        """POST /v1/kernels/<name>/train/chunked: submit a training job
+        on its FIRST corpus chunk (multipart: ``params`` JSON field +
+        corpus file parts).  The job queues immediately and holds
+        training until the upload closes; 202 with the job record plus
+        the per-chunk upload endpoint (ISSUE 18 rung 2)."""
+        from ..jobs import JobError, JobQueueFull
+
+        jobs = self._jobs_or_503()
+        params, files = _parse_multipart(_read_spool(spool),
+                                         content_type)
+        try:
+            job = jobs.submit_chunked(name, params, files)
+        except JobQueueFull as exc:
+            raise _HTTPError(429, "queue_full", str(exc))
+        except JobError as exc:
+            msg = str(exc)
+            if "unknown kernel" in msg:
+                raise _HTTPError(404, "not_found", msg)
+            raise _HTTPError(400, "bad_request", msg)
+        out = job.to_dict()
+        out["upload"] = {"endpoint": f"/v1/jobs/{job.job_id}/corpus",
+                         "chunks": 1, "complete": False}
+        return out
+
+    def handle_job_corpus(self, job_id: str, spool: str | None,
+                          content_type: str = "",
+                          query: str = "") -> dict:
+        """POST /v1/jobs/<id>/corpus[?final=1]: append one corpus chunk
+        to a chunked-upload job.  ``final=1`` closes the upload and
+        releases the runner's hold (it may carry files or be a bare
+        close)."""
+        import urllib.parse
+
+        from ..jobs import JobError
+
+        jobs = self._jobs_or_503()
+        q = urllib.parse.parse_qs(query or "")
+        final = (q.get("final") or ["0"])[-1] in ("1", "true")
+        body = _read_spool(spool)
+        files: list = []
+        if body.strip():
+            try:
+                _params, files = _parse_multipart(body, content_type)
+            except _HTTPError as exc:
+                # a bare close is often an EMPTY multipart (closing
+                # boundary only): zero files, not a malformed body
+                if "no parts" not in str(exc):
+                    raise
+        if not files and not final:
+            raise _HTTPError(400, "bad_request",
+                             "chunk carries no corpus files (send "
+                             "files, or final=1 to close the upload)")
+        try:
+            return jobs.upload_chunk(job_id, files, final)
+        except JobError as exc:
+            msg = str(exc)
+            if "unknown job" in msg:
+                raise _HTTPError(404, "not_found", msg)
+            if "no open chunked" in msg or "no longer accepting" in msg:
+                raise _HTTPError(409, "conflict", msg)
+            raise _HTTPError(400, "bad_request", msg)
+
     def handle_job_get(self, job_id: str) -> dict:
         jobs = self._jobs_or_503()
         snap = jobs.get(job_id)
@@ -1734,28 +1820,98 @@ class _Handler(BaseHTTPRequestHandler):
             self.close_connection = True
 
     def do_POST(self) -> None:
-        # drain the body FIRST, whatever the route: replying without
-        # consuming it would leave the bytes on the keep-alive stream to
-        # be misparsed as the next request line (protocol_version is 1.1)
+        path = self.path.partition("?")[0]
+        ck = _TRAIN_CHUNKED_RE.match(path)
+        jc = _JOB_CORPUS_RE.match(path)
         try:
             length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length)
         except ValueError:
             self.close_connection = True  # unknown body length: resync
             self.app.metrics.count_request("bad_request")
             self._reply(400, {"error": "bad Content-Length",
                               "reason": "bad_request"})
             return
+        cap = _jobs_body_cap_bytes()
+        tr = _TRAIN_RE.match(path)
+        if cap and length > cap and (ck or jc or tr):
+            # the upload cap (ISSUE 18): refuse from the Content-Length
+            # alone -- the body is never buffered.  Single-shot submits
+            # are pointed at the chunked endpoint; the unread body
+            # forces a connection resync
+            self.close_connection = True
+            self.app.metrics.count_request("too_large")
+            # drain-and-discard in bounded pieces (still never
+            # buffered): replying while the client is mid-send makes
+            # it see a broken pipe instead of the 413
+            remaining = length
+            while remaining > 0:
+                piece = self.rfile.read(min(1 << 20, remaining))
+                if not piece:
+                    break
+                remaining -= len(piece)
+            name = (ck or tr).group(1) if (ck or tr) else None
+            chunked = (f"/v1/kernels/{name}/train/chunked" if name
+                       else "/v1/kernels/<name>/train/chunked")
+            self._reply(413, {
+                "error": f"body is {length} bytes; the per-request cap "
+                         f"is {cap} (HPNN_JOBS_MAX_BODY_MB)",
+                "reason": "too_large",
+                "hint": "split the corpus across chunked uploads: "
+                        f"POST {chunked} with the first files, then "
+                        "POST /v1/jobs/<id>/corpus per chunk "
+                        "(?final=1 on the last)",
+            }, extra_headers={"X-HPNN-Chunked-Endpoint": chunked})
+            return
+        if ck or jc:
+            # corpus chunks stream to a disk spool as they leave the
+            # socket (ISSUE 18 rung 2) -- at no point does more than
+            # one cap-bounded chunk of a corpus sit in memory
+            body = b""
+            spool = self._spool_body(length)
+        else:
+            # drain the body FIRST, whatever the route: replying
+            # without consuming it would leave the bytes on the
+            # keep-alive stream to be misparsed as the next request
+            # line (protocol_version is 1.1)
+            body = self.rfile.read(length)
+            spool = None
+        try:
+            self._do_post_routed(path, body, spool, ck, jc)
+        finally:
+            if spool is not None:
+                try:
+                    os.unlink(spool)
+                except OSError:
+                    pass
+
+    def _spool_body(self, length: int) -> str:
+        """Drain the request body to a temp spool file in bounded
+        pieces; returns the spool path (caller unlinks)."""
+        import tempfile
+
+        fd, spool = tempfile.mkstemp(prefix=".hpnn-upload-",
+                                     suffix=".spool")
+        with os.fdopen(fd, "wb") as fp:
+            remaining = length
+            while remaining > 0:
+                piece = self.rfile.read(min(1 << 20, remaining))
+                if not piece:
+                    break
+                fp.write(piece)
+                remaining -= len(piece)
+        return spool
+
+    def _do_post_routed(self, path: str, body: bytes,
+                        spool: str | None, ck, jc) -> None:
         if self._chaos_server():
             return
-        path = self.path.partition("?")[0]
         r = _RELOAD_RE.match(path)
         t = _TRAIN_RE.match(path)
         a = _JOB_ACTION_RE.match(path)
         prof = path == "/v1/debug/profile"
         mesh_reg = path == "/v1/mesh/register"
         bundle = path == "/v1/mesh/bundle"
-        if (r or t or a or prof or mesh_reg or bundle) \
+        if (r or t or a or ck or jc or prof or mesh_reg or bundle) \
                 and not self.app.authorized(self.headers):
             # every mutating endpoint sits behind the auth token when
             # one is configured; infer/metrics/healthz stay open
@@ -1804,6 +1960,32 @@ class _Handler(BaseHTTPRequestHandler):
                             extra_headers=headers)
                 return
             self._reply(202, out)
+            return
+        if ck is not None:
+            try:
+                out = self.app.handle_train_chunked(
+                    ck.group(1), spool,
+                    content_type=self.headers.get("Content-Type", ""))
+            except _HTTPError as exc:
+                headers = ({"Retry-After": "1"} if exc.status == 429
+                           else None)
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome},
+                            extra_headers=headers)
+                return
+            self._reply(202, out)
+            return
+        if jc is not None:
+            try:
+                out = self.app.handle_job_corpus(
+                    jc.group(1), spool,
+                    content_type=self.headers.get("Content-Type", ""),
+                    query=self.path.partition("?")[2])
+            except _HTTPError as exc:
+                self._reply(exc.status,
+                            {"error": str(exc), "reason": exc.outcome})
+                return
+            self._reply(200, out)
             return
         if a is not None:
             try:

@@ -82,6 +82,106 @@ def gen_corpus(d: str, files: int, n_in: int, n_out: int) -> None:
     print(f"  corpus written in {time.time() - t0:.0f}s", flush=True)
 
 
+MP_WORKER = """
+import json, os, sys, time
+sys.path.insert(0, {repo!r})
+os.chdir({workdir!r})
+mode = os.environ["HPNN_BENCH_MODE"]
+if mode == "cli":
+    from hpnn_tpu import cli
+    rc = cli.train_nn_main(json.loads(os.environ["HPNN_BENCH_ARGS"]))
+    sys.exit(0 if rc == 0 else 1)
+from hpnn_tpu import runtime
+from hpnn_tpu.utils import nn_log
+rc = runtime.init_all(0)
+assert rc == 0, "runtime init failed"
+import jax
+from hpnn_tpu import api
+from hpnn_tpu.ckpt.trainer import train_loop
+from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+from hpnn_tpu.parallel import coord
+nn_log.set_verbosity(0)
+nn = api.configure("nn.conf")
+assert nn is not None, "configure failed"
+epochs = int(os.environ["HPNN_BENCH_EPOCHS"])
+api.reset_epoch_metrics()
+t0 = time.perf_counter()
+ok, _ = train_loop(nn, epochs)
+wall = time.perf_counter() - t0
+assert ok, "training failed"
+m = dict(api.EPOCH_METRICS)
+t0 = time.perf_counter()
+for i in range(32):
+    coord.snapshot_barrier(100000 + i)
+m["barrier_ms"] = (time.perf_counter() - t0) / 32 * 1e3
+m["wall_s"] = wall
+rank = jax.process_index()
+dump_kernel_to_path(nn.kernel, "kernel.%s.rank%d" % (mode, rank))
+if rank == 0:
+    with open("metrics.%s.json" % mode, "w") as fp:
+        json.dump(m, fp)
+print("MP_WORKER_DONE", rank, flush=True)
+"""
+
+
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _mp_launch(workdir: str, nprocs: int, mode: str, epochs: int = 0,
+               cli_args=None, rank_env=None, timeout: float = 900):
+    """Launch ``nprocs`` REAL coordinated processes (gloo CPU backend,
+    one XLA host device each -- the smallest true multi-host) running
+    MP_WORKER in ``workdir``; returns [(rc, output), ...]."""
+    import subprocess
+
+    port = _free_port()
+    code = MP_WORKER.format(repo=REPO, workdir=workdir)
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ)
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+            "HPNN_BENCH_MODE": mode,
+            "HPNN_BENCH_EPOCHS": str(epochs),
+        })
+        if cli_args is not None:
+            env["HPNN_BENCH_ARGS"] = json.dumps(cli_args)
+        if nprocs > 1:
+            env.update({
+                "HPNN_DISTRIBUTED": "1",
+                "HPNN_COORDINATOR": f"127.0.0.1:{port}",
+                "HPNN_NUM_PROCESSES": str(nprocs),
+                "HPNN_PROCESS_ID": str(rank),
+            })
+        else:
+            for var in ("HPNN_DISTRIBUTED", "HPNN_COORDINATOR",
+                        "HPNN_NUM_PROCESSES", "HPNN_PROCESS_ID"):
+                env.pop(var, None)
+        if rank_env is not None:
+            env.update(rank_env[rank])
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", code], env=env, cwd=workdir,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out))
+    return outs
+
+
 def _stub_select_train_epoch(dtype=None, donate=False, defer_stats=False):
     """A drop-in for ops.select_train_epoch whose epoch is ONE jitted
     pass over the gathered batch: it consumes every row (so the gather /
@@ -176,9 +276,18 @@ def main() -> int:
     ap.add_argument("--train", default=None,
                     help="trainer (default BP; the DP rows default to "
                     "BPM so there is momentum state to measure)")
+    ap.add_argument("--hosts", type=int, default=1,
+                    help="measure the CROSS-HOST zero-restage route "
+                    "(ISSUE 18) with N real coordinated processes: "
+                    "per-host resident shards vs per-epoch restage, "
+                    "snapshot-barrier cost, and a kill-one-rank + "
+                    "coordinated --resume byte-exact drill; merges a "
+                    "'multi_process' section into --out")
     ap.add_argument("--out", default="EPOCH_BENCH.json")
     args = ap.parse_args()
 
+    if args.hosts > 1:
+        return main_mp(args)
     runtime.init_all(0)
     if args.dp:
         return main_dp(args)
@@ -330,6 +439,156 @@ def main_dp(args) -> int:
                         "configs"))
     print(json.dumps({"metric": "dp_epoch_pipeline", "ok": ok,
                       **big["ratios"]}))
+    return 0 if ok else 1
+
+
+def _mp_row(m: dict, epochs: int) -> dict:
+    return {
+        "mode": m["mode"],
+        "epochs": epochs,
+        "wall_s": round(m["wall_s"], 3),
+        "epochs_per_s": round(epochs / m["wall_s"], 3),
+        "h2d_bytes_per_epoch": int(m["h2d_bytes"] / epochs),
+        "setup_h2d_bytes": int(m["setup_h2d_bytes"]),
+        "host_stall_ms_per_epoch": round(m["stage_s"] / epochs * 1e3, 2),
+        "shuffle_ms_per_epoch": round(m["shuffle_s"] / epochs * 1e3, 2),
+        "barrier_ms": round(m["barrier_ms"], 3),
+    }
+
+
+def main_mp(args) -> int:
+    """`make dp-host-bench`: the cross-host zero-restage route (ISSUE
+    18) over args.hosts REAL coordinated CPU processes.  Three
+    measurements on one corpus:
+
+    * resident vs restage -- per-rank row-range shards uploaded once,
+      per-epoch H2D is the replicated int32 slot map (floor: restage
+      moves >= 100x the bytes per epoch), with byte-identical kernels;
+    * snapshot-barrier cost -- the mean wall cost of the coherent
+      global snapshot step's cross-process barrier;
+    * kill-one-rank drill -- rank 1 takes a SIGTERM mid-run (the
+      deterministic HPNN_CKPT_KILL_AT_EPOCH hook), the coordinated stop
+      snapshots on every rank, and a coordinated --resume finishes the
+      run BYTE-IDENTICAL to an uninterrupted reference.
+
+    rc != 0 when any floor misses."""
+    import shutil
+
+    hosts = args.hosts
+    rows = int(args.rows.split(",")[0])
+    batch = args.dp or 250
+    train = args.train or "BP"
+    epochs = args.epochs
+    floors = {"h2d_restage_over_resident_min": 100.0,
+              "resident_parity": True, "resume_byte_exact": True}
+    root = os.path.join(args.dir, f"mp{hosts}")
+    corpus = os.path.join(root, f"c{rows}")
+    gen_corpus(corpus, rows, args.n_in, args.n_out)
+
+    def leg_dir(name: str) -> str:
+        d = os.path.join(root, name)
+        shutil.rmtree(d, ignore_errors=True)
+        os.makedirs(d)
+        with open(os.path.join(d, "nn.conf"), "w") as fp:
+            fp.write(f"[name] bench\n[type] ANN\n[init] generate\n"
+                     f"[seed] 1234\n[input] {args.n_in}\n"
+                     f"[hidden] {args.hidden}\n[output] {args.n_out}\n"
+                     f"[train] {train}\n[batch] {batch}\n"
+                     f"[sample_dir] {corpus}\n")
+        return d
+
+    def must(outs, what):
+        for rank, (rc, out) in enumerate(outs):
+            if rc != 0:
+                print(f"[mp] {what}: rank {rank} rc={rc}\n"
+                      + out[-3000:], flush=True)
+                raise SystemExit(1)
+
+    # prime: a single-process pass builds the pack and warms the caches
+    print(f"[mp] priming pack + caches ({rows} rows) ...", flush=True)
+    must(_mp_launch(leg_dir("prime"), 1, "resident", epochs=1),
+         "prime")
+
+    print(f"[mp] {hosts}-process resident ...", flush=True)
+    d_res = leg_dir("resident")
+    must(_mp_launch(d_res, hosts, "resident", epochs=epochs),
+         "resident")
+    print(f"[mp] {hosts}-process restage (HPNN_NO_EPOCH_PIPELINE=1) ...",
+          flush=True)
+    d_rst = leg_dir("restage")
+    must(_mp_launch(d_rst, hosts, "restage", epochs=epochs,
+                    rank_env=[{"HPNN_NO_EPOCH_PIPELINE": "1"}] * hosts),
+         "restage")
+    with open(os.path.join(d_res, "metrics.resident.json")) as fp:
+        on = _mp_row(json.load(fp), epochs)
+    with open(os.path.join(d_rst, "metrics.restage.json")) as fp:
+        off = _mp_row(json.load(fp), epochs)
+
+    def _read(path: str) -> bytes:
+        with open(path, "rb") as fp:
+            return fp.read()
+
+    parity = (_read(os.path.join(d_res, "kernel.resident.rank0"))
+              == _read(os.path.join(d_rst, "kernel.restage.rank0")))
+
+    # kill-one-rank + coordinated --resume drill (rung 3)
+    kill_epochs = max(epochs, 6)
+    cli_train = ["--epochs", str(kill_epochs), "--ckpt-every", "1",
+                 "--ckpt-dir", "ck", "nn.conf"]
+    print(f"[mp] uninterrupted {kill_epochs}-epoch reference ...",
+          flush=True)
+    d_ref = leg_dir("ref")
+    must(_mp_launch(d_ref, hosts, "cli", cli_args=cli_train), "ref")
+    print("[mp] kill-one-rank (SIGTERM on rank 1 at epoch 2) ...",
+          flush=True)
+    d_kill = leg_dir("kill")
+    rank_env = [{} for _ in range(hosts)]
+    rank_env[-1] = {"HPNN_CKPT_KILL_AT_EPOCH": "2"}
+    must(_mp_launch(d_kill, hosts, "cli", cli_args=cli_train,
+                    rank_env=rank_env), "kill")
+    print("[mp] coordinated --resume ...", flush=True)
+    must(_mp_launch(d_kill, hosts, "cli",
+                    cli_args=["--resume", "ck", "--epochs",
+                              str(kill_epochs), "nn.conf"]), "resume")
+    resume_exact = (_read(os.path.join(d_kill, "kernel.opt"))
+                    == _read(os.path.join(d_ref, "kernel.opt")))
+
+    ratio = (off["h2d_bytes_per_epoch"]
+             / max(on["h2d_bytes_per_epoch"], 1))
+    ratios = {
+        "h2d_restage_over_resident": round(ratio, 2),
+        "host_stall_speedup": round(
+            off["host_stall_ms_per_epoch"]
+            / max(on["host_stall_ms_per_epoch"], 1e-3), 2),
+        "epochs_per_s_speedup": round(
+            on["epochs_per_s"] / max(off["epochs_per_s"], 1e-9), 2),
+    }
+    ok = (ratio >= floors["h2d_restage_over_resident_min"]
+          and parity and resume_exact
+          and on["mode"] == "dp-resident"
+          and off["mode"] == "dp-restage")
+    result = {
+        "note": (f"{hosts} real coordinated CPU processes (gloo "
+                 "collectives, one XLA host device each): per-host "
+                 "resident row-range shards vs per-epoch restage, the "
+                 "snapshot barrier's wall cost, and a kill-one-rank + "
+                 "coordinated --resume byte-exactness drill"),
+        "hosts": hosts,
+        "config": {"rows": rows, "batch": batch, "train": train,
+                   "topology": [args.n_in, args.hidden, args.n_out],
+                   "epochs": epochs},
+        "floors": floors, "ok": ok,
+        "resident": on, "restage": off, "ratios": ratios,
+        "resident_parity_byte_exact": parity,
+        "resume": {"epochs": kill_epochs, "killed_rank": hosts - 1,
+                   "byte_exact": resume_exact},
+    }
+    _write_merged(args.out, {"multi_process": result},
+                  keep=("metric", "train_stub", "note", "floors", "ok",
+                        "configs", "dp"))
+    print(json.dumps({"metric": "mp_epoch_pipeline", "ok": ok,
+                      "resident_parity": parity,
+                      "resume_byte_exact": resume_exact, **ratios}))
     return 0 if ok else 1
 
 

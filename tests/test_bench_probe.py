@@ -207,6 +207,28 @@ def _load_artifact(name):
         return json.load(fp)
 
 
+def test_committed_mp_epoch_bench_rows_hold_floors():
+    """The committed EPOCH_BENCH.json multi_process section (make
+    dp-host-bench, ISSUE 18) stays pinned in tier 1: two REAL
+    coordinated processes where the restage route moves >= 100x the
+    per-epoch bytes of the resident slot-map route with byte-identical
+    kernels, and the kill-one-rank + coordinated --resume drill ended
+    byte-exact against the uninterrupted reference."""
+    art = _load_artifact("EPOCH_BENCH.json")
+    mp = art.get("multi_process")
+    assert mp and mp.get("ok") is True, \
+        "multi_process section missing or red"
+    assert mp["hosts"] >= 2
+    floors = mp["floors"]
+    assert mp["ratios"]["h2d_restage_over_resident"] \
+        >= floors["h2d_restage_over_resident_min"]
+    assert mp["resident"]["mode"] == "dp-resident"
+    assert mp["restage"]["mode"] == "dp-restage"
+    assert mp["resident_parity_byte_exact"] is True
+    assert mp["resume"]["byte_exact"] is True
+    assert mp["resident"]["barrier_ms"] > 0
+
+
 def test_committed_obs_bench_sampled_row_holds_floors():
     """The committed OBS_BENCH.json sampled-tracing row (ISSUE 13)
     stays pinned in tier 1: the --trace-sample 0.01 round held the

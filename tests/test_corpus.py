@@ -351,3 +351,137 @@ def test_prefetch_builds_pack_silently(tmp_path, capsys):
     t2 = corpus.prefetch_pack_async(d, N_IN, N_OUT)
     t2.join(timeout=30)
     assert os.stat(corpus.pack_path(d)).st_mtime_ns == before
+
+
+# --- chunked streaming ingest (ISSUE 18 rung 2) ----------------------------
+
+def _clean_corpus(d, n=9):
+    os.makedirs(d, exist_ok=True)
+    rng = np.random.default_rng(11)
+    for i in range(n):
+        _write_sample(os.path.join(d, f"s{i:03d}"),
+                      rng.uniform(-1, 1, N_IN), rng.uniform(-1, 1, N_OUT))
+
+
+def test_chunked_pack_matches_direct_load(tmp_path, capsys):
+    """A pack assembled chunk-by-chunk (the jobs streaming-upload path)
+    warm-serves the exact rows a direct no-cache load produces, and the
+    warm load really hits the pack."""
+    d = str(tmp_path / "samples")
+    _clean_corpus(d)
+    names = samples.list_sample_dir(d)
+    w = corpus.ChunkedPackWriter(d, N_IN, N_OUT)
+    # three uploads' worth, in listing order
+    assert w.add_sample_files(names[:4])
+    assert w.add_sample_files(names[4:7])
+    assert w.add_sample_files(names[7:])
+    assert w.finalize()
+    assert os.path.exists(corpus.pack_path(d))
+    assert w.n_rows == len(names)
+    # no chunk litter survives finalize
+    sib = os.listdir(os.path.dirname(corpus.pack_path(d)))
+    assert not any(".chunk" in f for f in sib)
+    nn_log.set_verbosity(3)
+    try:
+        warm = _load(d, capsys)
+    finally:
+        nn_log.set_verbosity(0)
+    truth = _load(d, capsys, HPNN_NO_CORPUS_CACHE="1")
+    _assert_same(warm[0], truth[0])
+    assert "(pack" in warm[1].out
+
+
+def test_chunked_pack_skip_classes_replay(tmp_path, capsys):
+    """Chunks carrying skip-class rows (dimension mismatch etc.) bake
+    the same per-file status a whole-dir pack records: the warm replay
+    emits the identical diagnostics."""
+    d = str(tmp_path / "samples")
+    _mixed_corpus(d)
+    names = samples.list_sample_dir(d)
+    w = corpus.ChunkedPackWriter(d, N_IN, N_OUT)
+    assert w.add_sample_files(names[:6])
+    assert w.add_sample_files(names[6:])
+    assert w.finalize()
+    warm = _load(d, capsys)
+    truth = _load(d, capsys, HPNN_NO_CORPUS_CACHE="1")
+    _assert_same(warm[0], truth[0])
+    assert warm[1].out == truth[1].out
+
+
+def test_chunked_pack_detects_chunk_corruption(tmp_path):
+    """A bit-flipped chunk fails its sha256 at finalize: no pack is
+    published and the chunks are cleaned up."""
+    d = str(tmp_path / "samples")
+    _clean_corpus(d)
+    names = samples.list_sample_dir(d)
+    w = corpus.ChunkedPackWriter(d, N_IN, N_OUT)
+    assert w.add_sample_files(names[:5])
+    assert w.add_sample_files(names[5:])
+    chunk = corpus.pack_path(d) + ".chunk00001"
+    with open(chunk, "r+b") as fp:
+        fp.seek(70)
+        byte = fp.read(1)
+        fp.seek(70)
+        fp.write(bytes([byte[0] ^ 0xFF]))
+    assert not w.finalize()
+    assert not os.path.exists(corpus.pack_path(d))
+    assert not os.path.exists(chunk)
+
+
+def test_chunked_pack_reorders_to_listing(tmp_path, capsys):
+    """Upload chunks cannot know the dir's final READDIR order, so
+    finalize reorders rows to the listing at assembly time: chunks fed
+    in ANY order still produce a servable pack."""
+    d = str(tmp_path / "samples")
+    _clean_corpus(d)
+    names = samples.list_sample_dir(d)
+    w = corpus.ChunkedPackWriter(d, N_IN, N_OUT)
+    assert w.add_sample_files(names[5:])
+    assert w.add_sample_files(names[:5])
+    assert w.finalize()
+    warm = _load(d, capsys)
+    truth = _load(d, capsys, HPNN_NO_CORPUS_CACHE="1")
+    _assert_same(warm[0], truth[0])
+
+
+def test_chunked_pack_refuses_listing_drift(tmp_path):
+    """A file that lands in the dir behind the writer's back (or one
+    removed) makes the uploaded set and the listing disagree: finalize
+    refuses rather than bake a pack missing rows."""
+    d = str(tmp_path / "samples")
+    _clean_corpus(d)
+    names = samples.list_sample_dir(d)
+    w = corpus.ChunkedPackWriter(d, N_IN, N_OUT)
+    assert w.add_sample_files(names)
+    _write_sample(os.path.join(d, "sneaky"),
+                  np.zeros(N_IN), np.zeros(N_OUT))
+    assert not w.finalize()
+    assert not os.path.exists(corpus.pack_path(d))
+    # and the chunk litter is gone either way
+    assert not any(".chunk" in f for f in os.listdir(str(tmp_path)))
+
+
+def test_padded_row_block_touches_only_requested_rows(tmp_path, capsys):
+    """The per-rank shard feed (multi-process resident upload): row
+    blocks come back exact for real rows and zero for the padding
+    region, matching the whole-corpus concatenation."""
+    d = str(tmp_path / "samples")
+    _clean_corpus(d)
+    names = samples.list_sample_dir(d)
+    rc = corpus.load_resident(d, names, N_IN, N_OUT)
+    assert rc is not None
+    total = rc.n_rows + 5
+    whole_x = np.concatenate(
+        [rc.X, np.zeros((5, rc.X.shape[1]))], axis=0)
+    whole_t = np.concatenate(
+        [rc.T, np.zeros((5, rc.T.shape[1]))], axis=0)
+    for lo, hi in ((0, 3), (2, rc.n_rows), (rc.n_rows - 1, total),
+                   (rc.n_rows, total), (0, total)):
+        np.testing.assert_array_equal(
+            rc.padded_row_block("x", lo, hi, total), whole_x[lo:hi])
+        np.testing.assert_array_equal(
+            rc.padded_row_block("t", lo, hi, total), whole_t[lo:hi])
+    with pytest.raises(ValueError):
+        rc.padded_row_block("x", 5, 3, total)
+    with pytest.raises(ValueError):
+        rc.padded_row_block("x", 0, total + 1, total)

@@ -1,0 +1,261 @@
+"""Cross-host zero-restage training (ISSUE 18).
+
+The tentpole parity bar: a 2-process ``HPNN_DISTRIBUTED`` run on the
+device-resident epoch pipeline must be BYTE-IDENTICAL -- the ``-vv``
+console stream and the dumped ``kernel.opt`` -- to the same 2-process
+run forced back onto the per-epoch restaging path
+(``HPNN_NO_EPOCH_PIPELINE=1``), and must match a single-process run of
+the identical conf to fp64 collective-reduction tolerance (1e-12).
+Each rank uploads only its own row range of the packed corpus (the
+per-rank shard feeds of ``api._EpochPipeline.build``); the replicated
+shuffle slot map is asserted identical across ranks by the crc32
+agreement fingerprint in ``_train_kernel_pipelined``.
+
+Also pinned here:
+
+* the coherent global snapshot step: ``--resume`` at a world size
+  different from the one stamped into the bundle is refused loudly
+  (``cli._train_nn_body``), exercised fast in-process;
+* multi-process ``[tile]`` confs warn once (rank 0 owns the stream)
+  and land on the supported minibatch-DP engine instead of the
+  single-controller tile engine;
+* a rank whose kernel file is unreadable (not merely missing) drags
+  every rank into the coordinated load bailout -- nobody hangs in a
+  collective waiting for a peer that already died.
+
+The subprocess harness (coordinator wiring, corpus builder, kernel
+loader) is shared with tests/test_multihost.py.
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from test_multihost import (REPO, WORKER, _free_port,  # noqa: F401
+                            _load_weights, _make_corpus, _run_procs)
+
+# drives the multi-epoch checkpoint loop (train_loop) instead of a
+# single train_kernel call: the epoch pipeline engages only under a
+# multi-epoch driver (it needs the persistent shuffle stream), so THIS
+# is the worker that exercises the zero-restage path.  The mode marker
+# prints after WORKER_STREAM_END so stream comparisons can stop at the
+# marker while mode assertions still see it.
+LOOP_WORKER = """
+import os, sys
+sys.path.insert(0, {repo!r})
+from hpnn_tpu import runtime
+rc = runtime.init_all()
+assert rc == 0, "runtime init failed"
+import jax
+from hpnn_tpu import api
+from hpnn_tpu.utils import nn_log
+nn_log.set_verbosity(2)
+os.chdir({workdir!r})
+nn = api.configure(os.environ.get("HPNN_TEST_CONF", "nn.conf"))
+if nn is None:
+    print("WORKER_BAILOUT", jax.process_index(), flush=True)
+    sys.exit(7)
+from hpnn_tpu.ckpt.trainer import train_loop
+epochs = int(os.environ.get("HPNN_TEST_EPOCHS", "3"))
+ok, interrupted = train_loop(nn, epochs)
+if not ok:
+    print("WORKER_TRAINFAIL", jax.process_index(), flush=True)
+    sys.exit(8)
+from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+dump_kernel_to_path(nn.kernel,
+                    "kernel.opt.rank%d" % jax.process_index())
+print("WORKER_STREAM_END", flush=True)
+print("WORKER_MODE", api.EPOCH_METRICS.get("mode"), flush=True)
+print("WORKER_DONE", jax.process_index(), flush=True)
+"""
+
+
+def _stream(out: str) -> str:
+    """The comparable console stream: everything before the worker's
+    end-of-stream marker."""
+    return out.split("WORKER_STREAM_END", 1)[0]
+
+
+def _run_loop_single(workdir, extra_env=None):
+    import subprocess
+
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+    })
+    for var in ("HPNN_DISTRIBUTED", "HPNN_COORDINATOR",
+                "HPNN_NUM_PROCESSES", "HPNN_PROCESS_ID"):
+        env.pop(var, None)
+    if extra_env:
+        env.update(extra_env)
+    code = LOOP_WORKER.format(repo=REPO, nprocs=1, workdir=str(workdir))
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       cwd=str(workdir), capture_output=True, text=True,
+                       timeout=420)
+    assert r.returncode == 0, r.stderr[-3000:]
+    return r
+
+
+def test_two_process_resident_matches_restage_and_single(tmp_path):
+    """The ISSUE 18 rung-1 acceptance: 2-process resident == 2-process
+    restage byte-identical (stream + kernel), == single-process at
+    1e-12."""
+    res, rst, one = tmp_path / "res", tmp_path / "rst", tmp_path / "one"
+    for d in (res, rst, one):
+        _make_corpus(str(d))
+
+    outs_res = _run_procs(str(res), 2, timeout=420, worker=LOOP_WORKER)
+    no_pipe = [{"HPNN_NO_EPOCH_PIPELINE": "1"} for _ in range(2)]
+    outs_rst = _run_procs(str(rst), 2, rank_env=no_pipe, timeout=420,
+                          worker=LOOP_WORKER)
+    r_one = _run_loop_single(one)
+
+    for tag, outs in (("resident", outs_res), ("restage", outs_rst)):
+        for rank, (rc, out, err) in enumerate(outs):
+            assert rc == 0, (tag, rank, rc, err[-3000:])
+            assert f"WORKER_DONE {rank}" in out, (tag, rank, out[-500:])
+
+    # the engine taken is the one claimed: resident rode the pipeline,
+    # the escape hatch really forced per-epoch restaging
+    assert "WORKER_MODE dp-resident" in outs_res[0][1]
+    assert "WORKER_MODE dp-restage" in outs_rst[0][1]
+    assert "WORKER_MODE dp-resident" in r_one.stdout
+
+    # -vv stream byte parity, resident vs restage (rank 0 owns the
+    # stream; peers stay silent either way)
+    assert _stream(outs_res[0][1]) == _stream(outs_rst[0][1])
+    assert "TRAINING BATCH" in _stream(outs_res[0][1])
+    for outs in (outs_res, outs_rst):
+        assert "TRAINING BATCH" not in outs[1][1]
+
+    # kernel byte parity resident vs restage, rank agreement, and the
+    # fp64 tolerance bar against the single-process reference
+    k = {}
+    for tag, d in (("res", res), ("rst", rst), ("one", one)):
+        k[tag] = [_load_weights(str(d / f"kernel.opt.rank{r}"))
+                  for r in ([0, 1] if tag != "one" else [0])]
+    with open(res / "kernel.opt.rank0", "rb") as fa, \
+            open(rst / "kernel.opt.rank0", "rb") as fb:
+        assert fa.read() == fb.read()
+    for tag in ("res", "rst"):
+        for wa, wb in zip(k[tag][0], k[tag][1]):
+            np.testing.assert_array_equal(wa, wb)
+    for wa, wb in zip(k["res"][0], k["one"][0]):
+        np.testing.assert_allclose(wa, wb, rtol=0, atol=1e-12)
+
+
+def test_multi_process_tile_conf_warns_and_keeps_dp(tmp_path):
+    """[tile] under HPNN_DISTRIBUTED: the single-controller tile engine
+    is refused with ONE warning (rank 0 owns the stream, peers are
+    gated silent) and the run lands on the minibatch-DP engine."""
+    work = tmp_path / "tile"
+    _make_corpus(str(work))
+    conf = work / "nn.conf"
+    conf.write_text(conf.read_text().replace("[batch] 6",
+                                             "[batch] 6\n[tile] 4"))
+    outs = _run_procs(str(work), 2, timeout=420, worker=LOOP_WORKER)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 0, (rank, rc, err[-3000:])
+        assert f"WORKER_DONE {rank}" in out
+    warn = "[tile] engine is single-controller"
+    assert outs[0][1].count(warn) == 1, outs[0][1][-2000:]
+    assert warn not in outs[1][1] and warn not in outs[1][2]
+    # the supported engine, not a crash and not the tile engine: the
+    # multi-process gate keeps [tile] confs on per-epoch restage DP
+    assert "WORKER_MODE dp-restage" in outs[0][1]
+    assert "TRAINING BATCH" in outs[0][1]
+
+
+def test_two_process_unreadable_kernel_coordinated_bailout(tmp_path):
+    """Rank 1's [init] kernel path exists but cannot be READ (a
+    directory -- chmod is void under root); the coordinated load gate
+    must pull BOTH ranks out with the diagnostic, no hang."""
+    work = tmp_path / "bad"
+    _make_corpus(str(work))
+
+    # a real kernel for rank 0, an unreadable path for rank 1
+    sys.path.insert(0, REPO)
+    from hpnn_tpu.api import generate_kernel
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+
+    kern, _seed = generate_kernel(10958, 10, [6], 4)
+    dump_kernel_to_path(kern, str(work / "kernel.good"))
+    os.makedirs(work / "kernel.unreadable")
+    base = (work / "nn.conf").read_text()
+    (work / "nn0.conf").write_text(
+        base.replace("[init] generate", "[init] ./kernel.good"))
+    (work / "nn1.conf").write_text(
+        base.replace("[init] generate", "[init] ./kernel.unreadable"))
+
+    outs = _run_procs(str(work), 2, timeout=300, rank_env=[
+        {"HPNN_TEST_CONF": "nn0.conf"},
+        {"HPNN_TEST_CONF": "nn1.conf"},
+    ], worker=LOOP_WORKER)
+    for rank, (rc, out, err) in enumerate(outs):
+        assert rc == 7, (rank, rc, out[-500:], err[-2000:])
+        assert f"WORKER_BAILOUT {rank}" in out
+    joined = "".join(o + e for _, o, e in outs)
+    assert "load failed on process(es) [1]" in joined
+
+
+def test_resume_refuses_mismatched_world_size(tmp_path, monkeypatch,
+                                              capsys):
+    """The coherent-global-step stamp (rung 3): a bundle written by an
+    N-process run refuses to resume at any other world size, loudly."""
+    sys.path.insert(0, REPO)
+    from hpnn_tpu import cli
+    from hpnn_tpu.ckpt import snapshot as snap
+    from hpnn_tpu.utils import nn_log
+
+    _make_corpus(str(tmp_path))
+    monkeypatch.chdir(tmp_path)
+    nn_log.set_verbosity(0)
+    rc = cli.train_nn_main(["--epochs=1", "--ckpt-every=1",
+                            "--ckpt-dir=ck", "nn.conf"])
+    assert rc == 0
+    capsys.readouterr()
+
+    # restamp the bundle as written by a 2-process run: same state,
+    # world_size=2 (write_snapshot re-snapshots the epoch atomically,
+    # publish refreshes the manifest fingerprints)
+    st = snap.load_snapshot("ck")
+    assert st is not None and st.world_size == 1
+    entry = snap.write_snapshot(
+        "ck", st.epoch, weights=st.weights, momentum=st.momentum,
+        rng_state=st.rng_state, seed=st.seed, errors=st.errors,
+        name="mh", train="BP", target_epochs=st.target_epochs,
+        world_size=2)
+    snap.publish_snapshot("ck", entry, seed=st.seed, errors=st.errors)
+    assert snap.load_snapshot("ck").world_size == 2
+
+    rc = cli.train_nn_main(["--epochs=3", "--resume", "--ckpt-dir=ck",
+                            "nn.conf"])
+    err = capsys.readouterr().err
+    assert rc == -1
+    assert "written by a 2-process run" in err
+    assert "1 process(es)" in err
+    nn_log.set_verbosity(0)
+
+
+def test_legacy_bundle_defaults_to_world_size_one(tmp_path):
+    """Bundles written before the stamp existed must keep resuming on
+    single-process runs: a meta without ``world_size`` loads as 1."""
+    sys.path.insert(0, REPO)
+    import json
+
+    from hpnn_tpu.ckpt import snapshot as snap
+
+    w = [np.zeros((3, 4)), np.zeros((2, 4))]
+    entry = snap.write_snapshot(str(tmp_path), 1, weights=w,
+                                momentum=None, rng_state=None, seed=7,
+                                errors=[0.1])
+    bundle = tmp_path / entry["tag"]
+    meta = json.loads((bundle / "snapshot.json").read_text())
+    assert meta["world_size"] == 1 and meta["barrier_epoch"] is None
+    del meta["world_size"]
+    (bundle / "snapshot.json").write_text(json.dumps(meta))
+    st = snap._load_bundle_state(str(bundle))
+    assert st is not None and st.world_size == 1

@@ -17,6 +17,7 @@ import os
 import sys
 import threading
 import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -811,6 +812,178 @@ def test_multipart_corpus_upload_trains(tmp_path):
         assert len(os.listdir(cdir)) == 6
         assert snap["params"]["samples"] == cdir
         assert os.path.isfile(os.path.join(snap["path"], "kernel.opt"))
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+# --- chunked streaming corpus upload (ISSUE 18 rung 2) ----------------------
+
+def _mp_body(params, files, boundary="hpnnChunkBoundary"):
+    """multipart/form-data body: optional ``params`` JSON field plus
+    corpus file parts.  Returns (body_bytes, content_type)."""
+    chunks = []
+    if params is not None:
+        chunks.append(
+            f'--{boundary}\r\n'
+            'Content-Disposition: form-data; name="params"\r\n\r\n'
+            + json.dumps(params) + "\r\n")
+    for name, text in files:
+        chunks.append(
+            f'--{boundary}\r\n'
+            'Content-Disposition: form-data; name="corpus"; '
+            f'filename="{name}"\r\n'
+            'Content-Type: application/octet-stream\r\n\r\n'
+            + text + "\r\n")
+    chunks.append(f"--{boundary}--\r\n")
+    return ("".join(chunks).encode(),
+            f"multipart/form-data; boundary={boundary}")
+
+
+def _post_mp(base, path, params, files, timeout=60):
+    """POST a multipart body; returns (status, parsed-json, headers) and
+    folds HTTP errors into the same shape instead of raising."""
+    body, ctype = _mp_body(params, files)
+    req = urllib.request.Request(base + path, data=body,
+                                 headers={"Content-Type": ctype})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read()), dict(
+                resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read()), dict(exc.headers)
+
+
+def test_chunked_upload_end_to_end(tmp_path):
+    """The streaming path: submit on chunk 1, append a chunk, bare
+    ``?final=1`` close -- the job trains on the FULL corpus, the
+    incremental pack lands, the chunk counter shows in /metrics, and the
+    result is byte-identical to a single-shot submit of the same
+    corpus."""
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    params = {"epochs": 2, "seed": 3, "train": "BP", "ckpt_every": 1}
+    files = [(f"s{i:03d}", _sample_text(i)) for i in range(6)]
+    try:
+        st, job, _ = _post_mp(base, "/v1/kernels/tiny/train/chunked",
+                              params, files[:3])
+        assert st == 202, job
+        jid = job["job_id"]
+        assert job["upload"] == {"endpoint": f"/v1/jobs/{jid}/corpus",
+                                 "chunks": 1, "complete": False}
+        st, out, _ = _post_mp(base, f"/v1/jobs/{jid}/corpus",
+                              None, files[3:])
+        assert (st, out) == (200, {"job": jid, "chunks": 2,
+                                   "complete": False})
+        st, out, _ = _post_mp(base, f"/v1/jobs/{jid}/corpus?final=1",
+                              None, [])
+        assert (st, out) == (200, {"job": jid, "chunks": 3,
+                                   "complete": True})
+        snap = _wait_terminal(base, jid)
+        assert snap["status"] == "done", snap
+        cdir = os.path.join(snap["path"], "corpus")
+        assert sorted(os.listdir(cdir)) == [n for n, _ in files]
+        # the incremental pack was assembled next to the corpus dir
+        assert os.path.isfile(os.path.join(snap["path"],
+                                           ".corpus.hpnn.pack"))
+        assert not any(n.startswith(".corpus.chunk")
+                       for n in os.listdir(snap["path"]))
+        # upload-hold marker cleared before training
+        assert not os.path.exists(os.path.join(snap["path"],
+                                               ".upload-incomplete"))
+        # parity: a single-shot submit of the SAME corpus/params is
+        # byte-identical -- the chunked pack replays the same rows
+        st, job2, _ = _post_mp(base, "/v1/kernels/tiny/train",
+                               params, files)
+        assert st == 202, job2
+        snap2 = _wait_terminal(base, job2["job_id"])
+        assert snap2["status"] == "done", snap2
+        with open(os.path.join(snap["path"], "kernel.opt"), "rb") as fp:
+            k1 = fp.read()
+        with open(os.path.join(snap2["path"], "kernel.opt"),
+                  "rb") as fp:
+            k2 = fp.read()
+        assert k1 == k2
+        with urllib.request.urlopen(base + "/metrics",
+                                    timeout=30) as resp:
+            metrics = resp.read().decode()
+        assert "hpnn_jobs_upload_chunks_total 3" in metrics
+        # the upload session is closed: more chunks are refused
+        st, out, _ = _post_mp(base, f"/v1/jobs/{jid}/corpus?final=1",
+                              None, [])
+        assert st == 409, out
+        st, out, _ = _post_mp(base, "/v1/jobs/nope/corpus", None,
+                              files[:1])
+        assert st == 404, out
+        st, out, _ = _post_mp(base, "/v1/kernels/tiny/train/chunked",
+                              params, [])
+        assert st == 400 and "chunk 1" in out["error"], out
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_oversized_submit_413_points_at_chunked(tmp_path, monkeypatch):
+    """HPNN_JOBS_MAX_BODY_MB: an over-cap single-shot submit is refused
+    from its Content-Length -- 413, the hint and header name the chunked
+    endpoint -- and the server keeps serving afterwards."""
+    monkeypatch.setenv("HPNN_JOBS_MAX_BODY_MB", "1")
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    params = {"epochs": 1, "seed": 3, "train": "BP"}
+    try:
+        big = [("s000", _sample_text(0) + "#" * (1 << 20) + "\n")]
+        st, out, hdrs = _post_mp(base, "/v1/kernels/tiny/train",
+                                 params, big)
+        assert st == 413, out
+        assert "HPNN_JOBS_MAX_BODY_MB" in out["error"]
+        assert "/v1/kernels/tiny/train/chunked" in out["hint"]
+        assert (hdrs.get("X-HPNN-Chunked-Endpoint")
+                == "/v1/kernels/tiny/train/chunked")
+        # an in-cap submit on a FRESH connection still works
+        files = [(f"s{i:03d}", _sample_text(i)) for i in range(6)]
+        st, job, _ = _post_mp(base, "/v1/kernels/tiny/train", params,
+                              files)
+        assert st == 202, job
+        snap = _wait_terminal(base, job["job_id"])
+        assert snap["status"] == "done", snap
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_chunked_upload_timeout_fails_job(tmp_path, monkeypatch):
+    """A chunked upload that never closes fails LOUDLY once the runner's
+    bounded wait (HPNN_JOBS_UPLOAD_WAIT_S) expires -- the job can never
+    train on a partial corpus."""
+    monkeypatch.setenv("HPNN_JOBS_UPLOAD_WAIT_S", "1")
+    conf, _ = _serve_conf(tmp_path)
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    params = {"epochs": 1, "seed": 3, "train": "BP"}
+    files = [(f"s{i:03d}", _sample_text(i)) for i in range(3)]
+    try:
+        st, job, _ = _post_mp(base, "/v1/kernels/tiny/train/chunked",
+                              params, files)
+        assert st == 202, job
+        snap = _wait_terminal(base, job["job_id"], timeout_s=30.0)
+        assert snap["status"] == "failed", snap
+        assert "corpus upload incomplete" in snap["error"]
+        # the abandoned session is gone: a late chunk is a 400
+        st, out, _ = _post_mp(
+            base, f"/v1/jobs/{job['job_id']}/corpus?final=1", None, [])
+        assert st in (400, 409), out
     finally:
         httpd.shutdown()
         app.close(drain=True)
