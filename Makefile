@@ -125,6 +125,16 @@ jobs-bench:
 	env JAX_PLATFORMS=cpu python scripts/jobs_bench.py \
 	    --out JOBS_BENCH.json
 
+# mesh-slice concurrency capture (ISSUE 19): two pinned 4-device jobs
+# serialized vs concurrent on disjoint slices under one sustained eval
+# load -- speedup >= 1.3x, zero non-200s, concurrent eval p99 within
+# the serialized window's ceiling, identical error trajectories.
+# Merges the "concurrency" section into JOBS_BENCH.json without
+# re-running the recovery phase; rc!=0 when a floor misses
+jobs-slice-bench:
+	env JAX_PLATFORMS=cpu python scripts/jobs_bench.py \
+	    --concurrency-only --out JOBS_BENCH.json
+
 # snapshot overhead (sync vs async io_pool writes) + hot-reload latency
 # under a client load; emits CKPT_BENCH.json
 ckpt-bench:
@@ -262,7 +272,8 @@ obs-bench:
 	    $(if $(REAL),--real)
 
 .PHONY: check check-all serve-check mesh-check chaos-check ckpt-check \
-    ckpt-bench jobs-check jobs-bench obs-check obs-bench native bench \
+    ckpt-bench jobs-check jobs-bench jobs-slice-bench obs-check \
+    obs-bench native bench \
     serve-bench io-bench epoch-bench dp-epoch-bench dp-host-bench \
     mfu-bench \
     mesh-bench autoscale-check trace-check lnn-check trainers-bench \

@@ -7,8 +7,8 @@ Usage: serve_nn [-v]... [-a addr] [-p port] [-b max-batch] [-q queue-rows]
                 [--compile-cache DIR]
                 [--warmup-mode background|sync|off] [--no-warmup]
                 [--watch-ckpt [NAME=]DIR] [--watch-interval S]
-                [--jobs N] [--job-dir DIR] [--ab-fraction F]
-                [--auth-token TOKEN]
+                [--jobs N] [--job-workers K] [--job-dir DIR]
+                [--ab-fraction F] [--auth-token TOKEN]
                 [--mesh-role router|worker|standby] [--router HOST:PORT]
                 [--advertise HOST:PORT] [--workers N]
                 [--quota-rows F] [--quota-burst N]
@@ -23,7 +23,10 @@ backpressure semantics, and the parity/mesh policy knobs.  With
 ``--jobs N`` the server also trains: POST /v1/kernels/<name>/train
 submits an online training job (hpnn_tpu/jobs) whose epoch-boundary
 snapshots hot-swap into serving with A/B generation pinning -- the
-README "Online training service" section has the walkthrough.  With
+README "Online training service" section has the walkthrough; with
+``--job-workers K`` up to K jobs train CONCURRENTLY, each pinned to a
+disjoint device slice of the mesh (hpnn_tpu/jobs/placement; the README
+"Multi-job scheduling" section has the two-pinned-jobs walkthrough).  With
 ``--mesh-role`` the server joins a multi-host serve mesh
 (hpnn_tpu/serve/mesh): a router fans requests over registered worker
 hosts with failover and fleet-coherent hot reload -- the README

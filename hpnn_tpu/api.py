@@ -313,6 +313,63 @@ def reset_epoch_metrics() -> None:
                          tp_devices=1, weight_bytes_per_device=0)
 
 
+# --------------------------------------------------------------------------
+# Device-slice pinning (ISSUE 19).  A training run normally sees the whole
+# process device list (bounded by the HPNN_DP_DEVICES / HPNN_TP_DEVICES env
+# knobs); the multi-job placement scheduler instead pins each concurrent job
+# to a DISJOINT slice of that list.  The slice is thread-local -- each job
+# worker thread wraps its ``train_job`` run in ``device_slice(devices)`` and
+# every mesh/device decision below (``_dp_device_count``, the epoch
+# pipeline's DP/TP branches, ``_clamped_model_mesh``, the restage DP
+# trainers, train/cg.py) consults ``slice_devices()`` first.  An explicit
+# slice WINS over the env knobs: the knobs bound the default
+# (whole-process) slice only, so a pinned 4-device job on an 8-device host
+# trains byte-identically to a serial run under ``HPNN_DP_DEVICES=4``.
+
+import contextlib as _contextlib
+import threading as _threading
+
+_DEVICE_SLICE = _threading.local()
+
+
+def slice_devices() -> list | None:
+    """This thread's pinned device slice, or None (whole process)."""
+    return getattr(_DEVICE_SLICE, "devices", None)
+
+
+@_contextlib.contextmanager
+def device_slice(devices):
+    """Pin every mesh/device decision on THIS thread to ``devices``.
+
+    Nest-safe (the previous slice is restored) and a no-op for a
+    falsy device list.  Also makes ``devices[0]`` the thread's JAX
+    default device so unsharded intermediates of a 1-device job land
+    on its own slice instead of device 0.
+    """
+    if not devices:
+        yield
+        return
+    import jax
+
+    prev = getattr(_DEVICE_SLICE, "devices", None)
+    _DEVICE_SLICE.devices = list(devices)
+    try:
+        with jax.default_device(devices[0]):
+            yield
+    finally:
+        _DEVICE_SLICE.devices = prev
+
+
+def _visible_device_count() -> int:
+    """``jax.device_count()`` bounded by the thread's pinned slice."""
+    sl = slice_devices()
+    if sl is not None:
+        return len(sl)
+    import jax
+
+    return jax.device_count()
+
+
 def _dp_device_count() -> int:
     """Device count for the [batch] DP routes: every visible device,
     capped by ``HPNN_DP_DEVICES`` (operators pinning a run to a mesh
@@ -321,14 +378,16 @@ def _dp_device_count() -> int:
     cap IS the data-axis width; on the hybrid [model]+[batch] route it
     caps the WHOLE (data x model) grid -- the model axis keeps its
     share, so ``HPNN_DP_DEVICES=4`` with ``[model] 2`` yields a 2x2
-    grid, not a 4x2 one."""
+    grid, not a 4x2 one.  A thread-local ``device_slice`` pin wins
+    outright: the slice length IS the grid, env knobs untouched."""
+    sl = slice_devices()
+    if sl is not None:
+        return len(sl)
     import jax
 
-    from .utils.env import env_int
+    from .utils.env import env_device_cap
 
-    ndev = jax.device_count()
-    cap = env_int("HPNN_DP_DEVICES", 0)
-    return max(1, min(ndev, cap)) if cap > 0 else ndev
+    return env_device_cap("HPNN_DP_DEVICES", jax.device_count())
 
 
 def _dp_slot_map(s: int, bsz: int, n_batches: int, bsz_pad: int):
@@ -540,25 +599,24 @@ class _EpochPipeline:
             if ndev > 1:
                 from .parallel import make_mesh
 
-                mesh = make_mesh(n_data=ndev // n_model, n_model=n_model)
+                mesh = make_mesh(n_data=ndev // n_model, n_model=n_model,
+                                 devices=slice_devices())
                 n_data = ndev // n_model
         elif shards > 1:
             # pure [model]: the per-sample TP route rides the pipeline on
             # a 1xN model mesh (even N==1 after clamping -- the engine is
             # the same, which keeps kill/--resume byte-exact)
-            import jax
-
             from .parallel import make_mesh
 
             tp = True
-            ndev = jax.device_count()
+            ndev = _visible_device_count()
             k = min(shards, ndev)
             if shards > ndev:
                 # _clamped_model_mesh's exact warning, re-emitted per
                 # epoch (the restage route warns every epoch)
                 tp_warn = (f"[model] {shards} > {ndev} visible "
                            f"device(s); using {ndev}\n")
-            mesh = make_mesh(n_data=1, n_model=k)
+            mesh = make_mesh(n_data=1, n_model=k, devices=slice_devices())
             n_model = k
         shard_rows = 0
         if os.environ.get("HPNN_EPOCH_SHARD_ROWS"):
@@ -1541,17 +1599,18 @@ def _emit_training_lines(events, stats, kind: str, momentum: bool) -> dict:
 
 def _clamped_model_mesh(shards: int):
     """(mesh, shards) for an N-way model axis, clamped to visible devices
-    with a warning -- shared by the TP train and eval routes."""
-    import jax
-
+    with a warning -- shared by the TP train and eval routes.  Honors a
+    thread-local ``device_slice`` pin (the warning then counts the
+    slice's devices, matching what the mesh is actually built over)."""
     from .parallel import make_mesh
 
-    ndev = jax.device_count()
+    ndev = _visible_device_count()
     if shards > ndev:
         nn_warn(f"[model] {shards} > {ndev} visible device(s); "
                 f"using {ndev}\n")
         shards = ndev
-    return make_mesh(n_data=1, n_model=shards), shards
+    return make_mesh(n_data=1, n_model=shards,
+                     devices=slice_devices()), shards
 
 
 def _train_kernel_tp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
@@ -1693,7 +1752,8 @@ def _train_kernel_dp(nn: NNDef, weights, xs, ts, kind: str, momentum: bool,
     if clamp_warn:
         nn_warn(clamp_warn)
     if ndev > 1:
-        mesh = make_mesh(n_data=ndev // n_model, n_model=n_model)
+        mesh = make_mesh(n_data=ndev // n_model, n_model=n_model,
+                         devices=slice_devices())
     else:
         mesh = None
     if mesh is not None and n_model > 1:
@@ -1795,7 +1855,8 @@ def _train_kernel_dp_tiled(nn: NNDef, weights, xs, ts, kind: str,
     launch_groups = req if req > 0 else 0
     storage = _tile_storage_env()
     ndev = _dp_device_count()
-    mesh = make_mesh(n_data=ndev, n_model=1) if ndev > 1 else None
+    mesh = (make_mesh(n_data=ndev, n_model=1, devices=slice_devices())
+            if ndev > 1 else None)
     pad_to = mesh.shape["data"] if mesh is not None else 1
     nn_out(_dp_tiled_banner(group, pad_to, meshed=mesh is not None,
                             storage=storage))
@@ -1971,7 +2032,7 @@ def train_job(conf_path: str, *, epochs: int, ckpt_dir: str,
               ckpt_every: int = 1, ckpt_keep: int = 0,
               kernel_out: str | None = None, resume: str | None = None,
               stop=None, on_epoch=None, replicate_to: str | None = None,
-              auth_token: str | None = None) -> dict:
+              auth_token: str | None = None, devices=None) -> dict:
     """Reentrant in-process training entry (the jobs subsystem's driver).
 
     The exact ``train_nn`` checkpoint path -- configure, multi-epoch
@@ -1992,11 +2053,32 @@ def train_job(conf_path: str, *, epochs: int, ckpt_dir: str,
     through to :func:`ckpt.trainer.train_loop` (external cancel +
     epoch-boundary callback).
 
+    ``devices`` pins the whole run -- configure, every epoch, the final
+    dump -- to an explicit device slice via :func:`device_slice` (the
+    placement scheduler's hook): mesh construction sees only the slice,
+    so a 4-device pinned run is byte-identical to a serial run on any
+    same-sized slice.  None keeps the whole-process view bounded by the
+    env knobs.
+
     Returns ``{"ok", "interrupted", "epoch", "errors", "error"}`` --
     never raises for config/corpus problems (the scheduler maps the
     dict to a job status); checkpoint WRITER failures do raise, exactly
     like the CLI's flush-before-done contract.
     """
+    with device_slice(devices):
+        return _train_job_pinned(
+            conf_path, epochs=epochs, ckpt_dir=ckpt_dir,
+            ckpt_every=ckpt_every, ckpt_keep=ckpt_keep,
+            kernel_out=kernel_out, resume=resume, stop=stop,
+            on_epoch=on_epoch, replicate_to=replicate_to,
+            auth_token=auth_token)
+
+
+def _train_job_pinned(conf_path: str, *, epochs: int, ckpt_dir: str,
+                      ckpt_every: int, ckpt_keep: int,
+                      kernel_out: str | None, resume: str | None,
+                      stop, on_epoch, replicate_to: str | None,
+                      auth_token: str | None) -> dict:
     from .ckpt import CheckpointManager, load_snapshot, train_loop
     from .io.kernel_io import dump_kernel_to_path
 

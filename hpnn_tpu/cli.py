@@ -632,10 +632,18 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
     ap.add_argument("--jobs", type=int, default=0, metavar="N",
                     help="enable the online training service with an "
                     "N-job bounded queue (POST /v1/kernels/<name>/train; "
-                    "0: disabled).  One scheduler worker time-slices the "
+                    "0: disabled).  Scheduler workers time-slice the "
                     "device against eval traffic at epoch granularity "
-                    "and hot-swaps every epoch-boundary snapshot into "
+                    "and hot-swap every epoch-boundary snapshot into "
                     "serving")
+    ap.add_argument("--job-workers", type=int, default=None, metavar="K",
+                    help="(with --jobs) concurrent training jobs: K "
+                    "scheduler workers each pin their job to a disjoint "
+                    "best-fit device slice of the mesh (submit params "
+                    "dp_devices/tp_devices/model_parallel size the ask; "
+                    "undeclared jobs share the mesh evenly).  Default: "
+                    "$HPNN_JOB_WORKERS or 1 (the single-worker "
+                    "whole-mesh behavior)")
     ap.add_argument("--job-dir", default="./jobs", metavar="DIR",
                     help="persistent job state/corpus/checkpoint root "
                     "(default ./jobs); a restarted server reports the "
@@ -953,19 +961,26 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
             return -1
         app.watch_manifest(wname, wdir, interval_s=args.watch_interval)
     if args.jobs > 0:
+        from .utils.env import env_int
+
         app.enable_jobs(args.job_dir, capacity=args.jobs,
                         auto_promote=args.auto_promote,
                         auto_resume=args.job_auto_resume or None,
-                        replicate_to=args.replicate_to)
+                        replicate_to=args.replicate_to,
+                        job_workers=args.job_workers
+                        or env_int("HPNN_JOB_WORKERS", 1, lo=1))
         tok = "on" if auth_token else "OFF (pass --auth-token)"
         promo = ", auto-promote" if args.auto_promote else ""
         res = ", auto-resume" if app.jobs.auto_resume else ""
         rep = (f", replicate-to={app.jobs.replicate_to}"
                if app.jobs.replicate_to else "")
+        wrk = (f", workers={app.jobs.workers} over "
+               f"{app.jobs.slices.n} device(s)"
+               if app.jobs.workers > 1 else "")
         sys.stdout.write(f"SERVE: online training enabled "
                          f"(queue={args.jobs}, job-dir={args.job_dir}, "
                          f"ab-fraction={args.ab_fraction:g}, "
-                         f"auth={tok}{promo}{res}{rep})\n")
+                         f"auth={tok}{promo}{res}{rep}{wrk})\n")
     elif args.auto_promote:
         sys.stderr.write("serve: --auto-promote is inert without "
                          "--jobs N (ignored)\n")

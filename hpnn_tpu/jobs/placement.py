@@ -1,0 +1,237 @@
+"""Device-slice placement for concurrent training jobs (ISSUE 19).
+
+One serve process owns one device list (the 8-device CPU mesh in
+tests, a TPU pod slice in production).  The job scheduler used to
+serialize every training job over the WHOLE list; this module is the
+allocator that lets K scheduler workers run K jobs at once, each
+pinned to a DISJOINT contiguous slice:
+
+* :class:`SliceManager` owns the device list and a free/busy bitmap.
+  ``acquire`` carves a **best-fit contiguous** run (the smallest free
+  run that fits, lowest index on ties -- contiguity matters on real
+  hardware where slice-local ICI beats hopping the pod, and best-fit
+  keeps large runs intact for large asks).
+* Grants are **strict FIFO**: a request is granted only when it is the
+  oldest pending request.  That is the whole fairness story -- a
+  whole-mesh ask parks at the head and DRAINS the mesh (later small
+  asks queue behind it instead of starving it forever), and no job can
+  leapfrog an older one just because its ask is smaller.
+* Slices are reclaimed three ways: the owning worker's ``release`` on
+  every terminal path, ``reclaim`` (the scheduler-tick sweep that
+  frees any slice whose owner is no longer a live running job --
+  defense against a leaked owner, the multi-job analog of a stuck
+  queue), and ``close`` (drain).
+
+The slice a job gets determines its training mesh: the worker wraps
+``api.train_job(..., devices=slice.devices)`` so every mesh decision
+(api.device_slice) sees exactly those devices.  ``dp``/``tp`` on the
+placement are bookkeeping for operators (/v1/jobs, /metrics) -- the
+authoritative grid is still the job's conf ([batch]/[model]) against
+the slice length.
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def plan_request(params: dict, n_devices: int) -> tuple[int, int]:
+    """(slice_size, tp_width) asked for by a job's params.
+
+    ``dp_devices`` * ``tp_devices`` (``model_parallel`` doubles as the
+    TP width when ``tp_devices`` is absent -- it is the conf knob that
+    actually shards rows).  0 size means "no declaration": the manager
+    hands out its fair default share.  Over-asks clamp to the mesh --
+    the placement analog of the ``[model]``/``HPNN_*_DEVICES`` clamp
+    warnings, except a slice ask is validated at submit time.
+    """
+    dp = int(params.get("dp_devices") or 0)
+    tp = int(params.get("tp_devices") or params.get("model_parallel") or 0)
+    if dp <= 0 and tp <= 0:
+        return 0, 1
+    tp = max(1, tp)
+    size = max(1, dp) * tp
+    if size > n_devices:
+        size = n_devices
+    if tp > size:
+        tp = size
+    return size, tp
+
+
+class SlicePlacement:
+    """One granted slice: the contiguous device run a job is pinned to."""
+
+    __slots__ = ("job_id", "devices", "start", "size", "dp", "tp")
+
+    def __init__(self, job_id: str, devices: list, start: int,
+                 size: int, tp: int = 1):
+        self.job_id = job_id
+        self.devices = list(devices)
+        self.start = start
+        self.size = size
+        self.tp = max(1, min(tp, size))
+        self.dp = max(1, size // self.tp)
+
+    def describe(self) -> dict:
+        """JSON-safe record carried on the job (/v1/jobs, events)."""
+        return {"devices": [getattr(d, "id", i + self.start)
+                            for i, d in enumerate(self.devices)],
+                "dp": self.dp, "tp": self.tp, "size": self.size}
+
+
+class SliceManager:
+    """Best-fit contiguous slice allocator with strict-FIFO granting."""
+
+    def __init__(self, devices=None, workers: int = 1):
+        if devices is None:
+            import jax
+
+            devices = jax.devices()
+        self.devices = list(devices)
+        self.n = len(self.devices)
+        self.workers = max(1, int(workers))
+        self._free = [True] * self.n
+        self._owners: dict[str, SlicePlacement] = {}
+        self._pending: list[dict] = []
+        self._cv = threading.Condition()
+        self._closed = False
+
+    # -- sizing --------------------------------------------------------
+
+    def default_share(self) -> int:
+        """Fair share for an undeclared job: the mesh split evenly over
+        the worker pool (every worker can hold a default job at once)."""
+        return max(1, self.n // self.workers)
+
+    def request_size(self, params: dict) -> tuple[int, int]:
+        """(size, tp) for a job's params; size 0 -> the fair share."""
+        size, tp = plan_request(params or {}, self.n)
+        if size <= 0:
+            size = self.default_share()
+        return size, tp
+
+    # -- allocation ----------------------------------------------------
+
+    def _best_fit(self, size: int) -> int | None:
+        """Start index of the smallest free contiguous run >= size."""
+        best = None
+        best_len = None
+        i = 0
+        while i < self.n:
+            if not self._free[i]:
+                i += 1
+                continue
+            j = i
+            while j < self.n and self._free[j]:
+                j += 1
+            run = j - i
+            if run >= size and (best_len is None or run < best_len):
+                best, best_len = i, run
+            i = j
+        return best
+
+    def try_acquire(self, job_id: str, size: int = 0,
+                    tp: int = 1) -> SlicePlacement | None:
+        """Non-blocking acquire; still queues behind older waiters
+        (returns None rather than leapfrog the FIFO)."""
+        with self._cv:
+            if self._closed or job_id in self._owners:
+                return None
+            if self._pending:
+                return None
+            return self._grant(job_id, size, tp)
+
+    def acquire(self, job_id: str, size: int = 0, tp: int = 1,
+                stop: threading.Event | None = None,
+                timeout_s: float | None = None) -> SlicePlacement | None:
+        """Block until this request is the oldest pending one AND a
+        best-fit run frees up; None on stop/close/timeout.  A whole-mesh
+        ask therefore drains the mesh: it holds the head of the queue
+        until every running slice releases."""
+        import time as _time
+
+        deadline = (None if timeout_s is None
+                    else _time.monotonic() + timeout_s)
+        ticket = {"job_id": job_id}
+        with self._cv:
+            if self._closed or job_id in self._owners:
+                return None
+            self._pending.append(ticket)
+            try:
+                while True:
+                    if self._closed:
+                        return None
+                    if stop is not None and stop.is_set():
+                        return None
+                    if self._pending[0] is ticket:
+                        placed = self._grant(job_id, size, tp)
+                        if placed is not None:
+                            return placed
+                    # grant is tried before the deadline check, so
+                    # timeout_s=0.0 means exactly one non-blocking try
+                    if deadline is not None \
+                            and _time.monotonic() >= deadline:
+                        return None
+                    self._cv.wait(0.05)
+            finally:
+                if ticket in self._pending:
+                    self._pending.remove(ticket)
+                self._cv.notify_all()
+
+    def _grant(self, job_id: str, size: int, tp: int):
+        size = max(1, min(int(size) or self.default_share(), self.n))
+        start = self._best_fit(size)
+        if start is None:
+            return None
+        for i in range(start, start + size):
+            self._free[i] = False
+        placed = SlicePlacement(job_id, self.devices[start:start + size],
+                                start, size, tp=tp)
+        self._owners[job_id] = placed
+        return placed
+
+    # -- reclamation ---------------------------------------------------
+
+    def release(self, job_id: str) -> bool:
+        with self._cv:
+            placed = self._owners.pop(job_id, None)
+            if placed is None:
+                return False
+            for i in range(placed.start, placed.start + placed.size):
+                self._free[i] = True
+            self._cv.notify_all()
+            return True
+
+    def reclaim(self, live) -> list[str]:
+        """Free every slice whose owner ``live(job_id)`` disowns.
+
+        The scheduler sweeps this once per tick with "is this job_id
+        still installed in my running map" -- a slice whose owner died
+        without releasing (worker crash, leaked state) frees within one
+        tick instead of deadlocking the queue behind a phantom job.
+        """
+        with self._cv:
+            dead = [j for j in self._owners if not live(j)]
+        for j in dead:
+            self.release(j)
+        return dead
+
+    def close(self) -> None:
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
+
+    # -- visibility ----------------------------------------------------
+
+    def occupancy(self) -> dict:
+        """Snapshot for /healthz, /metrics and the bench."""
+        with self._cv:
+            in_use = sum(1 for f in self._free if not f)
+            return {
+                "devices_total": self.n,
+                "devices_in_use": in_use,
+                "slices_active": len(self._owners),
+                "queued_placements": len(self._pending),
+                "slices": {j: p.describe()
+                           for j, p in self._owners.items()},
+            }

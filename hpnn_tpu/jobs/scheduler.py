@@ -1,20 +1,31 @@
-"""Job scheduler: train-while-serving on one shared device.
+"""Job scheduler: train-while-serving on pinned mesh slices.
 
-One worker thread drains the bounded :class:`~.queue.JobQueue` strictly
-FIFO and drives each job through the REENTRANT training entry
-(``api.train_job`` -- the same configure/train_loop/checkpoint path
+A pool of K worker threads (``--job-workers K``, default 1) drains the
+bounded :class:`~.queue.JobQueue` strictly FIFO; each worker acquires a
+DISJOINT contiguous device slice from the shared
+:class:`~.placement.SliceManager` (best-fit, strict-FIFO grants --
+``dp_devices``/``tp_devices``/``model_parallel`` submit params size the
+ask, undeclared jobs get the fair default share, a whole-mesh ask
+drains the mesh first) and drives its job through the REENTRANT
+training entry pinned to that slice (``api.train_job(...,
+devices=slice)`` -- the same configure/train_loop/checkpoint path
 ``train_nn`` runs, so a job's ``kernel.opt`` is byte-identical to the
-offline CLI run of the same conf/corpus/seed).  Device sharing is
-cooperative and epoch-granular:
+offline CLI run of the same conf/corpus/seed on a same-sized slice).
+The slice is released on EVERY terminal path, and a per-tick
+``reclaim`` sweep frees any slice whose owner is no longer installed
+(a leaked slice is the multi-job analog of a stuck queue).  Device
+sharing with eval traffic stays cooperative and epoch-granular:
 
 * the trainer calls back at EVERY epoch boundary (``on_epoch``); the
-  scheduler updates the persistent job record, flushes the due snapshot,
+  worker updates the persistent job record, flushes the due snapshot,
   hot-reloads the published bundle into the serving registry (the same
   manifest-generation machinery ``--watch-ckpt`` polls, driven
   synchronously here so a swap lands the moment its bundle is durable),
   and then YIELDS: while eval traffic is queued on any batcher, the next
   epoch waits (bounded by ``preempt_wait_s``) -- serve traffic preempts
-  training between epochs, never the reverse;
+  training between epochs, never the reverse.  The yield is PER WORKER:
+  one job deferring to eval traffic no longer stalls the other workers'
+  epochs;
 * cancel and graceful drain both latch the job's stop event; the
   in-flight epoch finishes, the checkpoint manager writes a final
   snapshot (the ckpt subsystem's signal machinery, reused verbatim), and
@@ -38,6 +49,7 @@ import time
 from ..obs import trace as obs_trace
 from ..utils import nn_log
 from ..utils.nn_log import nn_out, nn_warn
+from .placement import SliceManager, plan_request
 from .queue import JobQueue, JobQueueFull
 from .state import (
     JOB_CONSOLE,
@@ -61,6 +73,12 @@ _TYPES = ("ANN", "SNN", "LNN")
 # queue dwell and the incremental pack build all overlap the upload
 JOB_UPLOAD_MARKER = ".upload-incomplete"
 
+# the eval-preemption gate resumes training only after the batcher
+# queues stay drained this many consecutive 1ms ticks -- a saturated
+# closed-loop client dips to zero for a tick between a drain and the
+# next arrivals, and that must not read as "eval traffic stopped"
+YIELD_QUIESCE_TICKS = 10
+
 # console.log prefixes per captured nn_log level (replay-equivalent at
 # the verbosity the entries were captured under)
 _LOG_PREFIX = {"dbg": "NN(DBG): ", "out": "NN: ", "cout": "",
@@ -83,8 +101,9 @@ class JobScheduler:
                  preempt_wait_s: float = 2.0,
                  auto_promote: bool = False,
                  auto_resume: bool | None = None,
-                 replicate_to: str | None = None):
-        from ..utils.env import env_float, env_int
+                 replicate_to: str | None = None,
+                 job_workers: int = 1, devices=None):
+        from ..utils.env import env_device_cap, env_float, env_int
 
         self.app = app
         # eval-driven auto-promotion (ISSUE 13 / ROADMAP 2c): after a
@@ -128,17 +147,30 @@ class JobScheduler:
         self.upload_chunks_total = 0
         self.upload_wait_s = env_float("HPNN_JOBS_UPLOAD_WAIT_S",
                                        120.0, lo=1.0)
-        self._current: JobState | None = None
-        self._current_stop: threading.Event | None = None
-        self._cancel_requested = False
+        # mesh-slice placement (ISSUE 19): each worker pins its job to a
+        # disjoint contiguous device slice.  The HPNN_DP_DEVICES env
+        # knob keeps its pre-placement meaning as the DEFAULT-slice
+        # bound: an undeclared job's fair share is additionally capped
+        # by it (a declared dp_devices/tp_devices ask is explicit and
+        # wins, exactly like an explicit devices= list wins in api)
+        self.workers = max(1, int(job_workers))
+        self.slices = SliceManager(devices=devices, workers=self.workers)
+        self._default_cap = env_device_cap("HPNN_DP_DEVICES",
+                                           self.slices.n)
+        # per-job running state: job_id -> {"job", "stop", "cancel",
+        # "slice"} (guarded by _mu).  _pending_cancel keeps latching
+        # cancels that land in the pop-to-install window.
+        self._running: dict[str, dict] = {}
         self._pending_cancel: set[str] = set()
         self._draining = False
         self._paused = False
         self._closed = False
-        self._thread = threading.Thread(target=self._loop,
-                                        name="hpnn-job-scheduler",
-                                        daemon=True)
-        self._thread.start()
+        self._threads = [
+            threading.Thread(target=self._loop, args=(i,),
+                             name=f"hpnn-job-worker-{i}", daemon=True)
+            for i in range(self.workers)]
+        for t in self._threads:
+            t.start()
 
     # --- submission ------------------------------------------------------
     def submit(self, kernel: str, params: dict,
@@ -300,7 +332,7 @@ class JobScheduler:
         while os.path.exists(marker):
             if stop.is_set():
                 self._drop_upload(job.job_id, aborted=True)
-                status = ("cancelled" if self._cancel_requested
+                status = ("cancelled" if self._is_cancelled(job.job_id)
                           else "interrupted")
                 self.store.update(job, status=status,
                                   error="stopped during corpus upload",
@@ -350,6 +382,17 @@ class JobScheduler:
         if dtype not in _DTYPES:
             raise JobError(f"'dtype' must be one of {_DTYPES}: {dtype}")
         clean["dtype"] = dtype
+        # mesh-slice placement ask (ISSUE 19): dp_devices x tp_devices
+        # sizes the slice (model_parallel doubles as the TP width and
+        # emits the conf's [model] row-sharding line; batch emits
+        # [batch] so the DP route engages over the slice).  Undeclared
+        # jobs take the fair default share at grant time; an over-ask
+        # clamps to the mesh exactly like the [model] clamp.
+        for key in ("dp_devices", "tp_devices", "model_parallel",
+                    "batch"):
+            v = _as_int(params, key, 0)
+            if v:
+                clean[key] = v
         hidden = params.get("hidden", list(model.topology[1:-1]))
         if isinstance(hidden, int):
             hidden = [hidden]
@@ -387,6 +430,13 @@ class JobScheduler:
             clean.setdefault("samples", prev.params.get("samples"))
             if "epochs" not in params:
                 clean["epochs"] = max(prev.epochs, prev.epoch)
+            # a resumed job re-acquires an EQUAL-SIZE slice (not
+            # necessarily the same devices -- the trajectory depends
+            # only on the mesh shape, so resume stays byte-exact)
+            for key in ("dp_devices", "tp_devices", "model_parallel",
+                        "batch"):
+                if key not in clean and prev.params.get(key):
+                    clean[key] = int(prev.params[key])
         if corpus_files:
             if params.get("samples"):
                 raise JobError(
@@ -421,6 +471,13 @@ class JobScheduler:
             f"[dtype] {clean['dtype']}",
             f"[sample_dir] {clean['samples']}",
         ]
+        # slice-route keywords: the SAME [batch]/[model] grammar the
+        # offline CLI parses, so a pinned job's parity run is literally
+        # "train_nn on this conf under an equal-sized device view"
+        if clean.get("batch"):
+            lines.append(f"[batch] {clean['batch']}")
+        if clean.get("model_parallel"):
+            lines.append(f"[model] {clean['model_parallel']}")
         if clean["train"] == "CG":
             # [train] CG alone would warn-and-fall-through like the
             # reference; the keyword engages the native batched trainer
@@ -432,15 +489,38 @@ class JobScheduler:
         with open(job.conf_path, "w") as fp:
             fp.write("\n".join(lines) + "\n")
 
-    # --- worker -----------------------------------------------------------
-    def _loop(self) -> None:
+    # --- workers ----------------------------------------------------------
+    def _is_cancelled(self, job_id: str) -> bool:
+        with self._mu:
+            run = self._running.get(job_id)
+            return bool(run is not None and run["cancel"])
+
+    def _reclaim_tick(self) -> None:
+        """Free any slice whose owner is no longer an installed running
+        job -- one tick after a worker dies without its finally (or any
+        other leak), the next queued job can place.  Normal releases
+        happen inline in the worker; this sweep is the backstop that
+        keeps a slice leak from becoming the new deadlock."""
+        def live(job_id: str) -> bool:
+            with self._mu:
+                return job_id in self._running
+        for job_id in self.slices.reclaim(live):
+            nn_warn(f"jobs: reclaimed leaked device slice of "
+                    f"{job_id}\n")
+            nn_log.nn_event("job_slice_reclaimed", job=job_id)
+
+    def _loop(self, widx: int = 0) -> None:
         while not self._closed:
-            if self.auto_resume:
+            if widx == 0:
+                # housekeeping rides worker 0's poll cadence: one tick
+                # is the reclaim/auto-resume latency bound
                 try:
-                    self._auto_resume_tick()
+                    self._reclaim_tick()
+                    if self.auto_resume:
+                        self._auto_resume_tick()
                 except Exception as exc:  # noqa: BLE001 -- the tick is
                     # recovery machinery; it must never kill the worker
-                    nn_warn(f"jobs: auto-resume tick error (loop "
+                    nn_warn(f"jobs: housekeeping tick error (loop "
                             f"continues): {type(exc).__name__}: "
                             f"{exc}\n")
             job = self.queue.take(timeout_s=0.1)
@@ -462,17 +542,17 @@ class JobScheduler:
                                       error="server shutdown before run",
                                       finished=time.time())
                     continue
-                self._current = job
-                self._current_stop = threading.Event()
-                self._cancel_requested = False
+                run = {"job": job, "stop": threading.Event(),
+                       "cancel": False, "slice": None}
+                self._running[job.job_id] = run
                 if job.job_id in self._pending_cancel:
                     # a cancel latched while the job was between the
                     # queue and this install: honor it now
                     self._pending_cancel.discard(job.job_id)
-                    self._cancel_requested = True
-                    self._current_stop.set()
+                    run["cancel"] = True
+                    run["stop"].set()
             try:
-                self._run_job(job, self._current_stop)
+                self._place_and_run(job, run)
             except Exception as exc:  # noqa: BLE001 -- job isolation:
                 # one broken job must not kill the scheduler
                 nn_warn(f"jobs: {job.job_id} failed: {exc}\n")
@@ -480,12 +560,39 @@ class JobScheduler:
                                   error=f"{type(exc).__name__}: {exc}",
                                   finished=time.time())
             finally:
+                self.slices.release(job.job_id)
                 with self._mu:
-                    self._current = None
-                    self._current_stop = None
+                    self._running.pop(job.job_id, None)
                     # a cancel that raced job completion leaves a stale
                     # latch -- the job is terminal, drop it
                     self._pending_cancel.discard(job.job_id)
+
+    def _place_and_run(self, job: JobState, run: dict) -> None:
+        """Acquire the job's device slice (blocking, FIFO -- the job
+        stays ``queued`` while it waits), persist the placement, run."""
+        size, tp = plan_request(job.params, self.slices.n)
+        if size <= 0:
+            # undeclared ask: fair share of the mesh over the worker
+            # pool, bounded by the HPNN_DP_DEVICES default-slice cap
+            size = min(self.slices.default_share(), self._default_cap)
+        placed = None
+        if not run["stop"].is_set():
+            placed = self.slices.acquire(job.job_id, size, tp=tp,
+                                         stop=run["stop"])
+        if placed is None:
+            # stopped (cancel/drain) while waiting for a slice, or the
+            # manager closed under us: the job never trained
+            status = ("cancelled" if run["cancel"] else "interrupted")
+            self.store.update(job, status=status,
+                              error="stopped before slice grant",
+                              finished=time.time(), lease_expires=0.0)
+            nn_out(f"jobs: {job.job_id} {status} before slice grant\n")
+            return
+        run["slice"] = placed
+        self.store.update(job, slice=placed.describe())
+        nn_log.nn_event("job_slice_granted", job=job.job_id,
+                        **placed.describe())
+        self._run_job(job, run["stop"], placed.devices)
 
     # --- lease-based auto-resume (ISSUE 14) -------------------------------
     def _auto_resume_tick(self) -> None:
@@ -501,14 +608,14 @@ class JobScheduler:
             return
         lease_now = time.time()  # leases are persisted wall-clock
         with self._mu:
-            current = self._current.job_id if self._current else None
+            running = set(self._running)
         candidates = self.store.scan_recovery()
         if not candidates:
             self._resume_due.clear()  # nothing interrupted remains
             return
         for job in candidates:
             job_id = job.job_id
-            if job_id == current:
+            if job_id in running:
                 continue
             if (job.status in ("running", "snapshotting")
                     and job.lease_expires
@@ -616,18 +723,19 @@ class JobScheduler:
                f"{job.retries}/{self.max_retries}) from "
                f"{'epoch %d' % epoch if bundle else 'scratch'}\n")
 
-    def _run_job(self, job: JobState, stop: threading.Event) -> None:
+    def _run_job(self, job: JobState, stop: threading.Event,
+                 devices=None) -> None:
         # one trace per job, keyed by the job id itself: every epoch
-        # span, snapshot write and hot swap on this (scheduler) thread
+        # span, snapshot write and hot swap on this (worker) thread
         # nests under it -- `GET /v1/debug/trace?trace=job:<id>` is the
         # job's whole execution tree (ISSUE 8)
         with obs_trace.span("jobs.run", trace_id=f"job:{job.job_id}",
                             job=job.job_id, kernel=job.kernel,
                             epochs=job.epochs):
-            self._run_job_traced(job, stop)
+            self._run_job_traced(job, stop, devices)
 
-    def _run_job_traced(self, job: JobState,
-                        stop: threading.Event) -> None:
+    def _run_job_traced(self, job: JobState, stop: threading.Event,
+                        devices=None) -> None:
         from ..api import train_job
 
         # chunked upload in flight: hold training until the last chunk
@@ -684,7 +792,7 @@ class JobScheduler:
                 kernel_out=job.kernel_out, resume=resume,
                 stop=stop, on_epoch=on_epoch,
                 replicate_to=self.replicate_to,
-                auth_token=self.app.auth_token)
+                auth_token=self.app.auth_token, devices=devices)
         self._write_console(job, entries)
         # record_final bumped the manifest generation: swap the finished
         # kernel in (same weights as the last bundle, but the bump keeps
@@ -693,7 +801,8 @@ class JobScheduler:
         if not result["ok"]:
             status, error = "failed", result["error"]
         elif result["interrupted"]:
-            status = "cancelled" if self._cancel_requested else "interrupted"
+            status = ("cancelled" if self._is_cancelled(job.job_id)
+                      else "interrupted")
             error = None
         else:
             status, error = "done", None
@@ -891,12 +1000,24 @@ class JobScheduler:
         epoch waits (bounded) -- serving latency beats training
         throughput on a shared device.  The wait is a span
         (``jobs.yield_to_eval``): generation-swap / device contention
-        shows up in the job's trace as time spent here."""
+        shows up in the job's trace as time spent here.
+
+        Training resumes only after the queues stay drained for a
+        short quiesce window: under a saturated closed-loop client the
+        depth dips to zero for single ticks between a drain and the
+        next arrivals, and a momentary zero must not let an epoch
+        barge into a stream that is still hammering.  (With K slice
+        workers this is also what lets concurrent jobs overlap their
+        waits -- every worker defers through the same busy window
+        instead of taking turns barging.)"""
         with obs_trace.span("jobs.yield_to_eval"):
             deadline = time.monotonic() + self.preempt_wait_s
+            quiet = 0
             while not stop.is_set() and time.monotonic() < deadline:
                 depths = [b.depth() for b in self.app.batchers.values()]
-                if not any(depths):
+                if any(depths):
+                    quiet = 0
+                elif (quiet := quiet + 1) >= YIELD_QUIESCE_TICKS:
                     return
                 time.sleep(0.001)
 
@@ -916,16 +1037,21 @@ class JobScheduler:
         return self.store.list()
 
     def active(self) -> dict:
-        """The running job (id + its trace id) and the queued count --
-        what a mesh worker's heartbeat advertises so the router's
-        worker table says where a job runs and which
-        ``?trace=job:<id>`` to pull fleet-wide (ISSUE 10)."""
+        """The running jobs (first id + its trace id, back-compat for
+        the mesh worker heartbeat that advertises where a job runs and
+        which ``?trace=job:<id>`` to pull fleet-wide -- ISSUE 10) and
+        the queued count; ``running_jobs`` lists the whole pool."""
         with self._mu:
-            cur = self._current.job_id if self._current is not None \
-                else None
+            ids = sorted(self._running)
+        cur = ids[0] if ids else None
         return {"running": cur,
                 "trace": f"job:{cur}" if cur else None,
+                "running_jobs": ids,
                 "queued": self.queue.depth()}
+
+    def running_count(self) -> int:
+        with self._mu:
+            return len(self._running)
 
     def cancel(self, job_id: str) -> dict:
         """Cancel a queued job immediately, or latch the running job's
@@ -940,14 +1066,14 @@ class JobScheduler:
                               finished=time.time())
             return self.store.snapshot(job_id)
         with self._mu:
-            if self._current is not None \
-                    and self._current.job_id == job_id:
-                self._cancel_requested = True
-                self._current_stop.set()
+            run = self._running.get(job_id)
+            if run is not None:
+                run["cancel"] = True
+                run["stop"].set()
                 return self.store.snapshot(job_id)
             if job.status not in TERMINAL_STATES:
-                # TOCTOU window: the worker popped the job from the
-                # queue but has not installed it as _current yet (or
+                # TOCTOU window: a worker popped the job from the
+                # queue but has not installed it as running yet (or
                 # pause() is cycling it through requeue_front).  Latch
                 # the cancel; the worker honors it at install time.
                 self._pending_cancel.add(job_id)
@@ -968,13 +1094,14 @@ class JobScheduler:
         self._paused = False
 
     def drain(self, timeout_s: float = 120.0) -> None:
-        """Graceful shutdown: stop admitting, latch the running job's
-        stop event (finish the in-flight epoch + final snapshot, mark it
-        ``interrupted``), park queued jobs as interrupted/resumable."""
+        """Graceful shutdown: stop admitting, latch every running job's
+        stop event (finish the in-flight epoch + final snapshot, mark
+        them ``interrupted``), park queued jobs as
+        interrupted/resumable."""
         with self._mu:
             self._draining = True
-            if self._current_stop is not None:
-                self._current_stop.set()
+            for run in self._running.values():
+                run["stop"].set()
             open_uploads = list(self._uploads)
         for job_id in open_uploads:
             # open chunked uploads die with the server: chunk litter is
@@ -983,8 +1110,11 @@ class JobScheduler:
             self._drop_upload(job_id, aborted=True)
         self.queue.close()
         self._closed = True
-        self._thread.join(timeout=timeout_s)
-        if self._thread.is_alive():  # pragma: no cover - watchdog only
+        self.slices.close()
+        deadline = time.monotonic() + timeout_s
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.monotonic()))
+        if any(t.is_alive() for t in self._threads):  # pragma: no cover
             nn_warn("jobs: scheduler did not drain in time\n")
         # anything still queued never ran: park it resumable
         while True:
@@ -998,21 +1128,31 @@ class JobScheduler:
     # --- observability ----------------------------------------------------
     def metrics_snapshot(self) -> dict:
         with self._mu:
-            cur = self._current
-            running = None
-            if cur is not None:
-                snap = self.store.snapshot(cur.job_id) or {}
-                errs = snap.get("errors") or []
-                running = {
-                    "job": cur.job_id,
-                    "kernel": snap.get("kernel"),
-                    "epoch": snap.get("epoch", 0),
-                    "epochs": snap.get("epochs", 0),
-                    "mean_err": errs[-1] if errs else None,
-                }
+            ids = sorted(self._running)
+        running_jobs = []
+        for job_id in ids:
+            snap = self.store.snapshot(job_id) or {}
+            errs = snap.get("errors") or []
+            running_jobs.append({
+                "job": job_id,
+                "kernel": snap.get("kernel"),
+                "epoch": snap.get("epoch", 0),
+                "epochs": snap.get("epochs", 0),
+                "mean_err": errs[-1] if errs else None,
+                "slice": snap.get("slice"),
+            })
+        occ = self.slices.occupancy()
         return {
             "queue_depth": self.queue.depth(),
-            "running": running,
+            # "running" keeps its single-job shape (first of the pool)
+            # for the committed dashboards; "running_jobs" is the pool
+            "running": running_jobs[0] if running_jobs else None,
+            "running_jobs": running_jobs,
+            "workers": self.workers,
+            "slices_active": occ["slices_active"],
+            "slice_devices_in_use": occ["devices_in_use"],
+            "slice_devices_total": occ["devices_total"],
+            "queued_placements": occ["queued_placements"],
             "by_status": self.store.by_status(),
             "trained_epochs_total": self.store.trained_epochs(),
             "auto_resumes_total": self.auto_resumes_total,

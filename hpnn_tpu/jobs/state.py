@@ -88,6 +88,12 @@ class JobState:
     lease_expires: float = 0.0       # wall clock (persisted timestamp)
     retries: int = 0                 # auto-resume attempts so far
     auto_resume_from: str | None = None  # ckpt dir/bundle to resume from
+    # mesh-slice placement (ISSUE 19): {"devices": [ids], "dp", "tp",
+    # "size"} once the scheduler grants this job its device slice --
+    # carried on /v1/jobs and the job event stream so an operator sees
+    # WHERE a job trains; cleared by nothing (the last grant is part of
+    # the job's history, like generations)
+    slice: dict | None = None
     created: float = 0.0
     started: float = 0.0
     finished: float = 0.0

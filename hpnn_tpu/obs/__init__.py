@@ -61,6 +61,8 @@ EVENT_NAMES: dict[str, str] = {
     "job_lease_expired": "jobs",
     "job_auto_resume": "jobs",
     "job_auto_resume_failed": "jobs",
+    "job_slice_granted": "jobs",
+    "job_slice_reclaimed": "jobs",
     "auto_promote": "jobs",
     # mesh lifecycle (serve/mesh/, emitted via mesh_event)
     "mesh_worker_registered": "mesh",

@@ -53,11 +53,12 @@ def tp_device_count() -> int:
     parallel twin of ``HPNN_DP_DEVICES`` (the serve process reads it to
     build the giant-topology eval mesh; training takes its width from
     ``[model]``/``--model-parallel`` instead).  Capped to the visible
-    devices; 0/unset means no TP mesh."""
-    from ..utils.env import env_int
+    devices through the shared ``env_device_cap`` clamp/warn path;
+    0/unset means no TP mesh."""
+    from ..utils.env import env_device_cap
 
-    cap = env_int("HPNN_TP_DEVICES", 0)
-    return max(1, min(jax.device_count(), cap)) if cap > 0 else 1
+    return env_device_cap("HPNN_TP_DEVICES", jax.device_count(),
+                          default=1)
 
 
 def data_mesh(n_devices: int | None = None) -> Mesh | None:

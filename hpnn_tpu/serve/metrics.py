@@ -662,6 +662,45 @@ class ServeMetrics:
                 lines.append(
                     f'hpnn_jobs_total'
                     f'{{status="{_escape_label(status)}"}} {n}')
+            if "slice_devices_total" in j:
+                # mesh-slice placement (ISSUE 19): device occupancy of
+                # the worker pool plus one labeled row per pinned job
+                lines += [
+                    "# HELP hpnn_jobs_slices_active Training jobs "
+                    "holding a device slice.",
+                    "# TYPE hpnn_jobs_slices_active gauge",
+                    f"hpnn_jobs_slices_active {j['slices_active']}",
+                    "# HELP hpnn_jobs_slice_devices_in_use Devices "
+                    "held by job slices (of "
+                    "hpnn_jobs_slice_devices_total).",
+                    "# TYPE hpnn_jobs_slice_devices_in_use gauge",
+                    f"hpnn_jobs_slice_devices_in_use "
+                    f"{j['slice_devices_in_use']}",
+                    "# HELP hpnn_jobs_slice_devices_total Devices the "
+                    "placement scheduler owns.",
+                    "# TYPE hpnn_jobs_slice_devices_total gauge",
+                    f"hpnn_jobs_slice_devices_total "
+                    f"{j['slice_devices_total']}",
+                    "# HELP hpnn_jobs_queued_placements Slice requests "
+                    "waiting for devices to free.",
+                    "# TYPE hpnn_jobs_queued_placements gauge",
+                    f"hpnn_jobs_queued_placements "
+                    f"{j.get('queued_placements', 0)}",
+                    "# HELP hpnn_jobs_slice_devices Devices pinned per "
+                    "running job (dp x tp grid labels).",
+                    "# TYPE hpnn_jobs_slice_devices gauge",
+                ]
+                for rj in j.get("running_jobs") or []:
+                    sl = rj.get("slice") or {}
+                    if not sl:
+                        continue
+                    lines.append(
+                        "hpnn_jobs_slice_devices"
+                        f'{{job="{_escape_label(rj["job"])}",'
+                        f'kernel="{_escape_label(rj.get("kernel") or "")}",'
+                        f'dp="{sl.get("dp", 1)}",'
+                        f'tp="{sl.get("tp", 1)}"}} '
+                        f'{sl.get("size", 0)}')
         lines += [
             "# HELP hpnn_serve_queue_depth Requests waiting per kernel.",
             "# TYPE hpnn_serve_queue_depth gauge",

@@ -517,10 +517,13 @@ class ServeApp:
                     preempt_wait_s: float = 2.0,
                     auto_promote: bool = False,
                     auto_resume: bool | None = None,
-                    replicate_to: str | None = None):
+                    replicate_to: str | None = None,
+                    job_workers: int = 1):
         """Attach the train-while-serving job subsystem (``serve_nn
-        --jobs N``): bounded queue + scheduler worker + persistent job
-        store under ``job_dir``, with its gauges wired into /metrics.
+        --jobs N``): bounded queue + a pool of ``job_workers`` slice-
+        pinned scheduler workers (``--job-workers K``, ISSUE 19) +
+        persistent job store under ``job_dir``, with its gauges wired
+        into /metrics.
         ``auto_promote`` (``--auto-promote``) closes ROADMAP 2(c): a
         finished job's candidate generation is evaluated on a held-out
         test dir and promoted-if-better / rolled back automatically.
@@ -536,7 +539,8 @@ class ServeApp:
                                  preempt_wait_s=preempt_wait_s,
                                  auto_promote=auto_promote,
                                  auto_resume=auto_resume,
-                                 replicate_to=replicate_to)
+                                 replicate_to=replicate_to,
+                                 job_workers=job_workers)
         self.metrics.set_jobs_source(self.jobs.metrics_snapshot)
         return self.jobs
 
@@ -1373,9 +1377,35 @@ class ServeApp:
             raise _HTTPError(404, "not_found", f"unknown job '{job_id}'")
         return snap
 
-    def handle_job_list(self) -> dict:
+    def handle_job_list(self, state: str | None = None,
+                        limit: str | None = None) -> dict:
+        """GET /v1/jobs[?state=S&limit=N] -- the full history (exactly
+        the pre-filter bytes when no query is given), optionally
+        filtered to one lifecycle state and/or truncated to the N most
+        RECENT matching records (ids are monotonic, so the tail is the
+        recency window an operator wants)."""
+        from ..jobs.state import JOB_STATES
+
         jobs = self._jobs_or_503()
-        return {"jobs": jobs.list()}
+        records = jobs.list()
+        if state is not None:
+            if state not in JOB_STATES:
+                raise _HTTPError(
+                    400, "bad_request",
+                    f"'state' must be one of {list(JOB_STATES)}: "
+                    f"{state!r}")
+            records = [r for r in records if r.get("status") == state]
+        if limit is not None:
+            try:
+                n = int(limit)
+            except ValueError:
+                raise _HTTPError(400, "bad_request",
+                                 f"'limit' must be an integer: {limit!r}")
+            if n < 1:
+                raise _HTTPError(400, "bad_request",
+                                 f"'limit' must be >= 1: {n}")
+            records = records[-n:]
+        return {"jobs": records}
 
     def handle_job_action(self, job_id: str, action: str) -> dict:
         """POST /v1/jobs/<id>/{cancel,promote,rollback}.  Cancel stops
@@ -1540,7 +1570,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "queue_depth": {name: b.depth() for name, b in
                                     self.app.batchers.items()},
                     "active_jobs": 0 if jobs is None else
-                    jobs.queue.depth() + (1 if jobs._current else 0),
+                    jobs.queue.depth() + jobs.running_count(),
                     # brownout visibility (ISSUE 15 satellite): probes
                     # see a burning error budget / an engaged shed gate
                     # without parsing /metrics.  Transition-maintained
@@ -1551,6 +1581,10 @@ class _Handler(BaseHTTPRequestHandler):
                     "shed_engaged": (bool(self.app.shedder.active)
                                      if self.app.shedder is not None
                                      else False)}
+            if jobs is not None:
+                # mesh-slice occupancy (ISSUE 19): which device slices
+                # the job workers hold and how many asks await placement
+                body["job_slices"] = jobs.slices.occupancy()
             if mesh is not None:
                 body["mesh"] = mesh
             if warming:
@@ -1752,7 +1786,12 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if path == "/v1/jobs":
-                self._reply(200, self.app.handle_job_list())
+                import urllib.parse
+
+                q = urllib.parse.parse_qs(query or "")
+                self._reply(200, self.app.handle_job_list(
+                    state=(q.get("state") or [None])[-1],
+                    limit=(q.get("limit") or [None])[-1]))
                 return
             m = _JOB_EVENTS_RE.match(path)
             if m is not None:
@@ -1796,8 +1835,10 @@ class _Handler(BaseHTTPRequestHandler):
         deadline = time.monotonic() + max_s
         try:
             while time.monotonic() < deadline:
+                slice_ = snap.get("slice")
                 key = (snap["status"], snap["epoch"],
-                       len(snap["errors"]), len(snap["generations"]))
+                       len(snap["errors"]), len(snap["generations"]),
+                       slice_ is not None)
                 if key != last:
                     last = key
                     event = {
@@ -1808,6 +1849,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "epochs": snap["epochs"],
                         "errors": snap["errors"],
                         "generations": snap["generations"],
+                        "slice": slice_,
                     }
                     self._write_chunk(
                         (json.dumps(event) + "\n").encode("utf-8"))

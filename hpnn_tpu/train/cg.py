@@ -220,11 +220,12 @@ def run_cg_epoch(nn, weights, xs, ts, kind, dtype):
     n_data = 1
     sharding = None
     if getattr(conf, "batch", 0) > 0:
-        from ..api import _dp_device_count
+        from ..api import _dp_device_count, slice_devices
 
         n_data = _dp_device_count()
         if n_data > 1:
-            mesh = make_mesh(n_data=n_data, n_model=1)
+            mesh = make_mesh(n_data=n_data, n_model=1,
+                             devices=slice_devices())
             sharding = flat_state_sharding(mesh)
 
     flat = flatten_state([jnp.asarray(w, dtype) for w in weights],

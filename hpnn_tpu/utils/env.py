@@ -35,3 +35,27 @@ def env_float(name: str, default: float, lo: float | None = None,
     except ValueError:
         v = default
     return _clamp(v, lo, hi)
+
+
+_warned_device_caps: set[str] = set()
+
+
+def env_device_cap(name: str, n_devices: int,
+                   default: int | None = None) -> int:
+    """Device-count cap knob (``HPNN_DP_DEVICES`` / ``HPNN_TP_DEVICES``).
+
+    Unset/0/malformed -> ``default`` (or all ``n_devices`` when
+    ``default`` is None); an explicit value clamps into
+    ``[1, n_devices]``.  An over-ask warns ONCE per knob name through
+    the shared nn_warn stream -- per-call warns would differ between
+    the resident and restage epoch paths and break console byte-parity.
+    """
+    n = max(1, int(n_devices))
+    cap = env_int(name, 0)
+    if cap <= 0:
+        return n if default is None else _clamp(int(default), 1, n)
+    if cap > n and name not in _warned_device_caps:
+        _warned_device_caps.add(name)
+        from .nn_log import nn_warn
+        nn_warn(f"{name}={cap} > {n} visible device(s); using {n}\n")
+    return _clamp(cap, 1, n)

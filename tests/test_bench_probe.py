@@ -285,6 +285,32 @@ def test_committed_jobs_bench_recovery_row_holds_floors():
     assert rec["restart_to_done_s"] is not None
 
 
+def test_committed_jobs_bench_concurrency_row_holds_floors():
+    """The committed JOBS_BENCH.json concurrency row (ISSUE 19, make
+    jobs-slice-bench) stays pinned in tier 1: two pinned 4-device jobs
+    on disjoint slices of the 8-device mesh beat the same two jobs
+    serialized by >= 1.3x wall clock, dropped zero evals in either
+    window, held the concurrent eval p99 inside the serialized window's
+    ceiling, and trained identical error trajectories both ways."""
+    art = _load_artifact("JOBS_BENCH.json")
+    c = art["concurrency"]
+    assert c["ok"] is True
+    assert all(c["floors"].values()), c["floors"]
+    assert c["devices"] == 8 and c["slice_devices"] == 4
+    assert c["speedup"] >= c["speedup_floor"] >= 1.3
+    assert c["serial_wall_s"] > c["concurrent_wall_s"] > 0
+    assert c["serial_job_status"] == ["done", "done"]
+    assert c["concurrent_job_status"] == ["done", "done"]
+    assert c["disjoint_slices"] is True
+    assert c["both_slices_observed"] is True
+    assert c["non_200_evals"] == 0
+    for w in ("serial_eval", "concurrent_eval"):
+        assert set(c[w]["statuses"]) == {"200"}
+        assert c[w]["n_requests"] > 0
+    assert c["concurrent_eval"]["p99_ms"] <= c["p99_ceiling_ms"]
+    assert c["trajectories_match"] is True
+
+
 def test_committed_mesh_bench_shed_and_autoscale_rows_hold_floors():
     """The committed MESH_BENCH.json shed + autoscale rows (ISSUE 13)
     stay pinned in tier 1: the chaos 5xx burst engaged and recovered

@@ -13,7 +13,7 @@ import re
 
 import pytest
 
-from hpnn_tpu.utils.env import env_float, env_int
+from hpnn_tpu.utils.env import env_device_cap, env_float, env_int
 
 
 @pytest.fixture()
@@ -59,6 +59,64 @@ def test_env_float_parses_defaults_clamps(knob):
     assert env_float("HPNN_TEST_KNOB", 1.5, lo=0.0) == 0.0
 
 
+def test_env_device_cap_parses_defaults_clamps(knob, monkeypatch):
+    """The ONE device-count knob contract (ISSUE 19 satellite):
+    HPNN_DP_DEVICES / HPNN_TP_DEVICES both parse through
+    ``env_device_cap`` -- unset/0/malformed mean the default view,
+    explicit values clamp to [1, visible devices]."""
+    from hpnn_tpu.utils import env as env_mod
+
+    monkeypatch.setattr(env_mod, "_warned_device_caps", set())
+    assert env_device_cap("HPNN_TEST_KNOB", 8) == 8        # unset: all
+    assert env_device_cap("HPNN_TEST_KNOB", 8, default=1) == 1
+    knob("0")
+    assert env_device_cap("HPNN_TEST_KNOB", 8) == 8        # 0 = unset
+    knob("banana")
+    assert env_device_cap("HPNN_TEST_KNOB", 8, default=1) == 1
+    knob("3")
+    assert env_device_cap("HPNN_TEST_KNOB", 8) == 3
+    knob("-2")
+    assert env_device_cap("HPNN_TEST_KNOB", 8) == 8        # <=0 = unset
+
+
+def test_env_device_cap_over_ask_warns_once(knob, monkeypatch):
+    """An over-the-mesh ask clamps with ONE warning per knob name --
+    per-call warns would differ between code paths that consult the
+    knob a different number of times (console byte-parity)."""
+    from hpnn_tpu.utils import env as env_mod
+    from hpnn_tpu.utils import nn_log
+
+    monkeypatch.setattr(env_mod, "_warned_device_caps", set())
+    knob("64")
+    with nn_log.capture() as entries:
+        assert env_device_cap("HPNN_TEST_KNOB", 8) == 8
+        assert env_device_cap("HPNN_TEST_KNOB", 8) == 8
+    warns = [t for lvl, t in entries if lvl == "warn"]
+    assert len(warns) == 1
+    assert "HPNN_TEST_KNOB" in warns[0] and "8" in warns[0]
+
+
+def test_device_cap_live_consumers(monkeypatch):
+    """The real call sites: api._dp_device_count (DP route) and
+    parallel.mesh.tp_device_count (serve-side TP default)."""
+    import hpnn_tpu.api as api
+    from hpnn_tpu.parallel import mesh as pmesh
+
+    monkeypatch.setenv("HPNN_TP_DEVICES", "weird")
+    assert pmesh.tp_device_count() == 1     # TP defaults to OFF
+    monkeypatch.setenv("HPNN_TP_DEVICES", "2")
+    assert pmesh.tp_device_count() == 2
+    monkeypatch.setenv("HPNN_TP_DEVICES", "0")
+    assert pmesh.tp_device_count() == 1
+    # an explicit device slice beats the env knob entirely
+    monkeypatch.setenv("HPNN_DP_DEVICES", "1")
+    import jax
+
+    with api.device_slice(jax.devices()[:2]):
+        assert api._dp_device_count() == 2
+    assert api._dp_device_count() == 1
+
+
 def test_consumers_use_the_shared_helpers():
     """Source scan: the knobs this PR consolidated must not regress to
     ad-hoc ``int(os.environ...)`` parsing (each copy had its own -- or
@@ -66,6 +124,8 @@ def test_consumers_use_the_shared_helpers():
     consolidated = {
         "hpnn_tpu/api.py": ("HPNN_EPOCH_DEVICE_BUDGET_MB",
                             "HPNN_EPOCH_SHARD_ROWS", "HPNN_DP_DEVICES"),
+        "hpnn_tpu/jobs/scheduler.py": ("HPNN_DP_DEVICES",),
+        "hpnn_tpu/parallel/mesh.py": ("HPNN_TP_DEVICES",),
         "hpnn_tpu/ckpt/trainer.py": ("HPNN_CKPT_KILL_AT_EPOCH",),
         "hpnn_tpu/io/corpus.py": ("HPNN_CORPUS_CACHE_MAX_MB",
                                   "HPNN_IO_THREADS"),

@@ -12,6 +12,7 @@ bounded queue, the auth guard on mutating endpoints, generation
 pinning/promote/rollback at the registry level, and submit validation.
 """
 
+import contextlib
 import json
 import os
 import sys
@@ -1212,4 +1213,475 @@ def test_submit_validates_test_samples_dir(tmp_path):
                                   "test_samples":
                                   str(tmp_path / "nope")})
     finally:
+        app.close(drain=True)
+
+
+# --- mesh-slice placement (ISSUE 19) ----------------------------------------
+
+def test_plan_request_sizing():
+    from hpnn_tpu.jobs.placement import plan_request
+
+    # undeclared -> 0 (the manager's fair share decides)
+    assert plan_request({}, 8) == (0, 1)
+    assert plan_request({"epochs": 3}, 8) == (0, 1)
+    # dp alone, tp alone ([model] doubles as the TP width), dp x tp
+    assert plan_request({"dp_devices": 4}, 8) == (4, 1)
+    assert plan_request({"model_parallel": 2}, 8) == (2, 2)
+    assert plan_request({"tp_devices": 2}, 8) == (2, 2)
+    assert plan_request({"dp_devices": 2, "tp_devices": 2}, 8) == (4, 2)
+    # over-asks clamp to the mesh (tp clamps inside the slice)
+    assert plan_request({"dp_devices": 64}, 8) == (8, 1)
+    assert plan_request({"model_parallel": 16}, 8) == (8, 8)
+
+
+def test_slice_manager_best_fit_and_fifo():
+    from hpnn_tpu.jobs.placement import SliceManager
+
+    mgr = SliceManager(devices=list(range(8)), workers=2)
+    assert mgr.default_share() == 4
+    a = mgr.acquire("a", 2, timeout_s=0.0)
+    assert (a.start, a.size) == (0, 2)
+    b = mgr.acquire("b", 4, timeout_s=0.0)
+    assert (b.start, b.size) == (2, 4)
+    # free runs now: [6,7] (len 2).  Release a -> runs [0,1] and [6,7].
+    mgr.release("a")
+    # best fit for size 1: both runs are len 2; lowest index wins
+    c = mgr.acquire("c", 1, timeout_s=0.0)
+    assert (c.start, c.size) == (0, 1)
+    # size 2 must pick the SMALLEST run that fits: [6,7] not [1]
+    d = mgr.acquire("d", 2, timeout_s=0.0)
+    assert (d.start, d.size) == (6, 2)
+    # no contiguous run of 3 left -> a timed acquire gives up
+    assert mgr.acquire("e", 3, timeout_s=0.05) is None
+    occ = mgr.occupancy()
+    assert occ["devices_in_use"] == 7
+    assert occ["slices_active"] == 3
+    assert occ["slices"]["b"] == {"devices": [2, 3, 4, 5],
+                                  "dp": 4, "tp": 1, "size": 4}
+    # FIFO: while an older ask waits, try_acquire refuses to leapfrog
+    got = []
+    t = threading.Thread(
+        target=lambda: got.append(mgr.acquire("f", 3, timeout_s=5.0)))
+    t.start()
+    time.sleep(0.1)
+    assert mgr.try_acquire("g", 1) is None
+    mgr.release("b")  # frees [2..5] -> run [1..5]: f grants first
+    t.join(timeout=5.0)
+    assert got and (got[0].start, got[0].size) == (1, 3)
+    # the queue drained: a later try_acquire grants again
+    g = mgr.try_acquire("g", 1)
+    assert g is not None and g.size == 1
+    mgr.close()
+    assert mgr.acquire("h", 1, timeout_s=0.0) is None  # closed
+
+
+def test_slice_manager_whole_mesh_ask_drains():
+    from hpnn_tpu.jobs.placement import SliceManager
+
+    mgr = SliceManager(devices=list(range(4)), workers=2)
+    a = mgr.acquire("a", 2, timeout_s=0.0)
+    assert a is not None
+    order = []
+
+    def ask(job_id, size):
+        placed = mgr.acquire(job_id, size, timeout_s=10.0)
+        order.append((job_id, placed))
+
+    # a whole-mesh ask parks at the head; a later small ask that WOULD
+    # fit right now must queue behind it (no starvation of the big ask)
+    t_big = threading.Thread(target=ask, args=("big", 4))
+    t_big.start()
+    time.sleep(0.1)
+    t_small = threading.Thread(target=ask, args=("small", 1))
+    t_small.start()
+    time.sleep(0.2)
+    assert order == []  # both still waiting behind the held slice
+    mgr.release("a")  # mesh drains -> big grants, then small queues
+    t_big.join(timeout=10.0)
+    assert order[0][0] == "big" and order[0][1].size == 4
+    mgr.release("big")
+    t_small.join(timeout=10.0)
+    assert order[1][0] == "small" and order[1][1].size == 1
+    mgr.close()
+
+
+def test_slice_manager_stop_and_reclaim():
+    from hpnn_tpu.jobs.placement import SliceManager
+
+    mgr = SliceManager(devices=list(range(4)), workers=1)
+    assert mgr.acquire("a", 4, timeout_s=0.0) is not None
+    # a stop latched while waiting aborts the acquire
+    stop = threading.Event()
+    stop.set()
+    assert mgr.acquire("b", 1, stop=stop, timeout_s=5.0) is None
+    # reclaim frees exactly the slices whose owner is no longer live
+    assert mgr.reclaim(lambda j: True) == []
+    assert mgr.occupancy()["devices_in_use"] == 4
+    assert mgr.reclaim(lambda j: False) == ["a"]
+    assert mgr.occupancy() == {"devices_total": 4, "devices_in_use": 0,
+                               "slices_active": 0,
+                               "queued_placements": 0, "slices": {}}
+
+
+def test_scheduler_reclaims_leaked_slice_within_tick(tmp_path):
+    """A slice whose owner vanished without releasing (the leak the
+    per-tick sweep exists for) frees within one scheduler tick -- no
+    phantom job may deadlock the placement queue."""
+    conf, _ = _serve_conf(tmp_path, name="lk")
+    app = ServeApp(max_batch=4)
+    app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=1)
+    try:
+        # forge a granted slice owned by a job id that is not running
+        leaked = sched.slices.try_acquire("ghost-job", 2)
+        assert leaked is not None
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            if sched.slices.occupancy()["slices_active"] == 0:
+                break
+            time.sleep(0.02)
+        assert sched.slices.occupancy()["slices_active"] == 0
+        assert sched.slices.occupancy()["devices_in_use"] == 0
+    finally:
+        app.close(drain=True)
+
+
+def test_chaos_fault_mid_epoch_frees_slice(tmp_path, corpus_dir):
+    """Satellite: kill-mid-epoch reclaim.  An HPNN_FAULT-style injected
+    EIO under the job's own record write kills the job mid-epoch; its
+    slice must free within a tick and the NEXT job must place and
+    finish -- a leaked slice is the multi-job analog of a stuck
+    queue."""
+    from hpnn_tpu.serve.mesh import chaos
+
+    conf, _ = _serve_conf(tmp_path, name="ch")
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/ch/train",
+            {"epochs": 500, "seed": 5, "train": "BP",
+             "samples": corpus_dir, "ckpt_every": 1})
+        assert st == 202, job
+        jid = job["job_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            if snap["epoch"] >= 1 and snap.get("slice"):
+                break
+            time.sleep(0.02)
+        assert snap["epoch"] >= 1 and snap["slice"], snap
+        # one injected EIO under THIS job's next record write: the
+        # epoch-boundary update raises mid-epoch and the job dies
+        chaos.configure(f"eio@{jid}/job.json:times=1")
+        snap = _wait_terminal(base, jid, timeout_s=60.0)
+        assert snap["status"] == "failed", snap
+        assert "EIO" in (snap["error"] or "")
+        # the slice freed within a tick -- nothing holds the mesh
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            occ = app.jobs.slices.occupancy()
+            if occ["slices_active"] == 0:
+                break
+            time.sleep(0.02)
+        assert occ["slices_active"] == 0 and occ["devices_in_use"] == 0
+        # and the queue is NOT deadlocked: the next job places + runs
+        st, job2 = serve_bench.http_json(
+            base + "/v1/kernels/ch/train",
+            {"epochs": 1, "seed": 5, "train": "BP",
+             "samples": corpus_dir, "ckpt_every": 1})
+        assert st == 202, job2
+        snap2 = _wait_terminal(base, job2["job_id"])
+        assert snap2["status"] == "done", snap2
+        assert snap2["slice"]["size"] >= 1
+    finally:
+        chaos.reset()
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_job_list_state_and_limit_filters(tmp_path):
+    """GET /v1/jobs?state=S&limit=N -- filtered listing; the bare
+    endpoint's bytes stay exactly the unfiltered history."""
+    conf, _ = _serve_conf(tmp_path, name="fl")
+    app = ServeApp(max_batch=4)
+    app.add_model(conf, warmup=False)
+    sched = app.enable_jobs(str(tmp_path / "jobs"), capacity=8)
+    sched.pause()
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    try:
+        states = ["done", "done", "failed", "running", "queued"]
+        for s in states:
+            j = sched.store.create("fl", {})
+            if s != "queued":
+                sched.store.update(j, status=s)
+        st, plain = serve_bench.http_json(base + "/v1/jobs")
+        assert st == 200 and len(plain["jobs"]) == len(states)
+        # no query params -> byte-identical to the handler's own
+        # unfiltered listing
+        raw = urllib.request.urlopen(base + "/v1/jobs").read()
+        assert json.loads(raw) == {"jobs": sched.list()}
+        st, body = serve_bench.http_json(base + "/v1/jobs?state=done")
+        assert st == 200
+        assert [j["status"] for j in body["jobs"]] == ["done", "done"]
+        st, body = serve_bench.http_json(
+            base + "/v1/jobs?state=done&limit=1")
+        assert st == 200 and len(body["jobs"]) == 1
+        # limit keeps the N most RECENT records (ids are monotonic)
+        assert body["jobs"][0]["job_id"] == "job-000002"
+        st, body = serve_bench.http_json(base + "/v1/jobs?limit=3")
+        assert st == 200
+        assert [j["job_id"] for j in body["jobs"]] == \
+            ["job-000003", "job-000004", "job-000005"]
+        st, body = serve_bench.http_json(base + "/v1/jobs?state=bogus")
+        assert st == 400 and "state" in body["error"]
+        st, body = serve_bench.http_json(base + "/v1/jobs?limit=zero")
+        assert st == 400 and "limit" in body["error"]
+        st, body = serve_bench.http_json(base + "/v1/jobs?limit=0")
+        assert st == 400
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def test_worker_pool_fairness_and_slice_visibility(tmp_path, corpus_dir):
+    """K=2 workers, 4 queued jobs: exactly K run at once on DISJOINT
+    fair-share slices (FIFO), the rest wait; a released slice goes to
+    the next queued job; /healthz and /metrics carry the occupancy."""
+    conf, _ = _serve_conf(tmp_path, name="fw")
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=8, job_workers=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    jids = []
+    try:
+        for seed in (5, 6, 7, 8):
+            st, job = serve_bench.http_json(
+                base + "/v1/kernels/fw/train",
+                {"epochs": 500, "seed": seed, "train": "BP",
+                 "samples": corpus_dir, "ckpt_every": 1})
+            assert st == 202, job
+            jids.append(job["job_id"])
+        # exactly the first K=2 jobs run, on disjoint fair shares
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            snaps = {}
+            for jid in jids[:2]:
+                _, snaps[jid] = serve_bench.http_json(
+                    base + f"/v1/jobs/{jid}")
+            if all(s["status"] == "running" and s.get("slice")
+                   for s in snaps.values()):
+                break
+            time.sleep(0.02)
+        s0, s1 = snaps[jids[0]], snaps[jids[1]]
+        assert s0["status"] == "running" and s1["status"] == "running"
+        assert s0["slice"]["size"] == 4 and s1["slice"]["size"] == 4
+        assert not (set(s0["slice"]["devices"])
+                    & set(s1["slice"]["devices"]))
+        # the later 2 jobs wait their turn (K < queued fairness)
+        for jid in jids[2:]:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            assert snap["status"] == "queued", snap
+        # occupancy surfaces everywhere an operator looks
+        st, hz = serve_bench.http_json(base + "/healthz")
+        assert st == 200
+        assert hz["active_jobs"] == 4
+        assert hz["job_slices"]["slices_active"] == 2
+        assert hz["job_slices"]["devices_in_use"] == 8
+        prom = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "hpnn_jobs_slices_active 2" in prom
+        assert "hpnn_jobs_slice_devices_in_use 8" in prom
+        assert "hpnn_jobs_slice_devices_total 8" in prom
+        assert f'hpnn_jobs_slice_devices{{job="{jids[0]}"' in prom
+        # cancel the FIRST running job: its slice frees and the next
+        # queued job (FIFO) takes an equal-size slice
+        st, _b = serve_bench.http_json(
+            base + f"/v1/jobs/{jids[0]}/cancel", {})
+        assert st == 200
+        snap = _wait_terminal(base, jids[0])
+        assert snap["status"] == "cancelled"
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            _, third = serve_bench.http_json(
+                base + f"/v1/jobs/{jids[2]}")
+            if third["status"] == "running" and third.get("slice"):
+                break
+            time.sleep(0.02)
+        assert third["status"] == "running", third
+        assert third["slice"]["size"] == 4
+    finally:
+        for jid in jids:
+            with contextlib.suppress(Exception):
+                serve_bench.http_json(base + f"/v1/jobs/{jid}/cancel",
+                                      {})
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+def _submit_and_wait(base, kernel, params, timeout_s=240.0):
+    st, job = serve_bench.http_json(
+        base + f"/v1/kernels/{kernel}/train", params)
+    assert st == 202, job
+    snap = _wait_terminal(base, job["job_id"], timeout_s=timeout_s)
+    assert snap["status"] == "done", snap
+    kern = open(os.path.join(snap["path"], "kernel.opt"), "rb").read()
+    return snap, kern
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("mode_b", ["dp", "tp"])
+def test_concurrent_jobs_disjoint_slices_byte_parity(tmp_path,
+                                                     corpus_dir,
+                                                     mode_b):
+    """The ISSUE 19 acceptance: two jobs running CONCURRENTLY on
+    disjoint slices of the 8-device mesh each finish byte-identical to
+    the same job run serially on a same-sized slice, under live eval
+    traffic with zero non-200s -- including the variant where one job
+    pins a TP slice ([model]) while the other trains DP."""
+    epochs = 5
+    conf, _ = _serve_conf(tmp_path, name="cc")
+    app = ServeApp(max_batch=8, max_queue_rows=512)
+    app.add_model(conf, warmup=True)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=8, job_workers=2)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    params_a = {"epochs": epochs, "seed": 5, "train": "BP",
+                "samples": corpus_dir, "ckpt_every": 1,
+                "dp_devices": 4, "batch": 3}
+    if mode_b == "dp":
+        params_b = {"epochs": epochs, "seed": 9, "train": "BP",
+                    "samples": corpus_dir, "ckpt_every": 1,
+                    "dp_devices": 4, "batch": 3}
+        size_b = 4
+    else:
+        params_b = {"epochs": epochs, "seed": 9, "train": "BP",
+                    "samples": corpus_dir, "ckpt_every": 1,
+                    "model_parallel": 2}
+        size_b = 2
+    x = np.linspace(-1, 1, N_IN).reshape(1, N_IN)
+    stop = threading.Event()
+    failures: list = []
+
+    def hammer():
+        while not stop.is_set():
+            st, body = serve_bench.http_json(
+                base + "/v1/kernels/cc/infer", {"inputs": x.tolist()})
+            if st != 200:
+                failures.append((st, body))
+
+    try:
+        # serial references, each alone on its same-sized slice
+        ref_a_snap, ref_a = _submit_and_wait(base, "cc", params_a)
+        assert ref_a_snap["slice"]["size"] == 4
+        ref_b_snap, ref_b = _submit_and_wait(base, "cc", params_b)
+        assert ref_b_snap["slice"]["size"] == size_b
+        assert ref_b_snap["slice"]["tp"] == (2 if mode_b == "tp" else 1)
+        # concurrent: both submitted back-to-back under eval load
+        threads = [threading.Thread(target=hammer) for _ in range(2)]
+        for t in threads:
+            t.start()
+        st, job_a = serve_bench.http_json(
+            base + "/v1/kernels/cc/train", params_a)
+        assert st == 202, job_a
+        st, job_b = serve_bench.http_json(
+            base + "/v1/kernels/cc/train", params_b)
+        assert st == 202, job_b
+        ja, jb = job_a["job_id"], job_b["job_id"]
+        # both must be RUNNING at once on disjoint slices
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            _, sa = serve_bench.http_json(base + f"/v1/jobs/{ja}")
+            _, sb = serve_bench.http_json(base + f"/v1/jobs/{jb}")
+            both = (sa["status"] in ("running", "snapshotting")
+                    and sb["status"] in ("running", "snapshotting")
+                    and sa.get("slice") and sb.get("slice"))
+            if both or sa["status"] == "done" or sb["status"] == "done":
+                break
+            time.sleep(0.005)
+        assert both, (sa, sb)
+        assert not (set(sa["slice"]["devices"])
+                    & set(sb["slice"]["devices"]))
+        snap_a = _wait_terminal(base, ja, timeout_s=240.0)
+        snap_b = _wait_terminal(base, jb, timeout_s=240.0)
+        stop.set()
+        for t in threads:
+            t.join()
+        assert snap_a["status"] == "done", snap_a
+        assert snap_b["status"] == "done", snap_b
+        # zero dropped/non-200 eval requests while both jobs trained
+        assert failures == []
+        # byte parity: concurrent == serial on a same-sized slice
+        conc_a = open(os.path.join(snap_a["path"], "kernel.opt"),
+                      "rb").read()
+        conc_b = open(os.path.join(snap_b["path"], "kernel.opt"),
+                      "rb").read()
+        assert conc_a == ref_a
+        assert conc_b == ref_b
+        # the error trajectories agree too (same mesh shape, any slice)
+        assert snap_a["errors"] == ref_a_snap["errors"]
+        assert snap_b["errors"] == ref_b_snap["errors"]
+    finally:
+        stop.set()
+        httpd.shutdown()
+        app.close(drain=True)
+
+
+@pytest.mark.slow
+def test_pinned_slice_resume_byte_exact(tmp_path, corpus_dir):
+    """A cancelled pinned job resumes onto an EQUAL-SIZE slice (not
+    necessarily the same devices) and finishes byte-identical to the
+    same params run straight through."""
+    conf, _ = _serve_conf(tmp_path, name="pr")
+    app = ServeApp(max_batch=8)
+    app.add_model(conf, warmup=False)
+    app.enable_jobs(str(tmp_path / "jobs"), capacity=4)
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = "http://127.0.0.1:%d" % httpd.server_address[1]
+    pinned = {"seed": 5, "train": "BP", "samples": corpus_dir,
+              "ckpt_every": 1, "dp_devices": 4, "batch": 3}
+    try:
+        st, job = serve_bench.http_json(
+            base + "/v1/kernels/pr/train", dict(pinned, epochs=500))
+        assert st == 202, job
+        jid = job["job_id"]
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            _, snap = serve_bench.http_json(base + f"/v1/jobs/{jid}")
+            if snap["epoch"] >= 2:
+                break
+            time.sleep(0.02)
+        assert snap["epoch"] >= 2
+        st, _b = serve_bench.http_json(base + f"/v1/jobs/{jid}/cancel",
+                                       {})
+        assert st == 200
+        snap = _wait_terminal(base, jid)
+        assert snap["status"] == "cancelled"
+        assert snap["slice"]["size"] == 4
+        target = snap["epoch"] + 2
+        # resume WITHOUT re-declaring the slice ask: it is inherited,
+        # and the resumed job re-acquires an equal-size slice
+        st, job2 = serve_bench.http_json(
+            base + "/v1/kernels/pr/train",
+            {"resume_job": jid, "epochs": target})
+        assert st == 202, job2
+        snap2 = _wait_terminal(base, job2["job_id"])
+        assert snap2["status"] == "done", snap2
+        assert snap2["resumed_from"] == jid
+        assert snap2["slice"]["size"] == 4
+        assert snap2["params"]["dp_devices"] == 4
+        resumed = open(os.path.join(snap2["path"], "kernel.opt"),
+                       "rb").read()
+        # straight-through reference: same params, same slice size
+        ref_snap, ref = _submit_and_wait(
+            base, "pr", dict(pinned, epochs=target))
+        assert ref_snap["slice"]["size"] == 4
+        assert resumed == ref
+        assert snap2["errors"] == ref_snap["errors"]
+    finally:
+        httpd.shutdown()
         app.close(drain=True)
