@@ -32,12 +32,13 @@ TRACE_TESTS = tests/test_trace_analytics.py
 AUTOSCALE_TESTS = tests/test_autoscale.py
 LNN_TESTS = tests/test_lnn.py
 TP_TESTS = tests/test_tp_engine.py
+SWARM_TESTS = tests/test_swarm.py
 
 check:
 	python -m pytest $(FAST_TESTS) $(MESH_TESTS) $(SERVE_TESTS) \
 	    $(SERVE_MESH_TESTS) $(CHAOS_TESTS) $(TRAIN_CHAOS_TESTS) \
 	    $(CKPT_TESTS) $(JOBS_TESTS) $(OBS_TESTS) $(TRACE_TESTS) \
-	    $(AUTOSCALE_TESTS) $(LNN_TESTS) $(TP_TESTS) -q
+	    $(AUTOSCALE_TESTS) $(LNN_TESTS) $(TP_TESTS) $(SWARM_TESTS) -q
 
 # serving tier: registry/batcher/metrics units + the end-to-end HTTP run
 # (live ThreadingHTTPServer on an ephemeral port, CPU backend, driven by
@@ -254,6 +255,27 @@ model-bench:
 	python scripts/model_bench.py --out MODEL_BENCH.json \
 	    $(if $(REAL),--real)
 
+# swarm distribution tier (ISSUE 20): streamed blob verification,
+# per-dest single-flight under a thundering herd, peer-miss/poisoned-
+# peer fallback to the router origin, the seeded-wave coherent reload
+# (router egress capped at seeds x size, who-has index growth via
+# heartbeats), HPNN_MESH_SWARM=0 byte-identical router-only, and the
+# seeding-peer-kill chaos drill (zero failed reloads)
+swarm-check:
+	env JAX_PLATFORMS=cpu python -m pytest $(SWARM_TESTS) -q
+
+# swarm reload capture (ISSUE 20): 8 subprocess workers on disjoint
+# blob caches under an HPNN_FAULT latency throttle on every blob GET --
+# router-only (HPNN_MESH_SWARM=0) vs seeded-wave swarm reload wall
+# clock and ROUTER egress bytes.  Floors: swarm >= 2x faster, router
+# serves exactly HPNN_MESH_SWARM_SEEDS workers (egress counter),
+# every non-seed fetch a peer hit, zero failed reloads; emits
+# SWARM_BENCH.json, rc!=0 when a floor misses.
+# tests/test_bench_probe.py holds the committed artifact in tier 1
+swarm-bench:
+	env JAX_PLATFORMS=cpu python scripts/swarm_bench.py \
+	    --out SWARM_BENCH.json
+
 # TP parity tier (ISSUE 17): ring-engine unit parity ({ANN,SNN,LNN} x
 # {BP,BPM} x {f64,bf16} x {1-D, 2-D mesh}), overlap-vs-gather oracle,
 # pipeline-vs-restage byte parity, kill/--resume on the TP route, and
@@ -277,4 +299,4 @@ obs-bench:
     serve-bench io-bench epoch-bench dp-epoch-bench dp-host-bench \
     mfu-bench \
     mesh-bench autoscale-check trace-check lnn-check trainers-bench \
-    model-bench tp-check
+    model-bench tp-check swarm-check swarm-bench

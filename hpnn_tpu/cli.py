@@ -1022,6 +1022,7 @@ def serve_nn_main(argv: list[str] | None = None) -> int:
         advertise = args.advertise or f"127.0.0.1:{port}"
         app.mesh_worker = WorkerAgent(app, args.router,
                                       advertise).start()
+        app.metrics.set_swarm_source(app.mesh_worker.swarm_snapshot)
         sys.stdout.write(f"SERVE: mesh worker (router {args.router}, "
                          f"advertising {advertise})\n")
     # unconditional: the bound port is the serving contract (with -p 0

@@ -212,13 +212,37 @@ class LoadShedder:
         self.active = False
         self.engaged_total = 0
         self.shed_total = 0
+        self.stale_served_total = 0
         self._last_burn = 0.0
         self._last_eval = 0.0
         self._eval_every = min(0.5, max(self.clear_after_s / 8.0, 0.01))
 
     def should_shed(self, lane: int) -> bool:
         """The admission decision for one request (also advances the
-        engage/clear state machine)."""
+        engage/clear state machine).  Counts the shed; callers that can
+        degrade instead (brownout stale-serve, ISSUE 20) use
+        :meth:`gate_engaged` + the explicit counters."""
+        if self.gate_engaged(lane):
+            self.count_shed()
+            return True
+        return False
+
+    def count_shed(self) -> None:
+        with self._lock:
+            self.shed_total += 1
+
+    def count_stale(self) -> None:
+        """A low-lane request served STALE (pinned to a retained prior
+        generation) instead of shed -- the brownout rung between full
+        service and 429 (ROADMAP 2c)."""
+        with self._lock:
+            self.stale_served_total += 1
+
+    def gate_engaged(self, lane: int) -> bool:
+        """Advance the engage/clear state machine and report whether
+        this request's lane is gated, WITHOUT counting anything: the
+        caller picks the degradation rung (serve stale vs shed) and
+        records it via :meth:`count_stale` / :meth:`count_shed`."""
         if not self.active and not self.tracker.any_burning():
             return False  # steady healthy state: zero-cost
         from .events import mesh_event
@@ -251,10 +275,7 @@ class LoadShedder:
                     "mesh: low-lane shedding cleared (SLO burn out "
                     f"for {self.clear_after_s:g}s)\n",
                     level="out", shed_total=self.shed_total)
-            if self.active and lane >= self.shed_lane:
-                self.shed_total += 1
-                return True
-            return False
+            return self.active and lane >= self.shed_lane
 
     def retry_after_s(self) -> float:
         """What the 429 tells an obedient client: the clear hysteresis
@@ -267,6 +288,7 @@ class LoadShedder:
             return {"active": self.active,
                     "engaged_total": self.engaged_total,
                     "shed_total": self.shed_total,
+                    "stale_served_total": self.stale_served_total,
                     "clear_after_s": self.clear_after_s,
                     "shed_lane": LANE_NAMES.get(self.shed_lane, "low")}
 

@@ -47,6 +47,7 @@ from .backend import (
     post_json,
 )
 from .events import mesh_event
+from .worker import swarm_enabled
 
 STATE_LIVE = "live"
 STATE_WARMING = "warming"   # registered, /healthz still 503-warming
@@ -83,6 +84,12 @@ class BlobStore:
         self._blobs: OrderedDict[str, bytes] = OrderedDict()
         self._bytes = 0
         self._lock = threading.Lock()
+        # observability (ISSUE 20 satellite): LRU pressure and the
+        # router's blob-serving egress were invisible; /metrics renders
+        # both (hpnn_mesh_blob_evictions_total / _egress_bytes_total)
+        self.evictions_total = 0
+        self.egress_bytes_total = 0
+        self.serves_total = 0
 
     def put(self, data: bytes) -> dict:
         """Insert (idempotent) and return the ``{sha256, size}`` meta
@@ -98,6 +105,7 @@ class BlobStore:
                        and len(self._blobs) > 1):
                     _old, dropped = self._blobs.popitem(last=False)
                     self._bytes -= len(dropped)
+                    self.evictions_total += 1
         return {"sha256": sha, "size": len(data)}
 
     def get(self, sha: str) -> bytes | None:
@@ -107,10 +115,19 @@ class BlobStore:
                 self._blobs.move_to_end(sha)
             return data
 
+    def count_egress(self, n: int) -> None:
+        """One blob served over HTTP: ``n`` bytes left this host."""
+        with self._lock:
+            self.serves_total += 1
+            self.egress_bytes_total += int(n)
+
     def stats(self) -> dict:
         with self._lock:
             return {"blobs": len(self._blobs), "bytes": self._bytes,
-                    "max_bytes": self.max_bytes}
+                    "max_bytes": self.max_bytes,
+                    "evictions_total": self.evictions_total,
+                    "serves_total": self.serves_total,
+                    "egress_bytes_total": self.egress_bytes_total}
 
 
 class Worker:
@@ -118,7 +135,7 @@ class Worker:
 
     __slots__ = ("wid", "addr", "state", "fails", "inflight", "routed",
                  "failovers", "kernels", "created_at", "last_seen",
-                 "jobs", "retired_at", "goodbye")
+                 "jobs", "retired_at", "goodbye", "blobs")
 
     def __init__(self, addr: str):
         self.wid = addr  # the advertised addr IS the identity
@@ -130,10 +147,18 @@ class Worker:
         self.failovers = 0
         self.kernels: dict[str, dict] = {}
         self.jobs: dict | None = None  # heartbeat-advertised job state
+        # swarm who-has index (ISSUE 20): sha256 PREFIXES this worker's
+        # heartbeat advertised -- the router picks peer hints from it
+        self.blobs: set[str] = set()
         self.created_at = time.time()  # displayed registration timestamp
         self.last_seen = time.monotonic()
         self.retired_at = 0.0  # monotonic; set when retiring starts
         self.goodbye = False   # said {"retiring": true} (graceful exit)
+
+    def has_blob(self, sha: str) -> bool:
+        """Does the advertised has-set cover this sha?  Prefix match,
+        so router and worker need not agree on the prefix length."""
+        return any(sha.startswith(p) for p in self.blobs)
 
     def to_dict(self) -> dict:
         d = {"addr": self.addr, "state": self.state,
@@ -144,6 +169,10 @@ class Worker:
              "kernels": {n: dict(v) for n, v in self.kernels.items()}}
         if self.jobs is not None:
             d["jobs"] = dict(self.jobs)
+        if self.blobs:
+            # the standby's mirror adopts the who-has index, so a
+            # takeover keeps swarming without waiting a heartbeat round
+            d["blobs"] = sorted(self.blobs)
         return d
 
 
@@ -186,7 +215,8 @@ class WorkerPool:
 
     # --- membership ------------------------------------------------------
     def register(self, addr: str, kernels: dict | None = None,
-                 jobs: dict | None = None) -> Worker:
+                 jobs: dict | None = None,
+                 blobs: list | None = None) -> Worker:
         """Create or refresh a worker entry (registration doubles as the
         heartbeat).  A re-registering dead worker is readmitted -- the
         process restarted or the partition healed.  A WARMING worker
@@ -228,6 +258,11 @@ class WorkerPool:
                              if isinstance(v, dict)}
             if jobs is not None and isinstance(jobs, dict):
                 w.jobs = jobs
+            if blobs is not None and isinstance(blobs, (list, tuple)):
+                # the heartbeat's has-set REPLACES the index entry (the
+                # worker's cache is the truth; evicted blobs drop out)
+                w.blobs = {str(p).lower() for p in blobs
+                           if isinstance(p, str) and p}
             return w
 
     def workers(self) -> list[Worker]:
@@ -563,17 +598,43 @@ class MeshRouter:
         """The HTTP layer's lookup for ``GET /v1/mesh/blob/<sha>``; a
         miss re-checks every served model's current source (an LRU
         eviction or router restart must not 404 the fleet's CURRENT
-        generation)."""
+        generation).  Served bytes count into the egress totals -- the
+        number the swarm bench reads to prove the router NIC left the
+        reload hot path."""
         data = self.blobs.get(sha)
+        if data is None:
+            for name in self.app.registry.names():
+                meta = self.blob_for(name)
+                if meta is not None and meta["sha256"] == sha:
+                    data = self.blobs.get(sha)
+                    break
+        if data is None:
+            # replicated checkpoint bundles have a durable spool the LRU
+            # cannot evict and a restart cannot lose (ISSUE 14)
+            data = self.bundle_blob_bytes(sha)
         if data is not None:
-            return data
-        for name in self.app.registry.names():
-            meta = self.blob_for(name)
-            if meta is not None and meta["sha256"] == sha:
-                return self.blobs.get(sha)
-        # replicated checkpoint bundles have a durable spool the LRU
-        # cannot evict and a restart cannot lose (ISSUE 14)
-        return self.bundle_blob_bytes(sha)
+            self.blobs.count_egress(len(data))
+        return data
+
+    # --- swarm who-has index (ISSUE 20) ----------------------------------
+    def holders_of(self, sha: str, exclude: str | None = None,
+                   cap: int = 8) -> list[str]:
+        """Worker addresses whose advertised has-set covers ``sha`` --
+        the peer-hint list a registration ack or reload broadcast
+        carries.  Dead/retiring workers never seed (a hint to a corpse
+        just costs the fetcher one bounded miss, but why hand them
+        out); the fetcher jitters the order, so this list is stable."""
+        out = []
+        for w in self.pool.workers():
+            if w.state in (STATE_DEAD, STATE_RETIRING):
+                continue
+            if w.addr == exclude:
+                continue
+            if w.has_blob(sha):
+                out.append(w.addr)
+                if len(out) >= cap:
+                    break
+        return out
 
     # --- replicated checkpoint bundles (POST /v1/mesh/bundle) ------------
     def _bundle_scope_dir(self, scope: str) -> str:
@@ -671,23 +732,27 @@ class MeshRouter:
 
     # --- registration (POST /v1/mesh/register) ---------------------------
     def register_worker(self, addr: str, kernels: dict | None,
-                        jobs: dict | None = None) -> dict:
-        self.pool.register(addr, kernels, jobs=jobs)
+                        jobs: dict | None = None,
+                        blobs: list | None = None) -> dict:
+        self.pool.register(addr, kernels, jobs=jobs, blobs=blobs)
         # the ack tells the worker where the fleet SHOULD be: current
         # generation + weights blob (and source path, for shared-mount
         # fleets) per kernel, so an ejected/late worker catches itself
         # up before taking traffic again -- plus the standby to follow
-        # on takeover and the spill-protection token
+        # on takeover and the spill-protection token.  With the swarm
+        # on, each kernel's blob also carries peer hints, so the
+        # heartbeat catch-up path swarms exactly like a broadcast.
         ack = {"ok": True, "live": self.pool.live_count(),
                "required": self.required,
-               "kernels": self._kernel_state(),
+               "kernels": self._kernel_state(exclude=addr),
                "router_token": self.router_token}
         if self.standby_addr:
             ack["standby"] = self.standby_addr
         return ack
 
-    def _kernel_state(self) -> dict:
+    def _kernel_state(self, exclude: str | None = None) -> dict:
         state = {}
+        swarm = swarm_enabled()
         for name in self.app.registry.names():
             model = self.app.registry.get(name)
             if model is None:
@@ -697,6 +762,11 @@ class MeshRouter:
             blob = self.blob_for(name)
             if blob is not None:
                 info["blob"] = blob
+                if swarm:
+                    peers = self.holders_of(blob["sha256"],
+                                            exclude=exclude)
+                    if peers:
+                        info["peers"] = peers
             state[name] = info
         return state
 
@@ -783,18 +853,19 @@ class MeshRouter:
         headers = {}
         if self.app.auth_token:
             headers["Authorization"] = f"Bearer {self.app.auth_token}"
-        for w in self.pool.workers():
-            if w.state == STATE_DEAD:
-                continue  # readmission catch-up handles it later
+        swarm = swarm_enabled()
+
+        def _push(w, peers) -> bool:
+            payload = {"blob": blob, "set_generation": target}
+            if peers:
+                payload["peers"] = peers
             try:
                 status, body, _ = post_json(
                     w.addr, f"/v1/kernels/{name}/reload",
-                    {"blob": blob, "set_generation": target},
-                    timeout_s=30.0, headers=headers)
+                    payload, timeout_s=30.0, headers=headers)
             except TRANSPORT_ERRORS as exc:
                 self.pool.report_failure(w, exc)
-                failed.append(w.wid)
-                continue
+                return False
             if status != 200:
                 # the worker answered but could not land the weights:
                 # eject it from routing until its heartbeat catches up,
@@ -802,11 +873,52 @@ class MeshRouter:
                 self.pool.report_failure(
                     w, RuntimeError(f"reload HTTP {status}: "
                                     f"{body.get('error')}"))
-                failed.append(w.wid)
-                continue
+                return False
             w.kernels.setdefault(name, {})["generation"] = \
                 body.get("generation", target)
-            ok_workers.append(w.wid)
+            if swarm:
+                # the worker just landed + verified these bytes: index
+                # it as a holder NOW so the next wave (and heartbeat
+                # acks) can hint it, without a heartbeat round-trip
+                w.blobs.add(blob["sha256"])
+            return True
+
+        alive = [w for w in self.pool.workers()
+                 if w.state != STATE_DEAD]  # readmission catches dead up
+        if swarm and len(alive) > 1:
+            # swarm fan-out (ISSUE 20): the router seeds only K workers
+            # (its egress stays O(K), not O(N)); every later wave is
+            # hinted at the confirmed holders and sized to their count,
+            # so availability doubles per wave -- the tree/ring
+            # broadcast shape, not root-serialized sends.  Waves run
+            # concurrently; a wave with zero surviving holders falls
+            # back to seeding from the router again, so a seed failure
+            # degrades to the origin path instead of stranding the tail.
+            seeds_n = _env_int("HPNN_MESH_SWARM_SEEDS", 2, lo=1)
+            pending = list(alive)
+            holders: list = []
+            wave = pending[:seeds_n]
+            pending = pending[seeds_n:]
+            while wave:
+                hints = [h.addr for h in holders[:8]]
+                with ThreadPoolExecutor(max_workers=len(wave)) as ex:
+                    landed = list(ex.map(lambda w: _push(w, hints),
+                                         wave))
+                for w, okd in zip(wave, landed):
+                    if okd:
+                        ok_workers.append(w.wid)
+                        holders.append(w)
+                    else:
+                        failed.append(w.wid)
+                step = len(holders) if holders else seeds_n
+                wave = pending[:step]
+                pending = pending[step:]
+        else:
+            for w in alive:
+                if _push(w, None):
+                    ok_workers.append(w.wid)
+                else:
+                    failed.append(w.wid)
         mesh_event("reload_broadcast",
                    f"mesh: broadcast reload '{name}' gen {target}: "
                    f"{len(ok_workers)} ok, {len(failed)} failed\n",

@@ -141,7 +141,11 @@ class StandbyMonitor:
         for addr, w in workers.items():
             if not isinstance(w, dict) or w.get("state") == "dead":
                 continue
-            self.router.pool.register(str(addr), w.get("kernels"))
+            # blobs: the primary's who-has index rides in to_dict(),
+            # so a takeover keeps swarming instead of re-learning who
+            # holds what one heartbeat at a time
+            self.router.pool.register(str(addr), w.get("kernels"),
+                                      blobs=w.get("blobs"))
         # spill-protection token: present only on an auth-guarded
         # mirror; adopting it keeps --require-router workers serving
         # routed traffic across a takeover

@@ -293,6 +293,52 @@ class BlobError(Exception):
     sha256/size verification."""
 
 
+_HASH_CHUNK = 1 << 20  # hash in bounded 1 MiB pieces, never one buffer
+
+
+def _sha256_hex(data) -> str:
+    """Chunked sha256 of a fetched body: the hasher consumes bounded
+    memoryview slices -- the same streaming loop the file re-verify
+    uses, so neither path feeds it one giant buffer."""
+    h = hashlib.sha256()
+    view = memoryview(data)
+    for off in range(0, len(view), _HASH_CHUNK):
+        h.update(view[off:off + _HASH_CHUNK])
+    return h.hexdigest()
+
+
+def verify_blob_file(path: str, sha256: str,
+                     size: int | None = None) -> bool:
+    """Streaming re-verify of an already-landed blob: the size check
+    runs FIRST (one stat -- a truncated file short-circuits before any
+    hashing), then the sha256 streams in bounded chunks instead of a
+    whole-file read."""
+    try:
+        if size is not None and os.path.getsize(path) != int(size):
+            return False
+        h = hashlib.sha256()
+        with open(path, "rb") as fp:
+            while True:
+                piece = fp.read(_HASH_CHUNK)
+                if not piece:
+                    break
+                h.update(piece)
+    except OSError:
+        return False
+    return h.hexdigest() == sha256
+
+
+# per-sha single-flight (ISSUE 20): concurrent reload broadcasts for
+# one generation must download each blob ONCE per host -- the first
+# caller fetches, later callers wait on its event and re-verify the
+# landed file.  Keyed by dest path, so distinct blob dirs (tests, two
+# agents in one process) never serialize on each other.
+_sf_lock = threading.Lock()
+_sf_events: dict[str, threading.Event] = {}
+
+_PEER_TIMEOUT_S = 5.0  # one peer try never eats the whole deadline
+
+
 def fetch_blob(addr: str, sha256: str, size: int | None,
                dest_dir: str, timeout_s: float = 15.0,
                headers: dict | None = None,
@@ -307,16 +353,110 @@ def fetch_blob(addr: str, sha256: str, size: int | None,
     Content addressing makes this idempotent: a file already present
     under the right name is re-verified and reused, so concurrent
     reload broadcasts for one generation fetch once."""
+    path, _src, _misses = fetch_blob_from(
+        addr, sha256, size, dest_dir, timeout_s=timeout_s,
+        headers=headers, attempts=attempts)
+    return path
+
+
+def fetch_blob_from(addr: str, sha256: str, size: int | None,
+                    dest_dir: str, peers: tuple | list = (),
+                    timeout_s: float = 15.0,
+                    headers: dict | None = None,
+                    attempts: int = 3,
+                    rng: random.Random | None = None
+                    ) -> tuple[str, str, int]:
+    """Multi-source blob fetch (ISSUE 20): try the hinted ``peers``
+    (jittered order, one bounded try each) before falling back to
+    ``addr`` -- the router, the always-correct origin -- so a reload
+    broadcast's bytes fan out peer-to-peer instead of serializing on
+    one NIC.  A peer that 404s (has not landed the blob yet), fails at
+    the transport layer, or serves bytes that do not hash to ``sha256``
+    (a poisoned peer: NEVER loadable) just advances to the next source.
+
+    Returns ``(path, source, peer_misses)``: the landed file, the
+    address that served the bytes (``"cache"`` when the file was
+    already present and re-verified), and how many peer tries failed.
+
+    Per-sha single-flight: concurrent calls for one dest download once
+    -- the leader fetches, the rest wait and re-verify the landed
+    file."""
     if not sha256 or not all(c in "0123456789abcdef"
                              for c in sha256.lower()):
         raise BlobError(f"bad sha256 {sha256!r}")
     sha256 = sha256.lower()
     dest = os.path.join(dest_dir, f"{sha256}.opt")
-    if os.path.isfile(dest):
-        with open(dest, "rb") as fp:
-            if hashlib.sha256(fp.read()).hexdigest() == sha256:
-                return dest
     deadline = time.monotonic() + timeout_s
+    while True:
+        if verify_blob_file(dest, sha256, size):
+            return dest, "cache", 0
+        with _sf_lock:
+            ev = _sf_events.get(dest)
+            if ev is None:
+                _sf_events[dest] = ev = threading.Event()
+                break  # leader: this call performs the download
+        # a concurrent fetch of this blob is in flight on this host:
+        # wait for it, then re-verify what it landed (followers never
+        # open a second download)
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise BlobError(f"blob {sha256}: timed out waiting for a "
+                            "concurrent fetch")
+        ev.wait(remaining)
+        # loop: either the landed file verifies, or the leader failed
+        # and this caller takes leadership on the next pass
+    try:
+        return _fetch_multi(addr, sha256, size, dest, dest_dir,
+                            peers, deadline, headers, attempts, rng)
+    finally:
+        with _sf_lock:
+            _sf_events.pop(dest, None)
+        ev.set()
+
+
+def _land_blob(dest_dir: str, dest: str, raw: bytes) -> None:
+    from ...io.atomic import atomic_write_bytes
+
+    os.makedirs(dest_dir, exist_ok=True)
+    atomic_write_bytes(dest, raw)
+
+
+def _fetch_multi(addr: str, sha256: str, size: int | None, dest: str,
+                 dest_dir: str, peers, deadline: float,
+                 headers: dict | None, attempts: int,
+                 rng: random.Random | None) -> tuple[str, str, int]:
+    path = f"/v1/mesh/blob/{sha256}"
+    misses = 0
+    order = [p for p in peers if p and p != addr]
+    (rng or random).shuffle(order)
+    for peer in order:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            status, raw, _ = request(
+                peer, "GET", path, headers=headers,
+                timeout_s=min(remaining, _PEER_TIMEOUT_S))
+        except TRANSPORT_ERRORS:
+            misses += 1
+            continue
+        if status != 200:
+            # peer miss: it has not landed this blob (or refused);
+            # unlike the router's 404 this is not authoritative
+            misses += 1
+            continue
+        if size is not None and len(raw) != int(size):
+            misses += 1
+            continue
+        if _sha256_hex(raw) != sha256:
+            # a poisoned peer serving wrong bytes: rejected by the
+            # hash, never swapped in -- try the next source
+            misses += 1
+            continue
+        _land_blob(dest_dir, dest, raw)
+        return dest, peer, misses
+    # router fallback: the always-correct origin, with the PR-11
+    # bounded-retry semantics unchanged
     backoff = Backoff(base_s=0.2, cap_s=5.0)
     last = "no attempt made"
     for i in range(max(1, attempts)):
@@ -331,7 +471,7 @@ def fetch_blob(addr: str, sha256: str, size: int | None,
                 break
         try:
             status, raw, _ = request(
-                addr, "GET", f"/v1/mesh/blob/{sha256}",
+                addr, "GET", path,
                 headers=headers, timeout_s=remaining)
         except TRANSPORT_ERRORS as exc:
             last = f"{type(exc).__name__}: {exc}"
@@ -343,7 +483,7 @@ def fetch_blob(addr: str, sha256: str, size: int | None,
                 raise BlobError(
                     f"blob {sha256} not found on {addr}")
             continue
-        actual = hashlib.sha256(raw).hexdigest()
+        actual = _sha256_hex(raw)
         if actual != sha256:
             # corruption in flight (or a lying peer): retryable, but
             # NEVER loadable
@@ -352,10 +492,7 @@ def fetch_blob(addr: str, sha256: str, size: int | None,
         if size is not None and len(raw) != int(size):
             last = f"size mismatch ({len(raw)} != {size})"
             continue
-        from ...io.atomic import atomic_write_bytes
-
-        os.makedirs(dest_dir, exist_ok=True)
-        atomic_write_bytes(dest, raw)
-        return dest
+        _land_blob(dest_dir, dest, raw)
+        return dest, addr, misses
     raise BlobError(f"blob {sha256} from {addr}: giving up after "
                     f"{attempts} attempt(s) ({last})")

@@ -34,14 +34,13 @@ through the router would silently fall back to current weights.
 
 from __future__ import annotations
 
-import hashlib
 import os
 import random
 import tempfile
 import threading
 import time
 
-from ...utils.env import env_float
+from ...utils.env import env_float, env_int
 from ...utils.nn_log import nn_warn
 from . import transport
 from .backend import TRANSPORT_ERRORS, post_json
@@ -52,16 +51,32 @@ def _heartbeat_s(default: float = 2.0) -> float:
     return env_float("HPNN_MESH_HEARTBEAT_S", default)
 
 
+def swarm_enabled() -> bool:
+    """Peer-to-peer blob fan-out (ISSUE 20).  ``HPNN_MESH_SWARM=0`` is
+    the escape hatch: router-only pulls, byte-identical to the PR-11
+    path (no peer hints sent, none consumed)."""
+    return env_int("HPNN_MESH_SWARM", 1) != 0
+
+
+def hasset_prefix_len() -> int:
+    """Hex chars of each advertised sha prefix (compactness knob): 12
+    gives 48 bits -- collision-safe for any real fleet's blob count
+    while keeping a 32-entry has-set under 500 bytes per heartbeat."""
+    return env_int("HPNN_MESH_HASSET_PREFIX", 12, lo=4, hi=64)
+
+
+def hasset_max() -> int:
+    """Most blobs one heartbeat advertises (newest first)."""
+    return env_int("HPNN_MESH_HASSET_MAX", 32, lo=1)
+
+
 def _path_matches_blob(path: str, blob: dict) -> bool:
     """Does the file at ``path`` already hold exactly the announced
     bytes?  Shared-mount fleets short-circuit the HTTP fetch this way;
-    a same-named but DIFFERENT file on a disjoint host does not."""
-    try:
-        with open(path, "rb") as fp:
-            return (hashlib.sha256(fp.read()).hexdigest()
-                    == str(blob.get("sha256", "")).lower())
-    except OSError:
-        return False
+    a same-named but DIFFERENT file on a disjoint host does not.
+    Streams the hash in bounded chunks (ISSUE 20 satellite)."""
+    return transport.verify_blob_file(
+        path, str(blob.get("sha256", "")).lower(), blob.get("size"))
 
 
 class WorkerAgent:
@@ -87,6 +102,17 @@ class WorkerAgent:
         self._thread: threading.Thread | None = None
         self._warned = False
         self._rng = random.Random()
+        # swarm accounting (ISSUE 20): fetch outcomes (hit = a hinted
+        # peer served the bytes, miss = one peer try failed, fallback =
+        # peers exhausted and the router served) plus this worker's OWN
+        # blob-serving egress -- what the bench reads to prove the
+        # router NIC left the reload hot path
+        self._swarm_lock = threading.Lock()
+        self.swarm_hits = 0
+        self.swarm_misses = 0
+        self.swarm_fallbacks = 0
+        self.blob_serves = 0
+        self.blob_egress_bytes = 0
         # registration-failure backoff: base = one heartbeat period,
         # capped so a long-dead router costs one probe per cap period
         self._backoff = transport.Backoff(
@@ -118,6 +144,13 @@ class WorkerAgent:
         if self.app.auth_token:
             headers["Authorization"] = f"Bearer {self.app.auth_token}"
         payload = {"addr": self.advertise, "kernels": kernels}
+        if swarm_enabled():
+            # who-has advertisement: compact sha prefixes of the local
+            # blob cache, so the router's worker table doubles as the
+            # swarm's who-has-what index.  Every completed fetch lands
+            # in blob_dir, so availability re-advertises itself on the
+            # next heartbeat without a dedicated gossip channel
+            payload["blobs"] = self.blob_has_set()
         if self.app.jobs is not None:
             # fleet-wide job visibility (ISSUE 10): the router's worker
             # table names the running job + its trace id, so
@@ -210,15 +243,21 @@ class WorkerAgent:
                     if self.app.auth_token:
                         headers = {"Authorization":
                                    f"Bearer {self.app.auth_token}"}
+                    peers = info.get("peers")
+                    if not (swarm_enabled()
+                            and isinstance(peers, list)):
+                        peers = ()
                     try:
-                        path = transport.fetch_blob(
+                        path, source, misses = transport.fetch_blob_from(
                             self.current, str(blob["sha256"]),
                             blob.get("size"), self.blob_dir,
-                            timeout_s=20.0, headers=headers)
+                            peers=peers, timeout_s=20.0,
+                            headers=headers, rng=self._rng)
                     except transport.BlobError as exc:
                         nn_warn(f"mesh: cannot catch '{name}' up to "
                                 f"generation {want}: {exc}\n")
                         continue
+                    self.count_fetch(source, misses, bool(peers))
             elif src and os.path.exists(src):
                 path = src  # pre-blob router: trust the shared mount
             if path is None:
@@ -236,6 +275,74 @@ class WorkerAgent:
             except (ValueError, KeyError) as exc:
                 nn_warn(f"mesh: catch-up reload of '{name}' failed: "
                         f"{exc}\n")
+
+    # --- swarm blob serving (ISSUE 20) ----------------------------------
+    def blob_has_set(self) -> list[str]:
+        """Compact who-has advertisement: sha256 prefixes
+        (``HPNN_MESH_HASSET_PREFIX`` hex chars) of the blobs this
+        worker's cache holds, newest first, at most
+        ``HPNN_MESH_HASSET_MAX`` entries.  File NAMES are trusted --
+        every landed blob was sha-verified at fetch time, and a peer
+        pull re-verifies anyway."""
+        try:
+            names = os.listdir(self.blob_dir)
+        except OSError:
+            return []
+        rows = []
+        for n in names:
+            sha = n[:-4] if n.endswith(".opt") else ""
+            if len(sha) == 64 and all(c in "0123456789abcdef"
+                                      for c in sha):
+                try:
+                    mt = os.path.getmtime(os.path.join(self.blob_dir, n))
+                except OSError:
+                    continue
+                rows.append((mt, sha))
+        rows.sort(reverse=True)
+        k = hasset_prefix_len()
+        return [sha[:k] for _mt, sha in rows[:hasset_max()]]
+
+    def blob_bytes(self, sha256: str) -> bytes | None:
+        """Serve a cached blob to a PEER -- the worker half of the
+        swarm (``GET /v1/mesh/blob/<sha>`` routes here when this server
+        is a worker).  None when the cache does not hold it (the peer
+        falls back to its next source); egress is counted so the bench
+        can prove who served what."""
+        path = os.path.join(self.blob_dir, f"{sha256.lower()}.opt")
+        try:
+            with open(path, "rb") as fp:
+                data = fp.read()
+        except OSError:
+            return None
+        with self._swarm_lock:
+            self.blob_serves += 1
+            self.blob_egress_bytes += len(data)
+        return data
+
+    def count_fetch(self, source: str, misses: int,
+                    had_peers: bool) -> None:
+        """Record one multi-source fetch outcome into the swarm
+        counters (cache re-use counts as nothing: no bytes moved)."""
+        with self._swarm_lock:
+            self.swarm_misses += misses
+            if source == "cache":
+                return
+            if not had_peers:
+                return
+            if source in (self.current, self.router_addr):
+                self.swarm_fallbacks += 1
+            else:
+                self.swarm_hits += 1
+
+    def swarm_snapshot(self) -> dict:
+        """The per-worker swarm counters /metrics renders."""
+        with self._swarm_lock:
+            return {"enabled": swarm_enabled(),
+                    "hits": self.swarm_hits,
+                    "misses": self.swarm_misses,
+                    "fallbacks": self.swarm_fallbacks,
+                    "blob_serves": self.blob_serves,
+                    "blob_egress_bytes": self.blob_egress_bytes}
 
     # --- lifecycle -------------------------------------------------------
     def next_delay(self, ok: bool) -> float:

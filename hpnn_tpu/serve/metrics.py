@@ -256,6 +256,9 @@ class ServeMetrics:
         # SLO-driven load shedder (ISSUE 13): live-callback like the
         # other subsystem sources; None when shedding is off
         self._shed_fn: Callable[[], dict] | None = None
+        # swarm weight distribution (ISSUE 20): a mesh WORKER's peer
+        # fetch counters; None on routers / single-node servers
+        self._swarm_fn: Callable[[], dict] | None = None
         # SLO tracker (ISSUE 10): None unless --slo-* configured; the
         # batcher records latency against it through this reference
         # (one attribute read on the off path)
@@ -417,6 +420,13 @@ class ServeMetrics:
         with self._lock:
             self._shed_fn = fn
 
+    def set_swarm_source(self, fn: Callable[[], dict] | None) -> None:
+        """Attach a mesh worker agent's swarm-fetch snapshot callback
+        (``mesh.worker.WorkerAgent.swarm_snapshot``): peer hit/miss/
+        fallback counters plus blob bytes this worker seeded to peers."""
+        with self._lock:
+            self._swarm_fn = fn
+
     def set_slo(self, tracker) -> None:
         """Attach the SLO tracker (obs.slo.SloTracker); its burn-rate
         gauges join both metric renderings."""
@@ -459,6 +469,7 @@ class ServeMetrics:
         autoscale_fn = self._autoscale_fn
         quota_fn = self._quota_fn
         shed_fn = self._shed_fn
+        swarm_fn = self._swarm_fn
         # the source callbacks take their own subsystem locks
         # (scheduler/store, worker pool, batchers): call them OUTSIDE
         # our own lock (no nested-lock ordering to get wrong)
@@ -467,6 +478,7 @@ class ServeMetrics:
         autoscale = autoscale_fn() if autoscale_fn is not None else None
         quota = quota_fn() if quota_fn is not None else None
         shed = shed_fn() if shed_fn is not None else None
+        swarm = swarm_fn() if swarm_fn is not None else None
         slo = self.slo.snapshot() if self.slo is not None else None
         # trace sampling + durable export (ISSUE 13): module-level obs
         # state, absent when unconfigured (the series must not exist
@@ -506,6 +518,8 @@ class ServeMetrics:
             out["quota"] = quota
         if shed is not None:
             out["shed"] = shed
+        if swarm is not None:
+            out["swarm"] = swarm
         if slo is not None:
             out["slo"] = slo
         if sampling is not None:
@@ -765,6 +779,12 @@ class ServeMetrics:
                 "transitions (one per incident, hysteresis on clear).",
                 "# TYPE hpnn_shed_engaged_total counter",
                 f"hpnn_shed_engaged_total {sh['engaged_total']}",
+                "# HELP hpnn_shed_stale_served_total Low-lane requests "
+                "served from a retained prior generation instead of "
+                "shed (brownout tier).",
+                "# TYPE hpnn_shed_stale_served_total counter",
+                f"hpnn_shed_stale_served_total "
+                f"{sh.get('stale_served_total', 0)}",
             ]
         if snap.get("trace_sampling") is not None:
             ts = snap["trace_sampling"]
@@ -843,6 +863,55 @@ class ServeMetrics:
                 lines.append(
                     "hpnn_mesh_worker_requests_total"
                     f'{{worker="{_escape_label(wid)}"}} {w["routed"]}')
+            blobs = msh.get("blobs")
+            if blobs is not None:
+                lines += [
+                    "# HELP hpnn_mesh_blob_evictions_total Blobs "
+                    "dropped by the router blob store's LRU cap.",
+                    "# TYPE hpnn_mesh_blob_evictions_total counter",
+                    f"hpnn_mesh_blob_evictions_total "
+                    f"{blobs.get('evictions_total', 0)}",
+                    "# HELP hpnn_mesh_blob_egress_bytes_total Blob "
+                    "bytes the router served over GET /v1/mesh/blob.",
+                    "# TYPE hpnn_mesh_blob_egress_bytes_total counter",
+                    f"hpnn_mesh_blob_egress_bytes_total "
+                    f"{blobs.get('egress_bytes_total', 0)}",
+                    "# HELP hpnn_mesh_blob_serves_total Blob GETs the "
+                    "router answered with bytes.",
+                    "# TYPE hpnn_mesh_blob_serves_total counter",
+                    f"hpnn_mesh_blob_serves_total "
+                    f"{blobs.get('serves_total', 0)}",
+                ]
+        if snap.get("swarm") is not None:
+            sw = snap["swarm"]
+            lines += [
+                "# HELP hpnn_mesh_swarm_enabled Peer-to-peer blob "
+                "fan-out active on this worker (HPNN_MESH_SWARM).",
+                "# TYPE hpnn_mesh_swarm_enabled gauge",
+                f"hpnn_mesh_swarm_enabled "
+                f"{1 if sw.get('enabled') else 0}",
+                "# HELP hpnn_mesh_swarm_fetches_total Blob fetch "
+                "attempts by outcome: hit = a hinted peer served, "
+                "miss = one failed peer try, fallback = peers hinted "
+                "but the router served.",
+                "# TYPE hpnn_mesh_swarm_fetches_total counter",
+                'hpnn_mesh_swarm_fetches_total{outcome="hit"} '
+                f"{sw.get('hits', 0)}",
+                'hpnn_mesh_swarm_fetches_total{outcome="miss"} '
+                f"{sw.get('misses', 0)}",
+                'hpnn_mesh_swarm_fetches_total{outcome="fallback"} '
+                f"{sw.get('fallbacks', 0)}",
+                "# HELP hpnn_mesh_swarm_blob_serves_total Blob GETs "
+                "this worker answered for peers.",
+                "# TYPE hpnn_mesh_swarm_blob_serves_total counter",
+                f"hpnn_mesh_swarm_blob_serves_total "
+                f"{sw.get('blob_serves', 0)}",
+                "# HELP hpnn_mesh_swarm_blob_egress_bytes_total Blob "
+                "bytes this worker seeded to peers.",
+                "# TYPE hpnn_mesh_swarm_blob_egress_bytes_total counter",
+                f"hpnn_mesh_swarm_blob_egress_bytes_total "
+                f"{sw.get('blob_egress_bytes', 0)}",
+            ]
         if snap.get("quota") is not None:
             q = snap["quota"]
             lines += [

@@ -641,8 +641,11 @@ class ServeApp:
         jobs = req.get("jobs")
         if jobs is not None and not isinstance(jobs, dict):
             jobs = None  # advisory field: ignore junk, don't reject
+        blobs = req.get("blobs")
+        if blobs is not None and not isinstance(blobs, list):
+            blobs = None  # advisory has-set: ignore junk, don't reject
         return self.mesh_router.register_worker(addr, kernels,
-                                                jobs=jobs)
+                                                jobs=jobs, blobs=blobs)
 
     def handle_mesh_state(self, headers) -> dict:
         """GET /v1/mesh/state: the standby's mirror feed.  When an
@@ -1088,12 +1091,33 @@ class ServeApp:
         # budget is spent on the traffic that matters.  The 429 is a
         # CLIENT-visible policy outcome (4xx: spends no SLO budget
         # itself, or shedding would hold the burn alight forever).
-        if self.shedder is not None and self.shedder.should_shed(lane):
-            raise _HTTPError(
-                429, "shed",
-                "low-priority traffic shed: the availability budget "
-                "is burning (retry later or raise X-HPNN-Priority)",
-                retry_after=self.shedder.retry_after_s())
+        served_stale = False
+        if self.shedder is not None and self.shedder.gate_engaged(lane):
+            # brownout tier (ROADMAP 2c): before 429-shedding, degrade.
+            # A kernel that retains its previous generation serves the
+            # low lane STALE -- pinned to the newest retained prior
+            # generation, flagged ``X-HPNN-Served-Stale: 1`` -- so
+            # degradation is a spectrum (full -> stale -> shed), and
+            # the shed rung only fires when there is nothing to fall
+            # back to.  Explicit generation pins are never overridden:
+            # that client asked for specific weights.
+            stale_gen = None
+            if requested is None:
+                table = b.model.generation_table()
+                prior = [g for g in table.get("retained", ())
+                         if g < table.get("current", 0)]
+                if prior:
+                    stale_gen = max(prior)
+            if stale_gen is None:
+                self.shedder.count_shed()
+                raise _HTTPError(
+                    429, "shed",
+                    "low-priority traffic shed: the availability budget "
+                    "is burning (retry later or raise X-HPNN-Priority)",
+                    retry_after=self.shedder.retry_after_s())
+            gen = stale_gen
+            served_stale = True
+            self.shedder.count_stale()
         raw = req.get("inputs")
         if raw is None:
             one = req.get("input")
@@ -1180,6 +1204,8 @@ class ServeApp:
             "outputs": outs.tolist(),
             "argmax": [int(i) for i in np.argmax(outs, axis=1)],
         }
+        if served_stale:
+            out["served_stale"] = True
         if trace_ctx is not None:
             out["trace"] = trace_ctx[0]
         return out
@@ -1237,6 +1263,7 @@ class ServeApp:
             # path on purpose (disjoint filesystems)
             from .mesh import transport
             from .mesh.transport import BlobError
+            from .mesh.worker import swarm_enabled
 
             agent = self.mesh_worker
             if agent is None:
@@ -1248,14 +1275,19 @@ class ServeApp:
             if self.auth_token:
                 fetch_headers = {"Authorization":
                                  f"Bearer {self.auth_token}"}
+            peers = req.get("peers")
+            if not (swarm_enabled() and isinstance(peers, list)):
+                peers = ()
             try:
-                kernel_path = transport.fetch_blob(
+                kernel_path, source, misses = transport.fetch_blob_from(
                     agent.current, str(blob["sha256"]),
-                    blob.get("size"), agent.blob_dir, timeout_s=20.0,
-                    headers=fetch_headers)
+                    blob.get("size"), agent.blob_dir,
+                    peers=peers, timeout_s=20.0,
+                    headers=fetch_headers, rng=agent._rng)
             except BlobError as exc:
                 raise _HTTPError(409, "reload_failed",
                                  f"blob fetch failed: {exc}")
+            agent.count_fetch(source, misses, bool(peers))
         try:
             return self.reload_model(name, kernel_path,
                                      set_generation=set_generation)
@@ -1638,6 +1670,15 @@ class _Handler(BaseHTTPRequestHandler):
             router = self.app.mesh_router
             data = (router.blob_bytes(m.group(1))
                     if router is not None else None)
+            if data is None and self.app.mesh_worker is not None:
+                # swarm fast path (ISSUE 20): a WORKER serves the
+                # sha-named blobs its own cache landed, so peers pull
+                # weights from each other and the router's NIC stops
+                # being the reload bottleneck.  Same auth rule as the
+                # router's route (checked above); peers re-verify the
+                # sha, so a stale/corrupt cache entry can mislead
+                # nobody.
+                data = self.app.mesh_worker.blob_bytes(m.group(1))
             if data is None:
                 self._reply(404, {"error": f"unknown blob {m.group(1)}",
                                   "reason": "not_found"})
@@ -2125,6 +2166,11 @@ class _Handler(BaseHTTPRequestHandler):
                              kernel=m.group(1), outcome="ok",
                              generation=out.get("generation"))
         t_resp0 = time.monotonic()
+        if out.pop("served_stale", False):
+            # brownout: tell the client it got retained prior-generation
+            # weights (the body's "generation" says which)
+            echo = dict(echo or {})
+            echo["X-HPNN-Served-Stale"] = "1"
         self._reply(200, out, extra_headers=echo)
         t_resp1 = time.monotonic()
         self.app.metrics.observe_phase("respond", t_resp1 - t_resp0)

@@ -1,0 +1,261 @@
+#!/usr/bin/env python3
+"""Swarm weight-distribution benchmark (ISSUE 20) -- SWARM_BENCH.json.
+
+An in-process mesh router fronting 8 subprocess workers, each with its
+own blob cache dir, with ``HPNN_FAULT`` injecting a server-side latency
+on EVERY ``/v1/mesh/blob`` GET (router and workers alike) -- the
+in-process analog of a blob transfer that takes real wire time, which
+is what makes the fan-out topology measurable on one host:
+
+1. **router_only** -- ``HPNN_MESH_SWARM=0``: the PR-11 path, a
+   coherent reload serializing 8 throttled blob pulls through the one
+   router NIC;
+2. **swarm** -- ``HPNN_MESH_SWARM=1``: the router seeds
+   ``HPNN_MESH_SWARM_SEEDS`` (default 2) workers, later waves pull
+   from confirmed peers concurrently, availability doubling per wave.
+
+Floors (asserted, rc!=0 on a miss):
+
+* all 8 workers land each reload's generation, zero failed;
+* the swarm reload's ROUTER egress is exactly seeds x blob size (the
+  byte counter proves the NIC left the hot path) while router_only
+  pays 8 x size;
+* swarm wall-clock beats router_only by >= 2x under the throttle;
+* the workers' own /metrics account for every non-seed fetch as a
+  peer hit.
+
+Honesty rules (bench.py protocol): wall times are client-observed,
+floors are asserted and the process exits non-zero on a miss.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+
+def _scrape_counter(text: str, prefix: str) -> float:
+    """Sum every exposition sample line starting with ``prefix``."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(prefix) and not line.startswith("#"):
+            total += float(line.rsplit(None, 1)[1])
+    return total
+
+
+def _spawn_worker(conf: str, router_addr: str, env: dict,
+                  timeout_s: float = 180.0):
+    """mesh_bench.spawn_worker with an explicit ``env`` (each worker
+    needs its OWN blob cache dir, and eight workers must spawn in
+    parallel -- mutating os.environ around a serial helper would
+    serialize their JAX startups).  Returns (proc, port)."""
+    cmd = [sys.executable, "-u",
+           os.path.join(REPO, "apps", "serve_nn.py"),
+           "-p", "0", "--warmup-mode", "off",
+           "--mesh-role", "worker", "--router", router_addr, conf]
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            env=env)
+    port_box: list = []
+    ready = threading.Event()
+
+    def drain():
+        for line in proc.stdout:
+            if "SERVE: listening on" in line and not port_box:
+                port_box.append(int(line.rsplit(":", 1)[1]))
+                ready.set()
+        ready.set()  # EOF: process died before binding
+
+    threading.Thread(target=drain, daemon=True).start()
+    if not ready.wait(timeout_s) or not port_box:
+        proc.kill()
+        raise RuntimeError(f"worker did not bind within {timeout_s}s")
+    return proc, port_box[0]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--out", default=None)
+    ap.add_argument("--workers", type=int, default=8)
+    ap.add_argument("--seeds", type=int, default=2)
+    ap.add_argument("--latency-ms", type=float, default=600.0,
+                    help="server-side injected delay per blob GET")
+    ap.add_argument("--real", action="store_true",
+                    help="keep the ambient JAX platform in the worker "
+                    "subprocesses (default forces CPU everywhere)")
+    args = ap.parse_args()
+
+    if not args.real:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    import mesh_bench
+    import serve_bench
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+    from hpnn_tpu.serve.mesh import chaos
+    from hpnn_tpu.serve.server import ServeApp, serve_in_thread
+
+    tmp = tempfile.mkdtemp(prefix="hpnn-swarm-bench-")
+    conf = mesh_bench._write_conf(tmp)
+    fault = (f"latency@/v1/mesh/blob:side=server,"
+             f"ms={args.latency_ms:g}")
+    os.environ["HPNN_MESH_SWARM_SEEDS"] = str(args.seeds)
+    os.environ["HPNN_MESH_SWARM"] = "1"
+
+    # in-process router (so the bench can read the egress counters and
+    # arm its chaos rule directly)
+    rapp = ServeApp(max_batch=64, max_queue_rows=4096)
+    rapp.enable_mesh_router(required_workers=args.workers,
+                            health_interval_s=0.5)
+    assert rapp.add_model(conf) is not None
+    rhttpd, _ = serve_in_thread("127.0.0.1", 0, rapp)
+    rport = rhttpd.server_address[1]
+    rbase = f"http://127.0.0.1:{rport}"
+    chaos.configure(fault)  # the router's own blob GETs pay the wire
+
+    procs: list = []
+    wports: list[int] = []
+    errs: list = []
+
+    def spawn(i: int) -> None:
+        # per-worker env: its own blob cache + the same blob-route
+        # throttle, so peer serves pay exactly what the router pays
+        env = dict(os.environ,
+                   PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""),
+                   HPNN_MESH_BLOB_DIR=os.path.join(tmp, f"blobs-w{i}"),
+                   HPNN_FAULT=fault)
+        try:
+            proc, port = _spawn_worker(
+                conf, router_addr=f"127.0.0.1:{rport}", env=env)
+            procs.append(proc)
+            wports.append(port)
+        except Exception as exc:  # pragma: no cover
+            errs.append(exc)
+
+    row: dict = {"workers": args.workers, "seeds": args.seeds,
+                 "latency_ms": args.latency_ms}
+    failed: list[str] = []
+    try:
+        threads = [threading.Thread(target=spawn, args=(i,))
+                   for i in range(args.workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise RuntimeError(f"worker spawn failed: {errs[0]}")
+        mesh_bench.wait_healthz_ok(rbase, timeout_s=120.0)
+
+        def reload_round(seed: int, swarm: bool) -> dict:
+            kern, _ = generate_kernel(seed, 8, [6], 3)
+            kpath = os.path.join(tmp, f"gen-{seed}.opt")
+            dump_kernel_to_path(kern, kpath)
+            with open(kpath, "rb") as fp:
+                data = fp.read()
+            sha = hashlib.sha256(data).hexdigest()
+            os.environ["HPNN_MESH_SWARM"] = "1" if swarm else "0"
+            before = rapp.mesh_router.blobs.stats()
+            t0 = time.monotonic()
+            st, body = serve_bench.http_json(
+                rbase + "/v1/kernels/mesh/reload", {"kernel": kpath},
+                timeout_s=300.0)
+            wall_s = time.monotonic() - t0
+            after = rapp.mesh_router.blobs.stats()
+            if st != 200:
+                raise RuntimeError(f"reload HTTP {st}: {body}")
+            return {
+                "wall_s": round(wall_s, 3),
+                "generation": body["generation"],
+                "blob_bytes": len(data),
+                "sha256": sha,
+                "workers_reloaded":
+                    len(body["mesh"]["workers_reloaded"]),
+                "workers_failed": body["mesh"]["workers_failed"],
+                "router_serves":
+                    after["serves_total"] - before["serves_total"],
+                "router_egress_bytes":
+                    after["egress_bytes_total"]
+                    - before["egress_bytes_total"],
+            }
+
+        row["router_only"] = ro = reload_round(4321, swarm=False)
+        row["swarm"] = sw = reload_round(9753, swarm=True)
+        row["speedup_x"] = round(ro["wall_s"] / sw["wall_s"], 2) \
+            if sw["wall_s"] > 0 else None
+
+        # the workers' own ledger: every non-seed fetch was a peer hit
+        hits = serves = 0.0
+        for port in wports:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics",
+                    timeout=10) as resp:
+                text = resp.read().decode()
+            hits += _scrape_counter(
+                text, 'hpnn_mesh_swarm_fetches_total{outcome="hit"}')
+            serves += _scrape_counter(
+                text, "hpnn_mesh_swarm_blob_serves_total")
+        row["swarm"]["peer_hits"] = int(hits)
+        row["swarm"]["peer_serves"] = int(serves)
+
+        # --- floors ------------------------------------------------------
+        n, k, size = args.workers, args.seeds, sw["blob_bytes"]
+        if ro["workers_reloaded"] != n or ro["workers_failed"]:
+            failed.append(f"router_only reload incomplete: {ro}")
+        if sw["workers_reloaded"] != n or sw["workers_failed"]:
+            failed.append(f"swarm reload incomplete: {sw}")
+        if ro["router_egress_bytes"] != n * ro["blob_bytes"]:
+            failed.append(
+                f"router_only egress {ro['router_egress_bytes']} != "
+                f"{n} x {ro['blob_bytes']}")
+        if sw["router_egress_bytes"] > k * size:
+            failed.append(
+                f"swarm router egress {sw['router_egress_bytes']} "
+                f"exceeds seeds x size = {k * size}")
+        if sw["router_serves"] > k:
+            failed.append(f"router seeded {sw['router_serves']} "
+                          f"workers (cap {k})")
+        if row["speedup_x"] is None or row["speedup_x"] < 2.0:
+            failed.append(f"swarm speedup {row['speedup_x']}x "
+                          "(floor 2.0x)")
+        if int(hits) != n - sw["router_serves"]:
+            failed.append(f"peer hits {int(hits)} != "
+                          f"{n - sw['router_serves']} non-seed workers")
+        if int(serves) < 1:
+            failed.append("no worker ever served a peer")
+    finally:
+        chaos.reset()
+        for proc in procs:
+            if proc.poll() is None:
+                proc.kill()
+        rhttpd.shutdown()
+        rapp.close(drain=False)
+
+    row["floors_failed"] = failed
+    print(json.dumps(row))
+    if args.out:
+        with open(args.out, "w") as fp:
+            fp.write(json.dumps(row) + "\n")
+    if failed:
+        for f in failed:
+            sys.stderr.write(f"SWARM_BENCH floor miss: {f}\n")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -413,3 +413,28 @@ def test_committed_trainers_bench_meshed_cg_row_holds_floors():
     meshed, single = m["meshed"], m["single_device"]
     assert len(meshed["errors"]) == len(single["errors"]) >= 1
     assert meshed["final_error"] < meshed["init_error"]
+
+
+def test_committed_swarm_bench_rows_hold_floors():
+    """The committed SWARM_BENCH.json (make swarm-bench, ISSUE 20)
+    stays pinned in tier 1: under the latency-throttled blob route the
+    seeded-wave swarm reload beat the router-only broadcast by >= 2x,
+    the router's egress counter proves it served the blob to exactly
+    the seed workers (router-only pays workers x size), every non-seed
+    worker landed its copy as a peer hit, and neither round failed a
+    single worker."""
+    art = _load_artifact("SWARM_BENCH.json")
+    assert art["floors_failed"] == []
+    n, k = art["workers"], art["seeds"]
+    assert n >= 8 and 1 <= k < n
+    ro, sw = art["router_only"], art["swarm"]
+    for row in (ro, sw):
+        assert row["workers_reloaded"] == n
+        assert row["workers_failed"] == []
+    assert sw["generation"] > ro["generation"]
+    assert ro["router_egress_bytes"] == n * ro["blob_bytes"]
+    assert sw["router_egress_bytes"] <= k * sw["blob_bytes"]
+    assert sw["router_serves"] <= k
+    assert sw["peer_hits"] == n - sw["router_serves"]
+    assert sw["peer_serves"] >= 1
+    assert art["speedup_x"] >= 2.0

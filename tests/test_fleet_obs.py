@@ -965,6 +965,80 @@ def test_shed_low_lane_only_with_hysteresis(tmp_path):
         app.close(drain=True)
 
 
+def test_brownout_serves_stale_before_shedding(tmp_path):
+    """Brownout tier (ROADMAP 2c): with a retained prior generation,
+    an engaged shedder serves the low lane STALE (pinned to the prior
+    generation, flagged ``X-HPNN-Served-Stale: 1``) instead of 429 --
+    degradation is a spectrum, and the 429 rung stays the fallback for
+    kernels with nothing to fall back to."""
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    app = _shed_app(tmp_path)
+    app.registry.retain_generations = True
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    base = f"http://127.0.0.1:{httpd.server_address[1]}"
+    xs = {"inputs": np.zeros((2, N_IN)).tolist()}
+    low = {"X-HPNN-Priority": "low"}
+    try:
+        # serve once at generation 1 (materializes the weight holder
+        # retention snapshots), then reload to generation 2 so
+        # generation 1 is retained-and-prior
+        st, first, _ = _get_json_h(
+            base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 200 and first["generation"] == 1
+        k2, _ = generate_kernel(4321, N_IN, [N_HID], N_OUT)
+        k2path = str(tmp_path / "tiny2.opt")
+        dump_kernel_to_path(k2, k2path)
+        app.reload_model("tiny", k2path)
+        model = app.registry.get("tiny")
+        assert model.generation == 2
+        assert 1 in model.generation_table()["retained"]
+        st, fresh, hdrs = _get_json_h(
+            base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 200 and fresh["generation"] == 2
+        assert "X-HPNN-Served-Stale" not in hdrs
+        b = app.batchers["tiny"]
+        orig = b.backend
+        b.backend = _DeadBackend()
+        for _ in range(6):
+            st, _ = serve_bench.http_json(
+                base + "/v1/kernels/tiny/infer", xs)
+            assert st == 500
+        b.backend = orig
+        assert app.slo.any_burning()
+        # low lane: served, but from the RETAINED prior generation,
+        # and the response says so
+        st, body, hdrs = _get_json_h(
+            base + "/v1/kernels/tiny/infer", xs, headers=low)
+        assert st == 200 and body["generation"] == 1
+        assert hdrs.get("X-HPNN-Served-Stale") == "1"
+        assert "served_stale" not in body  # header, not body schema
+        # normal lane is untouched by the brownout
+        st, normal, hdrs = _get_json_h(
+            base + "/v1/kernels/tiny/infer", xs)
+        assert st == 200 and normal["generation"] == 2
+        assert "X-HPNN-Served-Stale" not in hdrs
+        snap = app.metrics.snapshot()
+        assert snap["shed"]["active"] is True
+        assert snap["shed"]["stale_served_total"] >= 1
+        assert snap["shed"]["shed_total"] == 0  # degraded, not shed
+        text = app.metrics.render_prometheus()
+        lint_prometheus(text)
+        assert "hpnn_shed_stale_served_total" in text
+        # an EXPLICITLY pinned low-lane request asked for specific
+        # weights: stale-substitution would lie to it, so the shed
+        # rung still applies
+        st, pinned, _ = _get_json_h(
+            base + "/v1/kernels/tiny/infer", xs,
+            headers={**low, "X-HPNN-Generation": "2"})
+        assert st == 429 and pinned["reason"] == "shed"
+        assert app.metrics.snapshot()["shed"]["shed_total"] >= 1
+    finally:
+        httpd.shutdown()
+        app.close(drain=True)
+
+
 def _get_json_h(url, payload=None, headers=None):
     import urllib.error
     import urllib.request

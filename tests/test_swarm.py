@@ -1,0 +1,447 @@
+"""Swarm weight distribution (ISSUE 20): peer-to-peer blob fan-out.
+
+Pins the tentpole contracts:
+
+* multi-source ``fetch_blob_from``: hinted peers first (jittered, one
+  bounded try each), router fallback always-correct; a poisoned peer's
+  bytes are rejected by the sha256 and NEVER swapped in;
+* per-dest single-flight: a thundering herd of concurrent fetches for
+  one blob downloads it ONCE per host;
+* who-has index: heartbeats advertise sha-prefix has-sets, the router's
+  worker table answers ``holders_of``, registration acks and reload
+  broadcasts carry peer hints;
+* seeded wave broadcast: on an N-worker fleet the router serves the
+  blob to at most ``HPNN_MESH_SWARM_SEEDS`` workers (the egress byte
+  counter proves it) and every worker lands the SAME generation
+  sha-verified;
+* ``HPNN_MESH_SWARM=0``: router-only pulls, no hints sent or consumed;
+* chaos: a seeding peer whose blob route dies mid-swarm (server-side
+  connection resets) degrades to the router origin -- zero failed
+  reloads, zero wrong bytes.
+"""
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__)))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "scripts"))
+import serve_bench  # noqa: E402
+
+from hpnn_tpu.serve import ServeApp  # noqa: E402
+from hpnn_tpu.serve.mesh import chaos, transport  # noqa: E402
+from hpnn_tpu.serve.mesh.transport import (  # noqa: E402
+    BlobError,
+    fetch_blob_from,
+    verify_blob_file,
+)
+from hpnn_tpu.serve.mesh.worker import WorkerAgent  # noqa: E402
+from hpnn_tpu.serve.server import serve_in_thread  # noqa: E402
+
+N_IN, N_HID, N_OUT = 8, 6, 3
+
+
+def _write_kernel_conf(tmp_path, name="tiny", seed=1234):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    kern, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    kpath = str(tmp_path / f"{name}.opt")
+    dump_kernel_to_path(kern, kpath)
+    conf = tmp_path / f"{name}.conf"
+    conf.write_text(f"[name] {name}\n[type] ANN\n[init] {kpath}\n"
+                    "[seed] 1\n[train] BP\n")
+    return str(conf), kpath
+
+
+def _new_kernel_file(tmp_path, seed, name="next.opt"):
+    from hpnn_tpu.io.kernel_io import dump_kernel_to_path
+    from hpnn_tpu.models.kernel import generate_kernel
+
+    k, _ = generate_kernel(seed, N_IN, [N_HID], N_OUT)
+    path = str(tmp_path / name)
+    dump_kernel_to_path(k, path)
+    with open(path, "rb") as fp:
+        data = fp.read()
+    return path, data, hashlib.sha256(data).hexdigest()
+
+
+class _BlobServer:
+    """A bare HTTP peer serving one blob (optionally wrong bytes or
+    slowly) -- the swarm's counterpart in miniature, with a GET
+    counter the single-flight test reads."""
+
+    def __init__(self, sha, data, delay_s=0.0):
+        srv = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                srv.gets += 1
+                if srv.delay_s:
+                    time.sleep(srv.delay_s)
+                if self.path != f"/v1/mesh/blob/{srv.sha}":
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/octet-stream")
+                self.send_header("Content-Length", str(len(srv.data)))
+                self.end_headers()
+                self.wfile.write(srv.data)
+
+        self.sha, self.data, self.delay_s = sha, data, delay_s
+        self.gets = 0
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        threading.Thread(target=self.httpd.serve_forever,
+                         daemon=True).start()
+        self.addr = f"127.0.0.1:{self.httpd.server_address[1]}"
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+# --- transport units --------------------------------------------------------
+
+def test_verify_blob_file_streaming(tmp_path):
+    data = os.urandom(3 << 20)  # > one hash chunk: exercises streaming
+    sha = hashlib.sha256(data).hexdigest()
+    path = tmp_path / f"{sha}.opt"
+    path.write_bytes(data)
+    assert verify_blob_file(str(path), sha, len(data))
+    assert verify_blob_file(str(path), sha)  # size optional
+    # truncation short-circuits on the size check
+    path.write_bytes(data[:-1])
+    assert not verify_blob_file(str(path), sha, len(data))
+    # right size, wrong bytes: the hash catches it
+    path.write_bytes(b"x" * len(data))
+    assert not verify_blob_file(str(path), sha, len(data))
+    assert not verify_blob_file(str(tmp_path / "absent.opt"), sha)
+
+
+def test_fetch_single_flight_thundering_herd(tmp_path):
+    """Two concurrent broadcasts for one generation download the blob
+    ONCE per host: the leader fetches, followers wait on its event and
+    re-verify the landed file ("cache")."""
+    data = os.urandom(64 << 10)
+    sha = hashlib.sha256(data).hexdigest()
+    srv = _BlobServer(sha, data, delay_s=0.4)
+    results, errs = [], []
+
+    def one():
+        try:
+            results.append(fetch_blob_from(
+                srv.addr, sha, len(data), str(tmp_path / "cache")))
+        except BlobError as exc:  # pragma: no cover
+            errs.append(exc)
+
+    try:
+        threads = [threading.Thread(target=one) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errs
+        assert srv.gets == 1, "single-flight must download once"
+        sources = sorted(src for _p, src, _m in results)
+        assert sources.count(srv.addr) == 1  # exactly one leader
+        assert sources.count("cache") == 3   # followers re-verified
+        for path, _src, misses in results:
+            assert misses == 0
+            assert verify_blob_file(path, sha, len(data))
+    finally:
+        srv.close()
+
+
+def test_peer_miss_and_poisoned_peer_fall_back_to_router(tmp_path):
+    """A dead peer costs one bounded miss; a poisoned peer serving
+    wrong bytes is rejected by the sha (never swapped in); the router
+    remains the always-correct origin."""
+    data = os.urandom(32 << 10)
+    sha = hashlib.sha256(data).hexdigest()
+    router = _BlobServer(sha, data)
+    poisoned = _BlobServer(sha, b"p" * len(data))  # right size, wrong bytes
+    dead_addr = "127.0.0.1:9"  # discard port: connection refused
+    try:
+        path, source, misses = fetch_blob_from(
+            router.addr, sha, len(data), str(tmp_path / "cache"),
+            peers=[dead_addr, poisoned.addr])
+        assert source == router.addr
+        assert misses == 2  # one per failed peer try
+        assert verify_blob_file(path, sha, len(data))
+        with open(path, "rb") as fp:
+            assert fp.read() == data  # poison never landed
+    finally:
+        router.close()
+        poisoned.close()
+
+
+def test_peer_hit_skips_the_router(tmp_path):
+    data = os.urandom(16 << 10)
+    sha = hashlib.sha256(data).hexdigest()
+    peer = _BlobServer(sha, data)
+    try:
+        path, source, misses = fetch_blob_from(
+            "127.0.0.1:9", sha, len(data), str(tmp_path / "cache"),
+            peers=[peer.addr])
+        assert source == peer.addr and misses == 0
+        assert verify_blob_file(path, sha, len(data))
+    finally:
+        peer.close()
+
+
+# --- in-process fleet helpers ----------------------------------------------
+
+def _mk_worker(conf, router_port, blob_dir):
+    app = ServeApp(max_batch=16, max_queue_rows=512)
+    assert app.add_model(conf, warmup=False) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    port = httpd.server_address[1]
+    agent = WorkerAgent(app, f"127.0.0.1:{router_port}",
+                        f"127.0.0.1:{port}", interval_s=0.3,
+                        blob_dir=str(blob_dir))
+    app.mesh_worker = agent
+    app.metrics.set_swarm_source(agent.swarm_snapshot)
+    agent.start()
+    return app, httpd, port
+
+
+def _mk_router(conf, required):
+    app = ServeApp(max_batch=16, max_queue_rows=512)
+    app.enable_mesh_router(required_workers=required,
+                           health_interval_s=0.2)
+    assert app.add_model(conf) is not None
+    httpd, _ = serve_in_thread("127.0.0.1", 0, app)
+    return app, httpd, httpd.server_address[1]
+
+
+def _wait_quorum(port, timeout_s=15.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        status, body = serve_bench.http_json(
+            f"http://127.0.0.1:{port}/healthz")
+        if status == 200:
+            return body
+        time.sleep(0.05)
+    raise AssertionError(f"router on :{port} never reached quorum")
+
+
+def _mk_fleet(tmp_path, n_workers, required=None):
+    conf, _ = _write_kernel_conf(tmp_path)
+    rapp, rhttpd, rport = _mk_router(
+        conf, required if required is not None else n_workers)
+    fleet = [(rapp, rhttpd)]
+    for i in range(n_workers):
+        app, httpd, _ = _mk_worker(conf, rport,
+                                   tmp_path / f"blobs-w{i}")
+        fleet.append((app, httpd))
+    return conf, fleet, rapp, rport
+
+
+def _close_fleet(fleet):
+    for app, httpd in reversed(fleet):
+        httpd.shutdown()
+        app.close(drain=False)
+
+
+# --- the acceptance pins ----------------------------------------------------
+
+def test_swarm_reload_router_egress_bounded(tmp_path, monkeypatch):
+    """The tentpole contract on a real (in-process) fleet: a coherent
+    reload seeds K workers from the router and the rest pull from
+    peers -- the router's blob egress is EXACTLY K x size, every
+    worker lands the same generation sha-verified, and heartbeats
+    re-advertise the new blob into the who-has index."""
+    monkeypatch.setenv("HPNN_MESH_SWARM_SEEDS", "2")
+    _conf, fleet, rapp, rport = _mk_fleet(tmp_path, 4)
+    try:
+        _wait_quorum(rport)
+        _path, data, sha = _new_kernel_file(tmp_path, 4321)
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/reload",
+            {"kernel": _path})
+        assert st == 200 and body["generation"] == 2
+        assert body["mesh"]["workers_failed"] == []
+        assert len(body["mesh"]["workers_reloaded"]) == 4
+        assert body["mesh"]["blob"]["sha256"] == sha
+        for app, _h in fleet:
+            assert app.registry.get("tiny").generation == 2
+        # the router NIC left the hot path: exactly K seed pulls
+        stats = rapp.mesh_router.blobs.stats()
+        assert stats["serves_total"] == 2
+        assert stats["egress_bytes_total"] == 2 * len(data)
+        # the other two workers were served by peers
+        hits = sum(a.mesh_worker.swarm_hits for a, _h in fleet[1:])
+        serves = sum(a.mesh_worker.blob_serves for a, _h in fleet[1:])
+        assert hits == 2 and serves == 2
+        # every landed copy re-verifies against the broadcast sha
+        for i in range(4):
+            path = tmp_path / f"blobs-w{i}" / f"{sha}.opt"
+            assert verify_blob_file(str(path), sha, len(data))
+        # heartbeats advertise the has-set into the router's index
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            holders = rapp.mesh_router.holders_of(sha)
+            if len(holders) == 4:
+                break
+            time.sleep(0.1)
+        assert len(rapp.mesh_router.holders_of(sha)) == 4
+        # worker /metrics exposes the swarm counters, lint-clean
+        from test_obs import lint_prometheus
+
+        wapp = fleet[1][0]
+        text = wapp.metrics.render_prometheus()
+        lint_prometheus(text)
+        assert "hpnn_mesh_swarm_enabled 1" in text
+        assert "hpnn_mesh_swarm_fetches_total" in text
+        # router /metrics exposes the blob store counters
+        rtext = rapp.metrics.render_prometheus()
+        lint_prometheus(rtext)
+        assert ("hpnn_mesh_blob_egress_bytes_total "
+                f"{2 * len(data)}") in rtext
+        assert "hpnn_mesh_blob_evictions_total 0" in rtext
+    finally:
+        _close_fleet(fleet)
+
+
+def test_swarm_off_is_router_only(tmp_path, monkeypatch):
+    """HPNN_MESH_SWARM=0 escape hatch: the broadcast is the serial
+    PR-11 loop (no hints sent or consumed), every worker pulls from
+    the router, and no has-set is advertised."""
+    monkeypatch.setenv("HPNN_MESH_SWARM", "0")
+    _conf, fleet, rapp, rport = _mk_fleet(tmp_path, 3)
+    try:
+        _wait_quorum(rport)
+        _path, data, sha = _new_kernel_file(tmp_path, 999)
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/reload",
+            {"kernel": _path})
+        assert st == 200 and body["generation"] == 2
+        assert body["mesh"]["workers_failed"] == []
+        assert len(body["mesh"]["workers_reloaded"]) == 3
+        for app, _h in fleet:
+            assert app.registry.get("tiny").generation == 2
+        # router-only: every worker pulled from the origin
+        stats = rapp.mesh_router.blobs.stats()
+        assert stats["serves_total"] == 3
+        assert stats["egress_bytes_total"] == 3 * len(data)
+        for app, _h in fleet[1:]:
+            snap = app.mesh_worker.swarm_snapshot()
+            assert snap["enabled"] is False
+            assert snap["hits"] == snap["misses"] == 0
+            assert snap["fallbacks"] == snap["blob_serves"] == 0
+        # no has-set advertised, so the who-has index stays empty
+        for w in rapp.mesh_router.pool.workers():
+            assert not w.blobs or sha not in w.blobs
+    finally:
+        _close_fleet(fleet)
+
+
+def test_seeding_peer_blob_route_killed_mid_swarm(tmp_path,
+                                                  monkeypatch):
+    """Chaos (server side, the peer's blob route): connection resets on
+    blob GETs mid-swarm -- the analog of kill -9 on a seeding peer.
+    The fetch machinery (peer miss -> router fallback -> bounded
+    retries) still lands every worker on the new generation with ZERO
+    failed reloads and zero wrong bytes."""
+    monkeypatch.setenv("HPNN_MESH_SWARM_SEEDS", "1")
+    _conf, fleet, rapp, rport = _mk_fleet(tmp_path, 3)
+    try:
+        _wait_quorum(rport)
+        _path, data, sha = _new_kernel_file(tmp_path, 777)
+        # after=1: the seed's own router pull survives, then the next
+        # TWO blob GETs (the second worker's peer try and its first
+        # router fallback) die at the server side mid-response
+        chaos.configure(
+            "reset@/v1/mesh/blob:side=server,after=1,times=2")
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/reload",
+            {"kernel": _path})
+        assert st == 200 and body["generation"] == 2
+        assert body["mesh"]["workers_failed"] == []
+        assert len(body["mesh"]["workers_reloaded"]) == 3
+        for app, _h in fleet:
+            assert app.registry.get("tiny").generation == 2
+        for i in range(3):
+            path = tmp_path / f"blobs-w{i}" / f"{sha}.opt"
+            assert verify_blob_file(str(path), sha, len(data))
+    finally:
+        chaos.reset()
+        _close_fleet(fleet)
+
+
+def test_registration_ack_carries_peer_hints(tmp_path, monkeypatch):
+    """The heartbeat catch-up path swarms too: once workers hold a
+    blob, a registration ack's kernel state names them as peers (the
+    asking worker excluded)."""
+    monkeypatch.setenv("HPNN_MESH_SWARM_SEEDS", "2")
+    _conf, fleet, rapp, rport = _mk_fleet(tmp_path, 2)
+    try:
+        _wait_quorum(rport)
+        _path, data, sha = _new_kernel_file(tmp_path, 31415)
+        st, body = serve_bench.http_json(
+            f"http://127.0.0.1:{rport}/v1/kernels/tiny/reload",
+            {"kernel": _path})
+        assert st == 200
+        ack = rapp.mesh_router.register_worker("127.0.0.1:59999", {})
+        info = ack["kernels"]["tiny"]
+        assert info["blob"]["sha256"] == sha
+        peers = info.get("peers") or []
+        assert len(peers) == 2  # both broadcast-confirmed holders
+        assert "127.0.0.1:59999" not in peers
+        # the asking worker itself is excluded from its own hints
+        a_worker = fleet[1][0].mesh_worker.advertise
+        ack2 = rapp.mesh_router.register_worker(a_worker, {})
+        assert a_worker not in (ack2["kernels"]["tiny"].get("peers")
+                                or [])
+    finally:
+        _close_fleet(fleet)
+
+
+def test_has_set_prefix_matching_units(tmp_path):
+    """Who-has units: has-set scanning trusts only 64-hex ``.opt``
+    names, prefixes match by startswith (router/worker prefix lengths
+    need not agree), and the standby's mirror adopts the index."""
+    from hpnn_tpu.serve.mesh.router import WorkerPool
+
+    blob_dir = tmp_path / "blobs"
+    blob_dir.mkdir()
+    data = os.urandom(1024)
+    sha = hashlib.sha256(data).hexdigest()
+    (blob_dir / f"{sha}.opt").write_bytes(data)
+    (blob_dir / "junk.opt").write_bytes(b"x")        # not a sha name
+    (blob_dir / f"{sha[:10]}.opt").write_bytes(b"x")  # too short
+    app = ServeApp(max_batch=4)
+    agent = WorkerAgent(app, "127.0.0.1:1", "127.0.0.1:2",
+                        interval_s=60.0, blob_dir=str(blob_dir))
+    hs = agent.blob_has_set()
+    assert hs == [sha[:12]]
+    pool = WorkerPool(eject_after=2)
+    try:
+        w = pool.register("127.0.0.1:7001", {}, blobs=hs)
+        assert w.has_blob(sha)
+        assert not w.has_blob("f" * 64)
+        # a later heartbeat's has-set REPLACES the entry (evictions
+        # drop out of the index)
+        pool.register("127.0.0.1:7001", {}, blobs=[])
+        assert not w.has_blob(sha)
+        # blobs=None (a pre-swarm worker) leaves the entry alone
+        pool.register("127.0.0.1:7001", {}, blobs=hs)
+        pool.register("127.0.0.1:7001", {})
+        assert w.has_blob(sha)
+        # the standby mirror carries the index through to_dict()
+        assert w.to_dict()["blobs"] == sorted({p.lower() for p in hs})
+    finally:
+        pool.close()
+        app.close(drain=False)
